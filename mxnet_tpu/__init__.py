@@ -1,0 +1,77 @@
+"""mxnet_tpu: a TPU-native deep learning framework with MXNet's API surface.
+
+A ground-up rebuild of Apache MXNet 1.6 (reference: hkvision/incubator-mxnet)
+for TPU: NDArray/autograd/Gluon/Module/KVStore semantics preserved, execution
+substrate replaced by JAX/XLA (eager = async PJRT dispatch, hybridize = jit
+to one HLO module, distribution = XLA collectives over the ICI mesh).
+
+Typical use:  ``import mxnet_tpu as mx``
+"""
+__version__ = "0.1.0"
+
+import jax as _jax
+
+# MXNet's dtype surface includes int64/float64 (e.g. large-tensor indexing,
+# `test_large_array.py` in the reference); JAX's 32-bit default would
+# silently truncate, so enable x64 and keep float32/bfloat16 as the
+# *convention* (all creation fns default to float32, models use bf16).
+_jax.config.update("jax_enable_x64", True)
+
+from .base import MXNetError
+from .context import (Context, cpu, gpu, tpu, cpu_pinned, current_context,
+                      num_gpus, num_tpus, gpu_memory_info)
+from . import ndarray
+from . import ndarray as nd
+from .ndarray import NDArray
+from . import random
+from . import autograd
+from . import _tape
+
+# Heavier subsystems are imported lazily via __getattr__ to keep import fast.
+_LAZY = {
+    "gluon": ".gluon",
+    "sym": ".symbol",
+    "symbol": ".symbol",
+    "mod": ".module",
+    "module": ".module",
+    "np": ".numpy",
+    "npx": ".numpy_extension",
+    "optimizer": ".optimizer",
+    "metric": ".metric",
+    "initializer": ".initializer",
+    "init": ".initializer",
+    "io": ".io",
+    "image": ".image",
+    "recordio": ".recordio",
+    "kvstore": ".kvstore",
+    "kv": ".kvstore",
+    "parallel": ".parallel",
+    "profiler": ".profiler",
+    "lr_scheduler": ".lr_scheduler",
+    "callback": ".callback",
+    "monitor": ".monitor",
+    "visualization": ".visualization",
+    "viz": ".visualization",
+    "runtime": ".runtime",
+    "test_utils": ".test_utils",
+    "engine": ".engine",
+    "contrib": ".contrib",
+    "amp": ".contrib.amp",
+    "model": ".model",
+    "rnn": ".rnn",
+    "util": ".util",
+}
+
+
+def __getattr__(name):
+    target = _LAZY.get(name)
+    if target is None:
+        raise AttributeError("module 'mxnet_tpu' has no attribute %r" % name)
+    import importlib
+    mod = importlib.import_module(target, __name__)
+    globals()[name] = mod
+    return mod
+
+
+def waitall():
+    nd.waitall()

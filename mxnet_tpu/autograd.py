@@ -1,0 +1,224 @@
+"""Autograd: imperative differentiation API.
+
+Parity surface: reference ``python/mxnet/autograd.py`` (record :122,
+pause :146, train_mode/predict_mode, backward :246, grad :273, Function
+:368) over ``src/imperative/imperative.cc``.
+
+TPU-native: recording builds a tape of pure JAX ops (mxnet_tpu/_tape.py);
+``backward`` lowers the whole recorded graph through one ``jax.vjp`` call —
+XLA compiles forward+backward together instead of op-at-a-time kernels.
+"""
+from __future__ import annotations
+
+from . import _tape
+from ._tape import is_recording, is_training
+from .ndarray.ndarray import NDArray
+
+__all__ = ["record", "pause", "train_mode", "predict_mode", "is_recording",
+           "is_training", "mark_variables", "backward", "grad", "Function",
+           "set_recording", "set_training"]
+
+
+def set_recording(is_rec):
+    return _tape.set_recording(is_rec)
+
+
+def set_training(train):
+    return _tape.set_training(train)
+
+
+class _RecordingStateScope:
+    def __init__(self, is_record, train_mode_):
+        self._enter_rec = is_record
+        self._enter_train = train_mode_
+        self._prev_rec = None
+        self._prev_train = None
+
+    def __enter__(self):
+        if self._enter_rec is not None:
+            self._prev_rec = _tape.set_recording(self._enter_rec)
+        if self._enter_train is not None:
+            self._prev_train = _tape.set_training(self._enter_train)
+        return self
+
+    def __exit__(self, *a):
+        if self._prev_rec is not None or self._enter_rec is not None:
+            _tape.set_recording(self._prev_rec)
+        if self._prev_train is not None or self._enter_train is not None:
+            _tape.set_training(self._prev_train)
+
+
+def record(train_mode=True):
+    """Scope: ops executed inside are recorded for backward."""
+    return _RecordingStateScope(True, train_mode)
+
+
+def pause(train_mode=False):
+    return _RecordingStateScope(False, train_mode)
+
+
+def train_mode():
+    return _RecordingStateScope(None, True)
+
+
+def predict_mode():
+    return _RecordingStateScope(None, False)
+
+
+def mark_variables(variables, gradients, grad_reqs="write"):
+    """reference Imperative::MarkVariables `src/imperative/imperative.cc:123`."""
+    if isinstance(variables, NDArray):
+        variables, gradients = [variables], [gradients]
+    if isinstance(grad_reqs, str):
+        grad_reqs = [grad_reqs] * len(variables)
+    for v, g, req in zip(variables, gradients, grad_reqs):
+        v._grad = g
+        v._grad_req = req
+        v._ag_node = (_tape.Leaf(v), 0)
+
+
+def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
+    if isinstance(heads, NDArray):
+        heads = [heads]
+        if head_grads is not None and isinstance(head_grads, NDArray):
+            head_grads = [head_grads]
+    _tape.backward(heads, head_grads, retain_graph=retain_graph,
+                   train_mode=train_mode)
+
+
+def grad(heads, variables, head_grads=None, retain_graph=None,
+         create_graph=False, train_mode=True):
+    """Functional gradient (reference autograd.grad `python/mxnet/autograd.py:273`).
+    With create_graph=True the returned grads are recorded onto the tape so
+    higher-order gradients work (replayed through jax.vjp again)."""
+    import jax.numpy as jnp
+    if isinstance(heads, NDArray):
+        heads = [heads]
+    if isinstance(variables, NDArray):
+        variables = [variables]
+    for v in variables:
+        if v._ag_node is None:
+            raise ValueError("variable passed to grad() must have attach_grad/"
+                             "mark_variables called or be used under record()")
+    heads_idx = []
+    grads_in = []
+    for i, h in enumerate(heads):
+        if h._ag_node is None:
+            raise ValueError("head not recorded")
+        heads_idx.append(h._ag_node)
+        if head_grads is None or head_grads[i] is None:
+            grads_in.append(jnp.ones(h.shape, dtype=h._data.dtype))
+        else:
+            grads_in.append(head_grads[i]._data)
+
+    var_leaves = [v._ag_node[0] for v in variables]
+    order = _tape._toposort([n for n, _ in heads_idx])
+    leaves = [l for l in _tape._collect_leaves(order)]
+    # ensure requested variables present even if unreached
+    leaf_ids = {id(l) for l in leaves}
+    import jax
+    leaf_vals = [l.handle._data for l in leaves]
+
+    def fn(lv):
+        return _tape._replay(order, heads_idx, leaves, lv)
+
+    if create_graph:
+        # record the grad computation as a single tape node
+        def grad_fn(*args):
+            lv = list(args[:len(leaves)])
+            gs = list(args[len(leaves):])
+            _, vjp_fn = jax.vjp(lambda l: _tape._replay(order, heads_idx, leaves, l), lv)
+            (g_out,) = vjp_fn(gs)
+            return tuple(g_out)
+
+        parents = [_leaf_parent(l) for l in leaves]
+        parents += [_tape.Const(g) for g in grads_in]
+        node = _tape.OpNode(grad_fn, parents, len(leaves), {}, "_backward")
+        vals = grad_fn(*([lv for lv in leaf_vals] + grads_in))
+        out_by_leaf = {id(l): (node, i, v) for i, (l, v) in enumerate(zip(leaves, vals))}
+    else:
+        _, vjp_fn = jax.vjp(fn, leaf_vals)
+        (gvals,) = vjp_fn(grads_in)
+        out_by_leaf = {id(l): (None, i, v) for i, (l, v) in enumerate(zip(leaves, gvals))}
+
+    results = []
+    for v in variables:
+        leaf = v._ag_node[0]
+        if id(leaf) in out_by_leaf:
+            nd, i, val = out_by_leaf[id(leaf)]
+            arr = NDArray(val, ctx=v._ctx)
+            if nd is not None and _tape.is_recording():
+                arr._ag_node = (nd, i)
+            results.append(arr)
+        else:
+            results.append(NDArray(jnp.zeros(v.shape, v._data.dtype), ctx=v._ctx))
+    return results
+
+
+def _leaf_parent(l):
+    return (l, 0)
+
+
+class Function:
+    """Custom differentiable function (reference autograd.Function
+    `python/mxnet/autograd.py:368`): user defines forward() and backward().
+    Lowered as a jax.custom_vjp around the recorded node."""
+
+    def __init__(self):
+        self._saved = ()
+
+    def save_for_backward(self, *args):
+        self._saved = args
+
+    @property
+    def saved_tensors(self):
+        return self._saved
+
+    def forward(self, *inputs):
+        raise NotImplementedError
+
+    def backward(self, *output_grads):
+        raise NotImplementedError
+
+    def __call__(self, *inputs):
+        import jax
+        import jax.numpy as jnp
+        self_ref = self
+
+        outs = self.forward(*inputs)
+        multi = isinstance(outs, (list, tuple))
+        outs_t = tuple(outs) if multi else (outs,)
+
+        if _tape.is_recording():
+            def fwd_fn(*vals):
+                nds = [NDArray(v) for v in vals]
+                with pause():
+                    res = self_ref.forward(*nds)
+                res = res if isinstance(res, (list, tuple)) else [res]
+                return tuple(r._data for r in res)
+
+            @jax.custom_vjp
+            def wrapped(*vals):
+                return fwd_fn(*vals)
+
+            def wrapped_fwd(*vals):
+                return fwd_fn(*vals), vals
+
+            def wrapped_bwd(res_vals, gs):
+                g_nds = [NDArray(g) for g in gs]
+                with pause():
+                    igrads = self_ref.backward(*g_nds)
+                igrads = igrads if isinstance(igrads, (list, tuple)) else [igrads]
+                return tuple(ig._data for ig in igrads)
+
+            wrapped.defvjp(wrapped_fwd, wrapped_bwd)
+
+            parents = []
+            for a in inputs:
+                node = a._ag_node
+                parents.append(node if node is not None else _tape.Const(a._data))
+            node = _tape.OpNode(wrapped, parents, len(outs_t), {},
+                                type(self).__name__)
+            for i, o in enumerate(outs_t):
+                o._ag_node = (node, i)
+        return outs

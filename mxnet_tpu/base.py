@@ -1,0 +1,62 @@
+"""Base utilities: dtypes, errors, registry plumbing.
+
+TPU-native re-design of the roles of ``python/mxnet/base.py`` (reference
+`python/mxnet/base.py`) — but with no ctypes FFI for the compute path: the
+"runtime" is JAX/XLA, so the bridge layer the reference needs (check_call,
+handle types) collapses to plain Python. The native C++ runtime pieces this
+framework does have (engine, recordio) expose their own ctypes bridge in
+``mxnet_tpu._ffi``.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+__all__ = [
+    "MXNetError", "string_types", "numeric_types", "integer_types",
+    "dtype_np", "dtype_name", "_as_list",
+]
+
+
+class MXNetError(RuntimeError):
+    """Error raised by the framework (parity with mxnet.base.MXNetError)."""
+
+
+string_types = (str,)
+numeric_types = (float, int, _np.generic)
+integer_types = (int, _np.integer)
+
+# Canonical dtype table. MXNet uses an int code enum (reference
+# `python/mxnet/ndarray/ndarray.py:54` _DTYPE_NP_TO_MX); on TPU the canonical
+# low-precision type is bfloat16 rather than float16, but both are supported.
+_DTYPE_ALIASES = {
+    "float": "float32",
+    "double": "float64",
+    "half": "float16",
+    "bf16": "bfloat16",
+}
+
+
+def dtype_np(dtype):
+    """Normalize a user dtype spec to a numpy dtype (incl. bfloat16)."""
+    if dtype is None:
+        return _np.dtype("float32")
+    if isinstance(dtype, str):
+        dtype = _DTYPE_ALIASES.get(dtype, dtype)
+        if dtype == "bfloat16":
+            import ml_dtypes
+            return _np.dtype(ml_dtypes.bfloat16)
+    if hasattr(dtype, "dtype"):
+        dtype = dtype.dtype
+    return _np.dtype(dtype)
+
+
+def dtype_name(dtype) -> str:
+    return dtype_np(dtype).name
+
+
+def _as_list(obj):
+    if obj is None:
+        return []
+    if isinstance(obj, (list, tuple)):
+        return list(obj)
+    return [obj]

@@ -1,0 +1,134 @@
+"""Device contexts.
+
+Parity surface: reference ``python/mxnet/context.py`` (Context class,
+``mx.cpu()`` / ``mx.gpu()``). TPU-native additions: ``mx.tpu()`` is the
+accelerator context; ``mx.gpu()`` aliases to the default accelerator so
+reference scripts run unmodified. A Context maps to a concrete
+``jax.Device``; ``with ctx:`` scopes default placement the way the
+reference's thread-local ``Context._default_ctx`` does
+(reference `python/mxnet/context.py:88`).
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+
+__all__ = ["Context", "cpu", "gpu", "tpu", "cpu_pinned", "current_context",
+           "num_gpus", "num_tpus", "gpu_memory_info"]
+
+_thread_local = threading.local()
+
+
+class Context:
+    """A device context (cpu / tpu). ``device_id`` indexes jax.devices()."""
+
+    devtype2str = {1: "cpu", 2: "gpu", 3: "cpu_pinned", 5: "cpu_shared", 6: "tpu"}
+    devstr2type = {"cpu": 1, "gpu": 2, "cpu_pinned": 3, "cpu_shared": 5, "tpu": 6}
+
+    def __init__(self, device_type, device_id=0):
+        if isinstance(device_type, Context):
+            device_type, device_id = device_type.device_type, device_type.device_id
+        self.device_type = device_type
+        self.device_id = device_id
+
+    @property
+    def device_typeid(self):
+        return self.devstr2type[self.device_type]
+
+    def _accelerators(self):
+        try:
+            accel = [d for d in jax.devices() if d.platform != "cpu"]
+        except RuntimeError:
+            accel = []
+        return accel
+
+    @property
+    def jax_device(self):
+        """Resolve to a concrete jax.Device."""
+        if self.device_type in ("cpu", "cpu_pinned", "cpu_shared"):
+            cpus = [d for d in jax.devices("cpu")] if _has_cpu() else jax.devices()
+            return cpus[min(self.device_id, len(cpus) - 1)]
+        accel = self._accelerators()
+        if not accel:  # CPU-only process (tests): accelerator ctx falls back
+            return jax.devices()[min(self.device_id, len(jax.devices()) - 1)]
+        return accel[min(self.device_id, len(accel) - 1)]
+
+    def __hash__(self):
+        return hash((self.device_type, self.device_id))
+
+    def __eq__(self, other):
+        return (isinstance(other, Context)
+                and self.device_type == other.device_type
+                and self.device_id == other.device_id)
+
+    def __str__(self):
+        return "%s(%d)" % (self.device_type, self.device_id)
+
+    __repr__ = __str__
+
+    def __enter__(self):
+        if not hasattr(_thread_local, "stack"):
+            _thread_local.stack = []
+        _thread_local.stack.append(self)
+        return self
+
+    def __exit__(self, *args):
+        _thread_local.stack.pop()
+
+    def empty_cache(self):
+        """Parity with mx.Context.empty_cache — XLA manages pools; no-op."""
+
+
+def _has_cpu():
+    try:
+        jax.devices("cpu")
+        return True
+    except RuntimeError:
+        return False
+
+
+def cpu(device_id=0):
+    return Context("cpu", device_id)
+
+
+def cpu_pinned(device_id=0):
+    return Context("cpu_pinned", device_id)
+
+
+def tpu(device_id=0):
+    return Context("tpu", device_id)
+
+
+def gpu(device_id=0):
+    """Alias: reference scripts that say mx.gpu(i) get the accelerator."""
+    return Context("gpu", device_id)
+
+
+def num_tpus():
+    try:
+        return len([d for d in jax.devices() if d.platform != "cpu"])
+    except RuntimeError:
+        return 0
+
+
+def num_gpus():
+    return num_tpus()
+
+
+def gpu_memory_info(device_id=0):
+    d = Context("tpu", device_id).jax_device
+    try:
+        stats = d.memory_stats()
+        total = stats.get("bytes_limit", 0)
+        used = stats.get("bytes_in_use", 0)
+        return (total - used, total)
+    except Exception:
+        return (0, 0)
+
+
+def current_context() -> Context:
+    stack = getattr(_thread_local, "stack", None)
+    if stack:
+        return stack[-1]
+    return Context("cpu", 0) if num_tpus() == 0 else Context("tpu", 0)

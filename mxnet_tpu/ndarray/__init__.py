@@ -1,0 +1,32 @@
+"""``mx.nd`` namespace: NDArray + the full generated op namespace.
+
+The reference generates this module's functions from the C op registry at
+import (reference `python/mxnet/ndarray/register.py`); we do the same from
+the Python-side registry — one source of truth for eager, symbolic, and
+numpy frontends."""
+from .ndarray import (NDArray, array, zeros, ones, full, empty, arange, eye,
+                      concat, stack, save, load, waitall, from_numpy,
+                      from_dlpack, to_dlpack_for_read, to_dlpack_for_write)
+from . import sparse
+from .. import ops as _ops
+from ..ops.registry import get_op as _get_op, list_ops as _list_ops
+from .. import random as _random_mod
+
+# contrib namespace (control flow + contrib ops)
+from . import contrib  # noqa: F401
+from . import linalg   # noqa: F401
+from . import random   # noqa: F401
+
+_ops.populate_namespace(globals())
+
+
+def __getattr__(name):
+    op = _get_op(name)
+    if op is None:
+        raise AttributeError("module 'mxnet_tpu.ndarray' has no attribute %r" % name)
+    return op
+
+
+def imresize(*a, **k):
+    from ..image import imresize as _f
+    return _f(*a, **k)

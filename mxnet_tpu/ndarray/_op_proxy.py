@@ -1,0 +1,12 @@
+"""Dynamic op lookup for NDArray methods (PEP 562 module __getattr__).
+
+Plays the role of the generated per-op Python functions the reference builds
+at import time (reference `python/mxnet/ndarray/register.py:270`)."""
+from ..ops.registry import get_op
+
+
+def __getattr__(name):
+    op = get_op(name)
+    if op is None:
+        raise AttributeError("no operator %r registered" % name)
+    return op

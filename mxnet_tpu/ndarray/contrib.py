@@ -1,0 +1,10 @@
+"""mx.nd.contrib namespace (reference `python/mxnet/ndarray/contrib.py`)."""
+from ..ops.contrib_ops import foreach, while_loop, cond  # noqa: F401
+from ..ops.registry import get_op as _get_op
+
+
+def __getattr__(name):
+    op = _get_op("_contrib_" + name) or _get_op(name)
+    if op is None:
+        raise AttributeError("no contrib operator %r" % name)
+    return op

@@ -1,0 +1,10 @@
+"""mx.nd.linalg namespace (reference `python/mxnet/ndarray/linalg.py` over
+src/operator/linalg ops)."""
+from ..ops.registry import get_op as _get_op
+
+
+def __getattr__(name):
+    op = _get_op("linalg_" + name) or _get_op(name)
+    if op is None:
+        raise AttributeError("no linalg operator %r" % name)
+    return op

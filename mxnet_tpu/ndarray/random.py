@@ -1,0 +1,4 @@
+"""mx.nd.random namespace (reference `python/mxnet/ndarray/random.py`)."""
+from ..random import (uniform, normal, randn, randint, gamma, exponential,  # noqa: F401
+                      poisson, negative_binomial, generalized_negative_binomial,
+                      multinomial, shuffle, bernoulli, seed)

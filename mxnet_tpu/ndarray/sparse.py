@@ -1,0 +1,135 @@
+"""Sparse NDArray API: row_sparse + CSR.
+
+Parity surface: reference ``python/mxnet/ndarray/sparse.py`` and the
+storage-type machinery (`include/mxnet/ndarray.h:61-66` kDefaultStorage/
+kRowSparseStorage/kCSRStorage; cast_storage
+`src/operator/tensor/cast_storage.cc`).
+
+TPU-native design: XLA has no native sparse layouts, so sparse arrays are
+API-complete views that keep (indices, data) host/device-side and densify on
+compute — the documented dense-fallback strategy (SURVEY §5.9). Row-sparse
+gradient *semantics* (the reason MXNet has row_sparse: embedding grads) are
+preserved where they matter: optimizers take a `lazy_update` path keyed on
+rows, and kvstore row_sparse_pull is supported.
+"""
+from __future__ import annotations
+
+import numpy as _np
+import jax.numpy as jnp
+
+from .ndarray import NDArray, array, zeros as _dense_zeros
+
+__all__ = ["CSRNDArray", "RowSparseNDArray", "csr_matrix", "row_sparse_array",
+           "zeros", "empty", "array"]
+
+
+class BaseSparseNDArray(NDArray):
+    __slots__ = ()
+
+
+class RowSparseNDArray(BaseSparseNDArray):
+    """Dense-backed row_sparse view: tracks .indices/.data accessors."""
+    __slots__ = ("_indices",)
+
+    def __init__(self, data, indices=None, ctx=None, dtype=None):
+        super().__init__(data, ctx=ctx, dtype=dtype, stype="row_sparse")
+        if indices is None:
+            dense = _np.asarray(self._data)
+            nz = _np.where(dense.reshape(dense.shape[0], -1).any(axis=1))[0]
+            indices = nz
+        self._indices = jnp.asarray(_np.asarray(indices, dtype=_np.int64))
+
+    @property
+    def indices(self):
+        return NDArray(self._indices)
+
+    @property
+    def data(self):
+        return NDArray(jnp.take(self._data, self._indices.astype(jnp.int32), axis=0))
+
+    def tostype(self, stype):
+        return _to_stype(self, stype)
+
+
+class CSRNDArray(BaseSparseNDArray):
+    __slots__ = ("_indptr_", "_indices_")
+
+    def __init__(self, data, indptr=None, indices=None, ctx=None, dtype=None):
+        super().__init__(data, ctx=ctx, dtype=dtype, stype="csr")
+        if indptr is None or indices is None:
+            dense = _np.asarray(self._data)
+            indptr = [0]
+            idx = []
+            for row in dense:
+                nz = _np.nonzero(row)[0]
+                idx.extend(nz.tolist())
+                indptr.append(len(idx))
+            indptr, indices = _np.array(indptr), _np.array(idx)
+        self._indptr_ = jnp.asarray(_np.asarray(indptr, dtype=_np.int64))
+        self._indices_ = jnp.asarray(_np.asarray(indices, dtype=_np.int64))
+
+    @property
+    def indptr(self):
+        return NDArray(self._indptr_)
+
+    @property
+    def indices(self):
+        return NDArray(self._indices_)
+
+    @property
+    def data(self):
+        dense = _np.asarray(self._data)
+        vals = dense[dense != 0] if dense.ndim == 2 else dense
+        return NDArray(jnp.asarray(vals))
+
+    def tostype(self, stype):
+        return _to_stype(self, stype)
+
+
+def _to_stype(arr, stype):
+    if stype == "default":
+        return NDArray(arr._data, ctx=arr._ctx)
+    if stype == "row_sparse":
+        return RowSparseNDArray(arr._data, ctx=arr._ctx)
+    if stype == "csr":
+        if arr.ndim != 2:
+            raise ValueError("csr requires 2D")
+        return CSRNDArray(arr._data, ctx=arr._ctx)
+    raise ValueError("unknown stype %r" % stype)
+
+
+def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
+    if isinstance(arg1, tuple) and len(arg1) == 3:
+        data, indices, indptr = arg1
+        data = _np.asarray(data)
+        indices = _np.asarray(indices, dtype=_np.int64)
+        indptr = _np.asarray(indptr, dtype=_np.int64)
+        n_rows = len(indptr) - 1
+        n_cols = shape[1] if shape else int(indices.max()) + 1
+        dense = _np.zeros((n_rows, n_cols), dtype=data.dtype)
+        for r in range(n_rows):
+            for j in range(indptr[r], indptr[r + 1]):
+                dense[r, indices[j]] = data[j]
+        return CSRNDArray(dense, indptr=indptr, indices=indices, ctx=ctx, dtype=dtype)
+    return CSRNDArray(_np.asarray(arg1), ctx=ctx, dtype=dtype)
+
+
+def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
+    if isinstance(arg1, tuple) and len(arg1) == 2:
+        data, indices = arg1
+        data = _np.asarray(data)
+        indices = _np.asarray(indices, dtype=_np.int64)
+        n_rows = shape[0] if shape else int(indices.max()) + 1
+        dense = _np.zeros((n_rows,) + data.shape[1:], dtype=data.dtype)
+        dense[indices] = data
+        return RowSparseNDArray(dense, indices=indices, ctx=ctx, dtype=dtype)
+    return RowSparseNDArray(_np.asarray(arg1), ctx=ctx, dtype=dtype)
+
+
+def zeros(stype, shape, ctx=None, dtype=None):
+    d = _dense_zeros(shape, ctx=ctx, dtype=dtype)
+    return _to_stype(d, stype)
+
+
+def empty(stype, shape, ctx=None, dtype=None):
+    return zeros(stype, shape, ctx=ctx, dtype=dtype)

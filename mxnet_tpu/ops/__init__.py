@@ -1,0 +1,20 @@
+"""Operator registry package.
+
+Importing this package registers the full op corpus (core + nn). Namespaces
+(mx.nd, mx.sym, mx.np) are *generated* from the registry at import, the same
+mechanism as the reference's generated op modules
+(reference `python/mxnet/ndarray/register.py:116`
+_generate_ndarray_function_code)."""
+from . import registry
+from .registry import register, get_op, list_ops, invoke, Op
+from . import core      # noqa: F401  (registers core tensor ops)
+from . import nn        # noqa: F401  (registers NN ops)
+from . import contrib_ops  # noqa: F401
+
+
+def populate_namespace(target, names=None):
+    """Inject registered ops into a module/dict namespace (mx.nd codegen)."""
+    for name in (names or list_ops()):
+        op = get_op(name)
+        if op is not None:
+            target[name] = op

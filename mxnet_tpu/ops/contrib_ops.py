@@ -1,0 +1,115 @@
+"""Contrib + control-flow operators.
+
+Role parity: reference ``src/operator/contrib/control_flow.cc``
+(_foreach :1089, _while_loop, _cond :1255) and assorted contrib ops.
+TPU-native: control flow maps directly onto lax.scan / lax.while_loop /
+lax.cond — compiler-friendly structured control flow instead of the
+reference's subgraph-executor machinery.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+
+# These take Python callables over NDArray handles; used by mx.nd.contrib.*
+# wrappers in ndarray/__init__ (they are not tape ops — jax traces through).
+
+def foreach(body, data, init_states):
+    """reference `python/mxnet/ndarray/contrib.py` foreach →
+    `src/operator/contrib/control_flow.cc:1089`. Maps to lax.scan."""
+    from ..ndarray.ndarray import NDArray
+
+    single_data = isinstance(data, NDArray)
+    single_state = isinstance(init_states, NDArray)
+    data_t = [data] if single_data else list(data)
+    states = [init_states] if single_state else list(init_states)
+
+    def step(carry, xs):
+        nd_xs = [NDArray(x) for x in xs]
+        nd_carry = [NDArray(c) for c in carry]
+        out, new_states = body(nd_xs[0] if single_data else nd_xs,
+                               nd_carry[0] if single_state else nd_carry)
+        out_l = [out] if isinstance(out, NDArray) else list(out)
+        ns_l = [new_states] if isinstance(new_states, NDArray) else list(new_states)
+        return tuple(s._data for s in ns_l), tuple(o._data for o in out_l)
+
+    carry, ys = lax.scan(step, tuple(s._data for s in states),
+                         tuple(d._data for d in data_t))
+    outs = [NDArray(y) for y in ys]
+    final = [NDArray(c) for c in carry]
+    return (outs[0] if len(outs) == 1 else outs,
+            final[0] if single_state else final)
+
+
+def while_loop(cond, func, loop_vars, max_iterations=None):
+    """reference contrib while_loop → lax.while_loop (no max_iterations
+    unrolling needed; XLA handles dynamic trip count)."""
+    from ..ndarray.ndarray import NDArray
+    single = isinstance(loop_vars, NDArray)
+    lv = [loop_vars] if single else list(loop_vars)
+
+    def jcond(vals):
+        return cond(*[NDArray(v) for v in vals])._data.astype(bool).reshape(())
+
+    def jbody(vals):
+        res = func(*[NDArray(v) for v in vals])
+        res = [res] if isinstance(res, NDArray) else list(res)
+        return tuple(r._data for r in res)
+
+    out = lax.while_loop(jcond, jbody, tuple(v._data for v in lv))
+    outs = [NDArray(v) for v in out]
+    return outs[0] if single else outs
+
+
+def cond(pred, then_func, else_func, inputs=None):
+    """reference contrib cond → lax.cond."""
+    from ..ndarray.ndarray import NDArray
+    p = pred._data.astype(bool).reshape(()) if isinstance(pred, NDArray) else pred
+
+    def _norm(f):
+        def g(_):
+            res = f()
+            rl = [res] if isinstance(res, NDArray) else list(res)
+            return tuple(r._data for r in rl)
+        return g
+
+    out = lax.cond(p, _norm(then_func), _norm(else_func), operand=None)
+    outs = [NDArray(v) for v in out]
+    return outs[0] if len(outs) == 1 else outs
+
+
+@register("_contrib_arange_like", differentiable=False)
+def arange_like(data, start=0.0, step=1.0, repeat=1, axis=None):
+    if axis is None:
+        n = 1
+        for s in data.shape:
+            n *= s
+        out = start + step * jnp.arange(n, dtype=data.dtype)
+        return out.reshape(data.shape)
+    n = data.shape[axis]
+    return start + step * jnp.arange(n, dtype=data.dtype)
+
+
+@register("_contrib_div_sqrt_dim")
+def div_sqrt_dim(data):
+    import numpy as _np
+    return data / _np.sqrt(data.shape[-1]).astype(data.dtype)
+
+
+@register("smooth_l1")
+def smooth_l1(data, scalar=1.0):
+    s2 = scalar * scalar
+    return jnp.where(jnp.abs(data) < 1.0 / s2,
+                     0.5 * s2 * jnp.square(data),
+                     jnp.abs(data) - 0.5 / s2)
+
+
+@register("_contrib_boolean_mask", differentiable=False)
+def boolean_mask(data, index, axis=0):
+    # dynamic-shape op: TPU-unfriendly; eager-only fallback via host
+    idx = jnp.nonzero(index)[0]
+    return jnp.take(data, idx, axis=axis)

@@ -1,0 +1,883 @@
+"""Core tensor operators: elemwise, broadcast, reduce, shape, indexing.
+
+Role parity: reference ``src/operator/tensor/`` (~35K LoC of CPU+CUDA
+kernels: elemwise_binary_op*, broadcast_reduce_op*, matrix_op, indexing_op,
+init_op, ordering_op). TPU-native: each op is a one-liner lowering to
+jax.numpy / lax — XLA supplies kernels, fusion, and layout; gradients come
+from the tape + jax.vjp, so no FGradient registrations.
+
+MXNet op-name parity is kept via aliases (broadcast_add == add, etc. —
+in MXNet these are distinct registrations, e.g.
+`src/operator/tensor/elemwise_binary_broadcast_op_basic.cc`).
+"""
+from __future__ import annotations
+
+import numpy as _np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import dtype_np
+from .registry import register
+
+# ---------------------------------------------------------------- arithmetic
+
+
+@register("add", aliases=("broadcast_add", "broadcast_plus", "elemwise_add",
+                          "_plus", "_add"))
+def add(lhs, rhs):
+    return jnp.add(lhs, rhs)
+
+
+@register("subtract", aliases=("broadcast_sub", "broadcast_minus",
+                               "elemwise_sub", "_sub", "_minus"))
+def subtract(lhs, rhs):
+    return jnp.subtract(lhs, rhs)
+
+
+@register("multiply", aliases=("broadcast_mul", "elemwise_mul", "_mul"))
+def multiply(lhs, rhs):
+    return jnp.multiply(lhs, rhs)
+
+
+@register("divide", aliases=("broadcast_div", "elemwise_div", "_div"))
+def divide(lhs, rhs):
+    return jnp.divide(lhs, rhs)
+
+
+@register("mod", aliases=("broadcast_mod",))
+def mod(lhs, rhs):
+    return jnp.mod(lhs, rhs)
+
+
+@register("power", aliases=("broadcast_power", "_power"))
+def power(lhs, rhs):
+    return jnp.power(lhs, rhs)
+
+
+@register("maximum", aliases=("broadcast_maximum", "_maximum"))
+def maximum(lhs, rhs):
+    return jnp.maximum(lhs, rhs)
+
+
+@register("minimum", aliases=("broadcast_minimum", "_minimum"))
+def minimum(lhs, rhs):
+    return jnp.minimum(lhs, rhs)
+
+
+@register("hypot", aliases=("broadcast_hypot",))
+def hypot(lhs, rhs):
+    return jnp.hypot(lhs, rhs)
+
+
+@register("negative")
+def negative(x):
+    return jnp.negative(x)
+
+
+@register("reciprocal")
+def reciprocal(x):
+    return jnp.reciprocal(x)
+
+
+@register("abs")
+def abs(x):  # noqa: A001 - MXNet op name
+    return jnp.abs(x)
+
+
+@register("sign")
+def sign(x):
+    return jnp.sign(x)
+
+
+@register("round")
+def round(x):  # noqa: A001
+    return jnp.round(x)
+
+
+@register("rint")
+def rint(x):
+    return jnp.rint(x)
+
+
+@register("ceil")
+def ceil(x):
+    return jnp.ceil(x)
+
+
+@register("floor")
+def floor(x):
+    return jnp.floor(x)
+
+
+@register("trunc")
+def trunc(x):
+    return jnp.trunc(x)
+
+
+@register("fix")
+def fix(x):
+    return jnp.fix(x)
+
+
+@register("square")
+def square(x):
+    return jnp.square(x)
+
+
+@register("sqrt")
+def sqrt(x):
+    return jnp.sqrt(x)
+
+
+@register("rsqrt")
+def rsqrt(x):
+    return lax.rsqrt(x)
+
+
+@register("cbrt")
+def cbrt(x):
+    return jnp.cbrt(x)
+
+
+@register("rcbrt")
+def rcbrt(x):
+    return 1.0 / jnp.cbrt(x)
+
+
+@register("exp")
+def exp(x):
+    return jnp.exp(x)
+
+
+@register("log")
+def log(x):
+    return jnp.log(x)
+
+
+@register("log10")
+def log10(x):
+    return jnp.log10(x)
+
+
+@register("log2")
+def log2(x):
+    return jnp.log2(x)
+
+
+@register("log1p")
+def log1p(x):
+    return jnp.log1p(x)
+
+
+@register("expm1")
+def expm1(x):
+    return jnp.expm1(x)
+
+
+@register("gamma")
+def gamma(x):
+    return jnp.exp(jax.scipy.special.gammaln(x))
+
+
+@register("gammaln")
+def gammaln(x):
+    return jax.scipy.special.gammaln(x)
+
+
+@register("erf")
+def erf(x):
+    return jax.scipy.special.erf(x)
+
+
+@register("erfinv")
+def erfinv(x):
+    return jax.scipy.special.erfinv(x)
+
+
+@register("digamma")
+def digamma(x):
+    return jax.scipy.special.digamma(x)
+
+
+# trig
+for _name, _fn in [("sin", jnp.sin), ("cos", jnp.cos), ("tan", jnp.tan),
+                   ("arcsin", jnp.arcsin), ("arccos", jnp.arccos),
+                   ("arctan", jnp.arctan), ("sinh", jnp.sinh),
+                   ("cosh", jnp.cosh), ("tanh", jnp.tanh),
+                   ("arcsinh", jnp.arcsinh), ("arccosh", jnp.arccosh),
+                   ("arctanh", jnp.arctanh)]:
+    register(_name)(lambda x, _f=_fn: _f(x))
+
+
+@register("degrees")
+def degrees(x):
+    return jnp.degrees(x)
+
+
+@register("radians")
+def radians(x):
+    return jnp.radians(x)
+
+
+# scalar variants (MXNet registers _plus_scalar etc; our binary ops accept
+# scalars natively, but keep the names for generated-code parity)
+@register("_plus_scalar")
+def _plus_scalar(data, scalar=0.0):
+    return data + scalar
+
+
+@register("_minus_scalar")
+def _minus_scalar(data, scalar=0.0):
+    return data - scalar
+
+
+@register("_rminus_scalar")
+def _rminus_scalar(data, scalar=0.0):
+    return scalar - data
+
+
+@register("_mul_scalar")
+def _mul_scalar(data, scalar=1.0):
+    return data * scalar
+
+
+@register("_div_scalar")
+def _div_scalar(data, scalar=1.0):
+    return data / scalar
+
+
+@register("_rdiv_scalar")
+def _rdiv_scalar(data, scalar=1.0):
+    return scalar / data
+
+
+@register("_power_scalar")
+def _power_scalar(data, scalar=1.0):
+    return data ** scalar
+
+
+@register("_rpower_scalar")
+def _rpower_scalar(data, scalar=1.0):
+    return scalar ** data
+
+
+# ------------------------------------------------------------- comparisons
+
+
+@register("equal", aliases=("broadcast_equal", "_equal"))
+def equal(lhs, rhs):
+    return (jnp.equal(lhs, rhs)).astype(_res_dtype(lhs, rhs))
+
+
+def _res_dtype(lhs, rhs):
+    d = getattr(lhs, "dtype", None) or getattr(rhs, "dtype", None)
+    return d if d is not None and jnp.issubdtype(d, jnp.floating) else jnp.float32
+
+
+@register("not_equal", aliases=("broadcast_not_equal", "_not_equal"))
+def not_equal(lhs, rhs):
+    return (jnp.not_equal(lhs, rhs)).astype(_res_dtype(lhs, rhs))
+
+
+@register("greater", aliases=("broadcast_greater", "_greater"))
+def greater(lhs, rhs):
+    return (jnp.greater(lhs, rhs)).astype(_res_dtype(lhs, rhs))
+
+
+@register("greater_equal", aliases=("broadcast_greater_equal", "_greater_equal"))
+def greater_equal(lhs, rhs):
+    return (jnp.greater_equal(lhs, rhs)).astype(_res_dtype(lhs, rhs))
+
+
+@register("lesser", aliases=("broadcast_lesser", "_lesser"))
+def lesser(lhs, rhs):
+    return (jnp.less(lhs, rhs)).astype(_res_dtype(lhs, rhs))
+
+
+@register("lesser_equal", aliases=("broadcast_lesser_equal", "_lesser_equal"))
+def lesser_equal(lhs, rhs):
+    return (jnp.less_equal(lhs, rhs)).astype(_res_dtype(lhs, rhs))
+
+
+@register("logical_and", aliases=("broadcast_logical_and",))
+def logical_and(lhs, rhs):
+    return jnp.logical_and(lhs, rhs).astype(jnp.float32)
+
+
+@register("logical_or", aliases=("broadcast_logical_or",))
+def logical_or(lhs, rhs):
+    return jnp.logical_or(lhs, rhs).astype(jnp.float32)
+
+
+@register("logical_xor", aliases=("broadcast_logical_xor",))
+def logical_xor(lhs, rhs):
+    return jnp.logical_xor(lhs, rhs).astype(jnp.float32)
+
+
+@register("logical_not")
+def logical_not(x):
+    return jnp.logical_not(x).astype(jnp.float32)
+
+
+@register("isnan")
+def isnan(x):
+    return jnp.isnan(x).astype(jnp.float32)
+
+
+@register("isinf")
+def isinf(x):
+    return jnp.isinf(x).astype(jnp.float32)
+
+
+@register("isfinite")
+def isfinite(x):
+    return jnp.isfinite(x).astype(jnp.float32)
+
+
+@register("where")
+def where(condition, x, y):
+    return jnp.where(condition.astype(bool) if hasattr(condition, "astype")
+                     else condition, x, y)
+
+
+# ---------------------------------------------------------------- reductions
+
+
+def _norm_axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(axis)
+    return int(axis)
+
+
+@register("sum", aliases=("sum_axis",))
+def sum(data, axis=None, keepdims=False, exclude=False):  # noqa: A001
+    axis = _excl(_norm_axis(axis), data.ndim, exclude)
+    return jnp.sum(data, axis=axis, keepdims=keepdims)
+
+
+def _excl(axis, ndim, exclude):
+    if not exclude or axis is None:
+        return axis
+    axes = (axis,) if isinstance(axis, int) else axis
+    return tuple(i for i in range(ndim) if i not in axes)
+
+
+@register("mean")
+def mean(data, axis=None, keepdims=False, exclude=False):
+    axis = _excl(_norm_axis(axis), data.ndim, exclude)
+    return jnp.mean(data, axis=axis, keepdims=keepdims)
+
+
+@register("prod")
+def prod(data, axis=None, keepdims=False, exclude=False):
+    axis = _excl(_norm_axis(axis), data.ndim, exclude)
+    return jnp.prod(data, axis=axis, keepdims=keepdims)
+
+
+@register("nansum")
+def nansum(data, axis=None, keepdims=False):
+    return jnp.nansum(data, axis=_norm_axis(axis), keepdims=keepdims)
+
+
+@register("nanprod")
+def nanprod(data, axis=None, keepdims=False):
+    return jnp.nanprod(data, axis=_norm_axis(axis), keepdims=keepdims)
+
+
+@register("max", aliases=("max_axis",))
+def max(data, axis=None, keepdims=False, exclude=False):  # noqa: A001
+    axis = _excl(_norm_axis(axis), data.ndim, exclude)
+    return jnp.max(data, axis=axis, keepdims=keepdims)
+
+
+@register("min", aliases=("min_axis",))
+def min(data, axis=None, keepdims=False, exclude=False):  # noqa: A001
+    axis = _excl(_norm_axis(axis), data.ndim, exclude)
+    return jnp.min(data, axis=axis, keepdims=keepdims)
+
+
+@register("norm")
+def norm(data, ord=2, axis=None, keepdims=False):  # noqa: A002
+    if ord == 1:
+        return jnp.sum(jnp.abs(data), axis=_norm_axis(axis), keepdims=keepdims)
+    return jnp.sqrt(jnp.sum(jnp.square(data), axis=_norm_axis(axis),
+                            keepdims=keepdims))
+
+
+@register("argmax")
+def argmax(data, axis=None, keepdims=False):
+    out = jnp.argmax(data, axis=axis, keepdims=keepdims).astype(jnp.float32)
+    return out
+
+
+@register("argmin")
+def argmin(data, axis=None, keepdims=False):
+    return jnp.argmin(data, axis=axis, keepdims=keepdims).astype(jnp.float32)
+
+
+@register("argsort")
+def argsort(data, axis=-1, is_ascend=True, dtype="float32"):
+    idx = jnp.argsort(data, axis=axis)
+    if not is_ascend:
+        idx = jnp.flip(idx, axis=axis)
+    return idx.astype(dtype_np(dtype))
+
+
+@register("sort")
+def sort(data, axis=-1, is_ascend=True):
+    out = jnp.sort(data, axis=axis)
+    if not is_ascend:
+        out = jnp.flip(out, axis=axis)
+    return out
+
+
+@register("topk")
+def topk(data, axis=-1, k=1, ret_typ="indices", is_ascend=False, dtype="float32"):
+    if is_ascend:
+        data_for = -data
+    else:
+        data_for = data
+    if axis != -1 and axis != data.ndim - 1:
+        moved = jnp.moveaxis(data_for, axis, -1)
+    else:
+        moved = data_for
+    vals, idx = lax.top_k(moved, k)
+    if is_ascend:
+        vals = -vals
+    if axis != -1 and axis != data.ndim - 1:
+        vals = jnp.moveaxis(vals, -1, axis)
+        idx = jnp.moveaxis(idx, -1, axis)
+    if ret_typ == "value":
+        return vals
+    if ret_typ == "both":
+        return vals, idx.astype(dtype_np(dtype))
+    return idx.astype(dtype_np(dtype))
+
+
+@register("cumsum")
+def cumsum(data, axis=None, dtype=None):
+    out = jnp.cumsum(data, axis=axis)
+    return out.astype(dtype_np(dtype)) if dtype else out
+
+
+# ------------------------------------------------------------- shape manip
+
+
+@register("reshape", aliases=("Reshape",))
+def reshape(data, shape=None, reverse=False):
+    shape = _mx_reshape(tuple(data.shape), tuple(shape), reverse)
+    return jnp.reshape(data, shape)
+
+
+def _mx_reshape(src, spec, reverse=False):
+    """MXNet reshape spec: 0 copy dim, -1 infer, -2 copy rest, -3 merge two,
+    -4 split (reference `src/operator/tensor/matrix_op-inl.h` ReshapeShape)."""
+    if reverse:
+        src = src[::-1]
+        spec = spec[::-1]
+    out, i = [], 0
+    spec = list(spec)
+    j = 0
+    while j < len(spec):
+        s = spec[j]
+        if s == 0:
+            out.append(src[i]); i += 1
+        elif s == -1:
+            out.append(-1); i += 1
+        elif s == -2:
+            out.extend(src[i:]); i = len(src)
+        elif s == -3:
+            out.append(src[i] * src[i + 1]); i += 2
+        elif s == -4:
+            d1, d2 = spec[j + 1], spec[j + 2]
+            if d1 == -1:
+                d1 = src[i] // d2
+            if d2 == -1:
+                d2 = src[i] // d1
+            out.extend([d1, d2]); i += 1; j += 2
+        else:
+            out.append(int(s)); i += 1
+        j += 1
+    if reverse:
+        out = out[::-1]
+    return tuple(out)
+
+
+@register("transpose")
+def transpose(data, axes=None):
+    return jnp.transpose(data, axes=axes)
+
+
+@register("swapaxes", aliases=("SwapAxis",))
+def swapaxes(data, dim1=0, dim2=0):
+    return jnp.swapaxes(data, dim1, dim2)
+
+
+@register("expand_dims")
+def expand_dims(data, axis=0):
+    return jnp.expand_dims(data, axis)
+
+
+@register("squeeze")
+def squeeze(data, axis=None):
+    return jnp.squeeze(data, axis=axis)
+
+
+@register("Flatten", aliases=("flatten",))
+def Flatten(data):
+    return jnp.reshape(data, (data.shape[0], -1))
+
+
+@register("broadcast_to")
+def broadcast_to(data, shape=None):
+    shape = tuple(s if s != 0 else d for s, d in zip(shape, data.shape))
+    return jnp.broadcast_to(data, shape)
+
+
+@register("broadcast_axis", aliases=("broadcast_axes",))
+def broadcast_axis(data, axis=(), size=()):
+    axis = (axis,) if isinstance(axis, int) else tuple(axis)
+    size = (size,) if isinstance(size, int) else tuple(size)
+    shape = list(data.shape)
+    for a, s in zip(axis, size):
+        shape[a] = s
+    return jnp.broadcast_to(data, tuple(shape))
+
+
+@register("concat", aliases=("Concat",))
+def concat(*data, dim=1, num_args=None):
+    return jnp.concatenate(data, axis=dim)
+
+
+@register("stack")
+def stack(*data, axis=0, num_args=None):
+    return jnp.stack(data, axis=axis)
+
+
+@register("split", aliases=("SliceChannel",), n_out=0)
+def split(data, num_outputs=1, axis=1, squeeze_axis=False):
+    parts = jnp.split(data, num_outputs, axis=axis)
+    if squeeze_axis:
+        parts = [jnp.squeeze(p, axis=axis) for p in parts]
+    return tuple(parts)
+
+
+@register("slice", aliases=("crop",))
+def slice(data, begin=(), end=(), step=()):  # noqa: A001
+    import builtins
+    step = step or [None] * len(begin)
+    idx = tuple(builtins.slice(b, e, s) for b, e, s in zip(begin, end, step))
+    return data[idx]
+
+
+@register("slice_axis")
+def slice_axis(data, axis=0, begin=0, end=None):
+    import builtins
+    idx = [builtins.slice(None)] * data.ndim
+    idx[axis] = builtins.slice(begin, end)
+    return data[tuple(idx)]
+
+
+@register("slice_like")
+def slice_like(data, shape_like, axes=()):
+    import builtins
+    idx = [builtins.slice(None)] * data.ndim
+    axes = axes or builtins.range(data.ndim)
+    for a in axes:
+        idx[a] = builtins.slice(0, shape_like.shape[a])
+    return data[tuple(idx)]
+
+
+@register("tile")
+def tile(data, reps=()):
+    return jnp.tile(data, reps)
+
+
+@register("repeat")
+def repeat(data, repeats=1, axis=None):
+    return jnp.repeat(data, repeats, axis=axis)
+
+
+@register("pad", aliases=("Pad",))
+def pad(data, mode="constant", pad_width=(), constant_value=0.0):
+    pw = [(pad_width[2 * i], pad_width[2 * i + 1]) for i in range(len(pad_width) // 2)]
+    jmode = {"constant": "constant", "edge": "edge", "reflect": "reflect"}[mode]
+    if jmode == "constant":
+        return jnp.pad(data, pw, mode=jmode, constant_values=constant_value)
+    return jnp.pad(data, pw, mode=jmode)
+
+
+@register("flip", aliases=("reverse",))
+def flip(data, axis=()):
+    return jnp.flip(data, axis=axis)
+
+
+@register("roll")
+def roll(data, shift=0, axis=None):
+    return jnp.roll(data, shift, axis=axis)
+
+
+@register("depth_to_space")
+def depth_to_space(data, block_size=1):
+    b, c, h, w = data.shape
+    bs = block_size
+    x = jnp.reshape(data, (b, bs, bs, c // (bs * bs), h, w))
+    x = jnp.transpose(x, (0, 3, 4, 1, 5, 2))
+    return jnp.reshape(x, (b, c // (bs * bs), h * bs, w * bs))
+
+
+@register("space_to_depth")
+def space_to_depth(data, block_size=1):
+    b, c, h, w = data.shape
+    bs = block_size
+    x = jnp.reshape(data, (b, c, h // bs, bs, w // bs, bs))
+    x = jnp.transpose(x, (0, 3, 5, 1, 2, 4))
+    return jnp.reshape(x, (b, c * bs * bs, h // bs, w // bs))
+
+
+# ---------------------------------------------------------------- indexing
+
+
+@register("_index", differentiable=True)
+def _index(data, key=None):
+    return data[key]
+
+
+@register("take")
+def take(a, indices, axis=0, mode="clip"):
+    return jnp.take(a, indices.astype(jnp.int32), axis=axis,
+                    mode="clip" if mode == "clip" else "wrap")
+
+
+@register("pick")
+def pick(data, index, axis=-1, keepdims=False, mode="clip"):
+    idx = jnp.expand_dims(index.astype(jnp.int32), axis=axis)
+    out = jnp.take_along_axis(data, idx, axis=axis)
+    if not keepdims:
+        out = jnp.squeeze(out, axis=axis)
+    return out
+
+
+@register("gather_nd")
+def gather_nd(data, indices):
+    idx = tuple(indices.astype(jnp.int32))
+    return data[idx]
+
+
+@register("scatter_nd")
+def scatter_nd(data, indices, shape=None):
+    idx = tuple(indices.astype(jnp.int32))
+    out = jnp.zeros(shape, dtype=data.dtype)
+    return out.at[idx].set(data)
+
+
+@register("one_hot")
+def one_hot(indices, depth=1, on_value=1.0, off_value=0.0, dtype="float32"):
+    oh = jax.nn.one_hot(indices.astype(jnp.int32), depth, dtype=dtype_np(dtype))
+    return oh * (on_value - off_value) + off_value
+
+
+@register("SequenceMask", aliases=("sequence_mask",))
+def SequenceMask(data, sequence_length=None, use_sequence_length=False,
+                 value=0.0, axis=0):
+    if not use_sequence_length or sequence_length is None:
+        return data
+    maxlen = data.shape[axis]
+    steps = jnp.arange(maxlen)
+    bshape = [1] * data.ndim
+    bshape[axis] = maxlen
+    steps = steps.reshape(bshape)
+    batch_axis = 1 if axis == 0 else 0
+    lshape = [1] * data.ndim
+    lshape[batch_axis] = data.shape[batch_axis]
+    mask = steps < sequence_length.reshape(lshape)
+    return jnp.where(mask, data, value)
+
+
+@register("SequenceLast")
+def SequenceLast(data, sequence_length=None, use_sequence_length=False, axis=0):
+    if not use_sequence_length or sequence_length is None:
+        import builtins
+        idx = [builtins.slice(None)] * data.ndim
+        idx[axis] = -1
+        return data[tuple(idx)]
+    last = (sequence_length.astype(jnp.int32) - 1)
+    return jnp.take_along_axis(
+        data, last.reshape((1, -1) + (1,) * (data.ndim - 2)), axis=axis
+    ).squeeze(axis)
+
+
+@register("SequenceReverse")
+def SequenceReverse(data, sequence_length=None, use_sequence_length=False, axis=0):
+    if not use_sequence_length or sequence_length is None:
+        return jnp.flip(data, axis=axis)
+    T = data.shape[axis]
+    steps = jnp.arange(T)
+    sl = sequence_length.astype(jnp.int32)
+    rev = jnp.where(steps[None, :] < sl[:, None], sl[:, None] - 1 - steps[None, :],
+                    steps[None, :])  # (B, T)
+    rev = jnp.swapaxes(rev, 0, 1)  # (T, B)
+    rev = rev.reshape((T,) + rev.shape[1:2] + (1,) * (data.ndim - 2))
+    return jnp.take_along_axis(data, rev, axis=0)
+
+
+# ---------------------------------------------------------------- init-like
+
+
+@register("zeros_like")
+def zeros_like(data):
+    return jnp.zeros_like(data)
+
+
+@register("ones_like")
+def ones_like(data):
+    return jnp.ones_like(data)
+
+
+@register("full_like")
+def full_like(data, fill_value=0.0):
+    return jnp.full_like(data, fill_value)
+
+
+@register("shape_array", differentiable=False)
+def shape_array(data):
+    return jnp.asarray(_np.asarray(data.shape), dtype=jnp.int64)
+
+
+@register("size_array", differentiable=False)
+def size_array(data):
+    return jnp.asarray([int(_np.prod(data.shape))], dtype=jnp.int64)
+
+
+@register("cast", aliases=("Cast",))
+def cast(data, dtype="float32"):
+    return data.astype(dtype_np(dtype))
+
+
+@register("amp_cast")
+def amp_cast(data, dtype="float32"):
+    return data.astype(dtype_np(dtype))
+
+
+@register("amp_multicast", n_out=0)
+def amp_multicast(*data, num_outputs=1, cast_narrow=False):
+    dtypes = [d.dtype for d in data]
+    target = jnp.result_type(*dtypes) if not cast_narrow else dtypes[0]
+    return tuple(d.astype(target) for d in data)
+
+
+@register("identity", aliases=("_copy", "BlockGrad_identity"))
+def identity(data):
+    return data
+
+
+@register("stop_gradient", aliases=("BlockGrad",))
+def stop_gradient(data):
+    return lax.stop_gradient(data)
+
+
+@register("make_loss", aliases=("MakeLoss",))
+def make_loss(data, grad_scale=1.0, normalization="null", valid_thresh=0.0):
+    return data * grad_scale if grad_scale != 1.0 else data
+
+
+@register("clip")
+def clip(data, a_min=None, a_max=None):
+    return jnp.clip(data, a_min, a_max)
+
+
+# ---------------------------------------------------------------- linalg
+
+
+@register("dot")
+def dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    a = lhs.T if transpose_a else lhs
+    b = rhs.T if transpose_b else rhs
+    if a.ndim == 1 and b.ndim == 1:
+        return jnp.dot(a, b)
+    # MXNet dot: contract last axis of a with first axis of b
+    return jnp.tensordot(a, b, axes=([-1], [0]))
+
+
+@register("batch_dot")
+def batch_dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    a = jnp.swapaxes(lhs, -1, -2) if transpose_a else lhs
+    b = jnp.swapaxes(rhs, -1, -2) if transpose_b else rhs
+    return jnp.matmul(a, b)
+
+
+@register("matmul")
+def matmul(lhs, rhs):
+    return jnp.matmul(lhs, rhs)
+
+
+@register("linalg_gemm2")
+def linalg_gemm2(A, B, transpose_a=False, transpose_b=False, alpha=1.0, axis=-3):
+    a = jnp.swapaxes(A, -1, -2) if transpose_a else A
+    b = jnp.swapaxes(B, -1, -2) if transpose_b else B
+    return alpha * jnp.matmul(a, b)
+
+
+@register("linalg_gemm")
+def linalg_gemm(A, B, C, transpose_a=False, transpose_b=False, alpha=1.0,
+                beta=1.0, axis=-3):
+    return linalg_gemm2.fn(A, B, transpose_a, transpose_b, alpha) + beta * C
+
+
+@register("linalg_potrf")
+def linalg_potrf(A):
+    return jnp.linalg.cholesky(A)
+
+
+@register("linalg_syrk")
+def linalg_syrk(A, transpose=False, alpha=1.0):
+    if transpose:
+        return alpha * jnp.matmul(jnp.swapaxes(A, -1, -2), A)
+    return alpha * jnp.matmul(A, jnp.swapaxes(A, -1, -2))
+
+
+@register("linalg_trsm")
+def linalg_trsm(A, B, transpose=False, rightside=False, lower=True, alpha=1.0):
+    import jax.scipy.linalg as jsl
+    a = jnp.swapaxes(A, -1, -2) if transpose else A
+    if rightside:
+        x = jsl.solve_triangular(jnp.swapaxes(a, -1, -2),
+                                 jnp.swapaxes(alpha * B, -1, -2),
+                                 lower=not lower)
+        return jnp.swapaxes(x, -1, -2)
+    return jsl.solve_triangular(a, alpha * B, lower=lower)
+
+
+@register("linalg_sumlogdiag")
+def linalg_sumlogdiag(A):
+    return jnp.sum(jnp.log(jnp.diagonal(A, axis1=-2, axis2=-1)), axis=-1)
+
+
+@register("linalg_extractdiag")
+def linalg_extractdiag(A, offset=0):
+    return jnp.diagonal(A, offset=offset, axis1=-2, axis2=-1)
+
+
+@register("khatri_rao")
+def khatri_rao(*args):
+    out = args[0]
+    for m in args[1:]:
+        out = jnp.einsum("i...,j...->ij...", out, m).reshape(
+            (-1,) + out.shape[1:])
+    return out
+
+
+@register("diag")
+def diag(data, k=0):
+    if data.ndim == 1:
+        return jnp.diag(data, k=k)
+    return jnp.diagonal(data, offset=k, axis1=-2, axis2=-1)
+
+
+@register("embedding", aliases=("Embedding",))
+def embedding(data, weight, input_dim=None, output_dim=None, dtype=None,
+              sparse_grad=False):
+    return jnp.take(weight, data.astype(jnp.int32), axis=0)

@@ -1,0 +1,145 @@
+"""Operator registry and eager/traced dispatch.
+
+Role parity: the nnvm op registry + attr functors
+(reference `include/mxnet/op_attr_types.h:217-331`: FCompute, FInferShape...)
+and the imperative dispatch path (`src/imperative/imperative.cc:89` Invoke →
+`imperative_utils.h:395` PushFCompute → Engine::PushAsync).
+
+TPU-native design: an op is ONE pure JAX function. Shape/type inference,
+kernel selection, fusion, and async scheduling are all delegated to
+XLA — eager calls dispatch asynchronously via JAX (the role of the reference
+dependency engine `src/engine/threaded_engine.h:282` is played by XLA's
+program order + JAX async dispatch), and the same function is traceable under
+``jax.jit`` so hybridized graphs compile to a single HLO module (the role of
+CachedOp `src/imperative/cached_op.cc:1023`).
+
+Gradients come from ``jax.vjp`` over the recorded tape — no per-op backward
+registration (the role of nnvm's FGradient) is needed.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable, Dict, Optional
+
+from .. import _tape
+
+__all__ = ["Op", "register", "get_op", "list_ops", "invoke", "wrap_out"]
+
+_OP_REGISTRY: Dict[str, "Op"] = {}
+
+
+class Op:
+    """Registered operator: a named pure JAX function with metadata.
+
+    ``state_binders`` maps kwarg names to zero-arg callables resolved at
+    *invoke* time (not at replay/backward time): RNG keys and the
+    train-mode flag are captured into the recorded kwargs so tape replay
+    is deterministic — the reference gets the same property from stateful
+    cuDNN dropout descriptors held by the op state
+    (`src/operator/nn/dropout-inl.h`)."""
+    __slots__ = ("name", "fn", "n_out", "aliases", "doc", "namespace",
+                 "differentiable", "state_binders")
+
+    def __init__(self, name, fn, n_out=1, aliases=(), doc=None,
+                 namespace="nd", differentiable=True, state_binders=None):
+        self.name = name
+        self.fn = fn
+        self.n_out = n_out
+        self.aliases = aliases
+        self.doc = doc or fn.__doc__
+        self.namespace = namespace
+        self.differentiable = differentiable
+        self.state_binders = state_binders or {}
+
+    def __call__(self, *args, **kwargs):
+        return invoke(self, *args, **kwargs)
+
+    def __repr__(self):
+        return "<Op %s>" % self.name
+
+
+def register(name=None, n_out=1, aliases=(), namespace="nd",
+             differentiable=True, state_binders=None):
+    """Decorator: register a pure JAX function as a framework op."""
+    def deco(fn):
+        opname = name or fn.__name__
+        op = Op(opname, fn, n_out=n_out, aliases=aliases,
+                namespace=namespace, differentiable=differentiable,
+                state_binders=state_binders)
+        _OP_REGISTRY[opname] = op
+        for a in aliases:
+            _OP_REGISTRY[a] = op
+        return op
+    return deco
+
+
+def get_op(name: str) -> Optional[Op]:
+    return _OP_REGISTRY.get(name)
+
+
+def list_ops():
+    """Parity with MXListAllOpNames (reference `src/c_api/c_api.cc`)."""
+    return sorted(_OP_REGISTRY.keys())
+
+
+def wrap_out(val, like=None):
+    """Wrap a raw jax value into an NDArray in the current context."""
+    from ..ndarray.ndarray import NDArray
+    ctx = like.ctx if like is not None else None
+    return NDArray(val, ctx=ctx)
+
+
+def invoke(op: Op, *args, out=None, **kwargs):
+    """Eager-dispatch an op: unwrap handles → pure fn → wrap → record.
+
+    Under jax tracing (inside CachedOp/jit) the same path runs with tracers
+    in ``_data`` — no separate symbolic executor is needed.
+    """
+    from ..ndarray.ndarray import NDArray
+
+    vals = []
+    nd_inputs = []
+    parents = []
+    for a in args:
+        if isinstance(a, NDArray):
+            vals.append(a._data)
+            nd_inputs.append(a)
+            node = a._ag_node
+            if node is None:
+                parents.append(_tape.Const(a._data))
+            else:
+                parents.append(node if isinstance(node, tuple) else (node, 0))
+        else:
+            vals.append(a)
+            parents.append(_tape.Const(a))
+
+    for kname, binder in op.state_binders.items():
+        if kname not in kwargs:
+            kwargs[kname] = binder()
+
+    out_vals = op.fn(*vals, **kwargs)
+    multi = isinstance(out_vals, tuple)
+    outs = out_vals if multi else (out_vals,)
+
+    recording = op.differentiable and _tape.is_recording()
+
+    node = None
+    if recording:
+        node = _tape.OpNode(op.fn, parents, len(outs), dict(kwargs), op.name)
+
+    results = []
+    out_list = out if isinstance(out, (list, tuple)) else ([out] if out is not None else None)
+    for i, v in enumerate(outs):
+        if out_list is not None and i < len(out_list) and out_list[i] is not None:
+            tgt = out_list[i]
+            tgt._data = v
+            tgt._ag_node = (node, i) if node is not None else None
+            results.append(tgt)
+        else:
+            arr = NDArray(v, ctx=nd_inputs[0].ctx if nd_inputs else None)
+            if node is not None:
+                arr._ag_node = (node, i)
+            results.append(arr)
+    if multi:
+        return tuple(results)
+    return results[0]

@@ -1,0 +1,168 @@
+"""Random number service: MXNet's stateful RNG semantics over JAX keys.
+
+Role parity: reference ``src/resource.cc`` RNG resources (kRandom/kParallelRandom,
+`src/resource.cc:132-151`), ``mx.random.seed`` (`python/mxnet/random.py`), and
+the sampler ops (`src/operator/random/`).
+
+TPU-native design: a thread-local splitting key. Eager calls split a global
+key (stateful, like the reference's per-device Random<xpu> resource). Under
+jit tracing (CachedOp), a *traced* base key is installed by the compiled
+callable and splits happen on the tracer — so every execution of a compiled
+graph gets fresh randomness, while the trace stays pure. This replaces the
+reference's cuDNN dropout-state resource machinery.
+"""
+from __future__ import annotations
+
+import threading
+
+import numpy as _np
+import jax
+import jax.numpy as jnp
+
+from .base import dtype_np
+
+__all__ = ["seed", "next_key", "uniform", "normal", "randn", "randint",
+           "gamma", "exponential", "poisson", "negative_binomial",
+           "generalized_negative_binomial", "multinomial", "shuffle",
+           "bernoulli", "push_trace_key", "pop_trace_key"]
+
+_state = threading.local()
+
+
+def _global():
+    if not hasattr(_state, "key"):
+        _state.key = jax.random.PRNGKey(_np.random.randint(0, 2**31 - 1))
+    return _state
+
+
+def seed(seed_state, ctx="all"):
+    """Parity with mx.random.seed (reference `python/mxnet/random.py:38`)."""
+    _global().key = jax.random.PRNGKey(int(seed_state))
+    _np.random.seed(int(seed_state) % (2**32))
+
+
+def push_trace_key(key):
+    """Install a traced base key for the duration of a jit trace."""
+    st = _global()
+    if not hasattr(st, "trace_stack"):
+        st.trace_stack = []
+    st.trace_stack.append(key)
+
+
+def pop_trace_key():
+    _global().trace_stack.pop()
+
+
+def next_key():
+    """Split off a fresh key — from the traced base when tracing, else from
+    the global stateful key."""
+    st = _global()
+    stack = getattr(st, "trace_stack", None)
+    if stack:
+        stack[-1], sub = jax.random.split(stack[-1])
+        return sub
+    st.key, sub = jax.random.split(st.key)
+    return sub
+
+
+def _wrap(val, ctx=None, out=None):
+    from .ndarray.ndarray import NDArray
+    if out is not None:
+        out._data = val
+        out._ag_node = None
+        return out
+    return NDArray(val, ctx=ctx)
+
+
+def _shape(shape):
+    if shape is None:
+        return ()
+    if isinstance(shape, int):
+        return (shape,)
+    return tuple(shape)
+
+
+def uniform(low=0.0, high=1.0, shape=None, dtype="float32", ctx=None, out=None, **kw):
+    v = jax.random.uniform(next_key(), _shape(shape), dtype=dtype_np(dtype),
+                           minval=low, maxval=high)
+    return _wrap(v, ctx, out)
+
+
+def normal(loc=0.0, scale=1.0, shape=None, dtype="float32", ctx=None, out=None, **kw):
+    v = loc + scale * jax.random.normal(next_key(), _shape(shape),
+                                        dtype=dtype_np(dtype))
+    return _wrap(v, ctx, out)
+
+
+def randn(*shape, loc=0.0, scale=1.0, dtype="float32", ctx=None, **kw):
+    return normal(loc, scale, shape, dtype=dtype, ctx=ctx)
+
+
+def randint(low, high=None, shape=(1,), dtype="int32", ctx=None, out=None, **kw):
+    if high is None:
+        low, high = 0, low
+    v = jax.random.randint(next_key(), _shape(shape), low, high,
+                           dtype=dtype_np(dtype))
+    return _wrap(v, ctx, out)
+
+
+def gamma(alpha=1.0, beta=1.0, shape=None, dtype="float32", ctx=None, out=None, **kw):
+    v = jax.random.gamma(next_key(), alpha, _shape(shape),
+                         dtype=dtype_np(dtype)) * beta
+    return _wrap(v, ctx, out)
+
+
+def exponential(scale=1.0, shape=None, dtype="float32", ctx=None, out=None, **kw):
+    v = jax.random.exponential(next_key(), _shape(shape),
+                               dtype=dtype_np(dtype)) * scale
+    return _wrap(v, ctx, out)
+
+
+def poisson(lam=1.0, shape=None, dtype="float32", ctx=None, out=None, **kw):
+    v = jax.random.poisson(next_key(), lam, _shape(shape)).astype(dtype_np(dtype))
+    return _wrap(v, ctx, out)
+
+
+def negative_binomial(k=1, p=1.0, shape=None, dtype="float32", ctx=None,
+                      out=None, **kw):
+    g = jax.random.gamma(next_key(), k, _shape(shape)) * ((1 - p) / p)
+    v = jax.random.poisson(next_key(), g).astype(dtype_np(dtype))
+    return _wrap(v, ctx, out)
+
+
+def generalized_negative_binomial(mu=1.0, alpha=1.0, shape=None,
+                                  dtype="float32", ctx=None, out=None, **kw):
+    k = 1.0 / alpha
+    p = k / (k + mu)
+    return negative_binomial(k=k, p=p, shape=shape, dtype=dtype, ctx=ctx, out=out)
+
+
+def multinomial(data, shape=(), get_prob=False, dtype="int32", **kw):
+    from .ndarray.ndarray import NDArray
+    probs = data._data if isinstance(data, NDArray) else jnp.asarray(data)
+    n = int(_np.prod(_shape(shape))) if shape else 1
+    logits = jnp.log(jnp.maximum(probs, 1e-37))
+    if probs.ndim == 1:
+        samp = jax.random.categorical(next_key(), logits, shape=(n,))
+        samp = samp.reshape(_shape(shape) or ())
+    else:
+        samp = jax.random.categorical(next_key(), logits[:, None, :].repeat(n, 1),
+                                      axis=-1)
+        samp = samp.reshape((probs.shape[0],) + (_shape(shape) or ()))
+    out = _wrap(samp.astype(dtype_np(dtype)), None, None)
+    if get_prob:
+        lp = jnp.take_along_axis(logits, samp.reshape(logits.shape[:-1] + (-1,)).astype(jnp.int32), axis=-1)
+        return out, _wrap(lp.reshape(samp.shape), None, None)
+    return out
+
+
+def bernoulli(prob=0.5, shape=None, dtype="float32", ctx=None, out=None, **kw):
+    v = jax.random.bernoulli(next_key(), prob, _shape(shape)).astype(dtype_np(dtype))
+    return _wrap(v, ctx, out)
+
+
+def shuffle(data, **kw):
+    from .ndarray.ndarray import NDArray
+    v = data._data if isinstance(data, NDArray) else jnp.asarray(data)
+    perm = jax.random.permutation(next_key(), v.shape[0])
+    return _wrap(jnp.take(v, perm, axis=0), getattr(data, "_ctx", None), None)

@@ -1,0 +1,34 @@
+"""Test harness: run the whole suite on a virtual 8-device CPU mesh.
+
+Mirrors the reference's device-retargeting test pattern
+(`tests/python/unittest/common.py` + `mx.test_utils.default_context()`):
+one suite, device chosen by environment. XLA-CPU is the oracle; the driver
+separately exercises the real TPU chip.
+
+NOTE: platform selection must go through jax.config.update — in this image a
+PJRT plugin for the TPU tunnel is registered at interpreter startup and has
+already captured JAX_PLATFORMS, so mutating os.environ in conftest is too
+late. XLA_FLAGS is still read lazily at first backend init, so setting it
+here (before any jax computation) works.
+"""
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seed_everything():
+    import mxnet_tpu as mx
+    np.random.seed(0)
+    mx.random.seed(0)
+    yield

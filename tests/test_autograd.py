@@ -1,0 +1,184 @@
+"""Autograd semantics tests (model: reference tests/python/unittest/test_autograd.py)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd as ag
+
+
+def test_simple_grad():
+    x = nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with ag.record():
+        y = (x * x).sum()
+    y.backward()
+    assert np.allclose(x.grad.asnumpy(), [2, 4, 6])
+
+
+def test_chain():
+    x = nd.array([[1.0, 2.0], [3.0, 4.0]])
+    x.attach_grad()
+    with ag.record():
+        y = nd.exp(nd.log(x) * 2.0)  # x^2
+        z = y.sum()
+    z.backward()
+    assert np.allclose(x.grad.asnumpy(), 2 * x.asnumpy(), rtol=1e-5)
+
+
+def test_multi_variable():
+    a = nd.array([2.0])
+    b = nd.array([3.0])
+    a.attach_grad()
+    b.attach_grad()
+    with ag.record():
+        c = a * b + a
+    c.backward()
+    assert np.allclose(a.grad.asnumpy(), [4.0])  # b + 1
+    assert np.allclose(b.grad.asnumpy(), [2.0])  # a
+
+
+def test_head_gradient():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with ag.record():
+        y = x * 3.0
+    y.backward(nd.array([10.0, 100.0]))
+    assert np.allclose(x.grad.asnumpy(), [30.0, 300.0])
+
+
+def test_grad_req_add():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad(grad_req="add")
+    for _ in range(3):
+        with ag.record():
+            y = (x * x).sum()
+        y.backward()
+    assert np.allclose(x.grad.asnumpy(), 3 * 2 * x.asnumpy())
+
+
+def test_grad_req_null():
+    x = nd.array([1.0])
+    x.attach_grad(grad_req="null")
+    with ag.record():
+        y = x * 2
+    y.backward()
+    assert np.allclose(x.grad.asnumpy(), [0.0])
+
+
+def test_pause():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with ag.record():
+        y = x * 2
+        with ag.pause():
+            z = y * 3  # not recorded
+        w = y.sum()
+    w.backward()
+    assert np.allclose(x.grad.asnumpy(), [2.0, 2.0])
+
+
+def test_is_recording_training():
+    assert not ag.is_recording()
+    with ag.record():
+        assert ag.is_recording()
+        assert ag.is_training()
+    with ag.record(train_mode=False):
+        assert ag.is_recording()
+        assert not ag.is_training()
+    with ag.train_mode():
+        assert ag.is_training()
+
+
+def test_detach():
+    x = nd.array([1.0])
+    x.attach_grad()
+    with ag.record():
+        y = x * 2
+        z = y.detach() * x
+    z.backward()
+    # dz/dx = y.detach() = 2 (no flow through y)
+    assert np.allclose(x.grad.asnumpy(), [2.0])
+
+
+def test_stop_gradient():
+    x = nd.array([3.0])
+    x.attach_grad()
+    with ag.record():
+        y = nd.stop_gradient(x * 2) * x
+    y.backward()
+    assert np.allclose(x.grad.asnumpy(), [6.0])
+
+
+def test_functional_grad():
+    x = nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with ag.record():
+        y = (x ** 3).sum()
+        gx, = ag.grad(y, x)
+    assert np.allclose(gx.asnumpy(), 3 * x.asnumpy() ** 2)
+
+
+def test_higher_order():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with ag.record():
+        y = (x ** 3).sum()
+        gx, = ag.grad(y, x, create_graph=True)
+        z = gx.sum()
+    z.backward()
+    # d2y/dx2 = 6x = 12
+    assert np.allclose(x.grad.asnumpy(), [12.0])
+
+
+def test_mark_variables():
+    x = nd.array([5.0])
+    g = nd.zeros((1,))
+    ag.mark_variables([x], [g])
+    with ag.record():
+        y = x * x
+    y.backward()
+    assert np.allclose(g.asnumpy(), [10.0])
+    assert x.grad is g
+
+
+def test_backward_through_reshape_and_reduce():
+    x = nd.array(np.arange(6, dtype=np.float32).reshape(2, 3))
+    x.attach_grad()
+    with ag.record():
+        y = x.reshape((3, 2)).transpose()
+        z = (y * y).mean()
+    z.backward()
+    assert np.allclose(x.grad.asnumpy(), 2 * x.asnumpy() / 6, rtol=1e-5)
+
+
+def test_custom_function():
+    class Sigmoid(ag.Function):
+        def forward(self, x):
+            y = nd.sigmoid(x)
+            self.save_for_backward(y)
+            return y
+
+        def backward(self, dy):
+            y, = self.saved_tensors
+            return dy * y * (1 - y)
+
+    x = nd.array([0.0, 1.0])
+    x.attach_grad()
+    f = Sigmoid()
+    with ag.record():
+        y = f(x)
+        z = y.sum()
+    z.backward()
+    s = 1 / (1 + np.exp(-x.asnumpy()))
+    assert np.allclose(x.grad.asnumpy(), s * (1 - s), rtol=1e-5)
+
+
+def test_dropout_grad():
+    x = nd.ones((100,))
+    x.attach_grad()
+    with ag.record():
+        y = nd.Dropout(x, p=0.5)
+        z = y.sum()
+    z.backward()
+    g = x.grad.asnumpy()
+    # grads are 0 or 2 (1/keep_prob)
+    assert set(np.unique(g)).issubset({0.0, 2.0})

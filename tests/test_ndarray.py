@@ -1,0 +1,195 @@
+"""NDArray semantics tests (model: reference tests/python/unittest/test_ndarray.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def test_creation():
+    a = nd.zeros((3, 4))
+    assert a.shape == (3, 4)
+    assert a.dtype == np.float32
+    assert np.allclose(a.asnumpy(), 0)
+    b = nd.ones((2, 2), dtype="int32")
+    assert b.dtype == np.int32
+    c = nd.full((2, 3), 7.5)
+    assert np.allclose(c.asnumpy(), 7.5)
+    d = nd.array([[1, 2], [3, 4]])
+    assert d.shape == (2, 2)
+    e = nd.arange(0, 10, 2)
+    assert np.allclose(e.asnumpy(), [0, 2, 4, 6, 8])
+
+
+def test_arithmetic():
+    a = nd.array([[1.0, 2.0], [3.0, 4.0]])
+    b = nd.array([[5.0, 6.0], [7.0, 8.0]])
+    assert np.allclose((a + b).asnumpy(), [[6, 8], [10, 12]])
+    assert np.allclose((a - b).asnumpy(), [[-4, -4], [-4, -4]])
+    assert np.allclose((a * b).asnumpy(), [[5, 12], [21, 32]])
+    assert np.allclose((b / a).asnumpy(), [[5, 3], [7 / 3, 2]])
+    assert np.allclose((a + 1).asnumpy(), [[2, 3], [4, 5]])
+    assert np.allclose((1 - a).asnumpy(), [[0, -1], [-2, -3]])
+    assert np.allclose((a ** 2).asnumpy(), [[1, 4], [9, 16]])
+    assert np.allclose((-a).asnumpy(), [[-1, -2], [-3, -4]])
+
+
+def test_inplace():
+    a = nd.ones((2, 2))
+    a += 2
+    assert np.allclose(a.asnumpy(), 3)
+    a *= 2
+    assert np.allclose(a.asnumpy(), 6)
+    a[:] = 5
+    assert np.allclose(a.asnumpy(), 5)
+
+
+def test_indexing():
+    a = nd.array(np.arange(24).reshape(2, 3, 4))
+    assert np.allclose(a[0].asnumpy(), np.arange(12).reshape(3, 4))
+    assert np.allclose(a[1, 2].asnumpy(), [20, 21, 22, 23])
+    assert np.allclose(a[:, 1:3].asnumpy(),
+                       np.arange(24).reshape(2, 3, 4)[:, 1:3])
+    a[0, 0] = 99
+    assert np.allclose(a.asnumpy()[0, 0], 99)
+
+
+def test_reshape_transpose():
+    a = nd.array(np.arange(24).reshape(2, 3, 4))
+    assert a.reshape((6, 4)).shape == (6, 4)
+    assert a.reshape((-1, 4)).shape == (6, 4)
+    assert a.reshape((0, -1)).shape == (2, 12)  # MXNet 0 = copy dim
+    assert a.transpose().shape == (4, 3, 2)
+    assert a.transpose((1, 0, 2)).shape == (3, 2, 4)
+    assert a.swapaxes(0, 2).shape == (4, 3, 2)
+    assert a.expand_dims(1).shape == (2, 1, 3, 4)
+    assert a.flatten().shape == (2, 12)
+
+
+def test_reductions():
+    x = np.random.rand(3, 4, 5).astype(np.float32)
+    a = nd.array(x)
+    assert np.allclose(a.sum().asnumpy(), x.sum(), rtol=1e-5)
+    assert np.allclose(a.mean(axis=1).asnumpy(), x.mean(axis=1), rtol=1e-5)
+    assert np.allclose(a.max(axis=(0, 2)).asnumpy(), x.max(axis=(0, 2)))
+    assert np.allclose(a.min().asnumpy(), x.min())
+    assert np.allclose(a.norm().asnumpy(), np.linalg.norm(x.ravel()), rtol=1e-5)
+    assert np.allclose(a.argmax(axis=1).asnumpy(), x.argmax(axis=1))
+
+
+def test_dtype_cast():
+    a = nd.ones((2, 2), dtype="float32")
+    b = a.astype("int32")
+    assert b.dtype == np.int32
+    c = a.astype("float16")
+    assert c.dtype == np.float16
+    d = nd.cast(a, dtype="int64")
+    assert d.dtype == np.int64
+
+
+def test_scalar_conversion():
+    a = nd.array([3.5])
+    assert a.asscalar() == pytest.approx(3.5)
+    assert float(a) == pytest.approx(3.5)
+    assert int(nd.array([7])) == 7
+
+
+def test_broadcast():
+    a = nd.ones((1, 3))
+    b = a.broadcast_to((4, 3))
+    assert b.shape == (4, 3)
+    c = nd.broadcast_axis(nd.ones((1, 3, 1)), axis=(0, 2), size=(2, 4))
+    assert c.shape == (2, 3, 4)
+
+
+def test_concat_stack_split():
+    a = nd.ones((2, 3))
+    b = nd.zeros((2, 3))
+    c = nd.concat(a, b, dim=0)
+    assert c.shape == (4, 3)
+    d = nd.stack(a, b, axis=0)
+    assert d.shape == (2, 2, 3)
+    parts = nd.split(nd.array(np.arange(12).reshape(4, 3)), num_outputs=2, axis=0)
+    assert len(parts) == 2 and parts[0].shape == (2, 3)
+
+
+def test_save_load(tmp_path):
+    fname = str(tmp_path / "arrs")
+    a = nd.array([[1, 2], [3, 4]])
+    b = nd.ones((3,))
+    nd.save(fname, {"a": a, "b": b})
+    loaded = nd.load(fname)
+    assert np.allclose(loaded["a"].asnumpy(), a.asnumpy())
+    assert np.allclose(loaded["b"].asnumpy(), b.asnumpy())
+    nd.save(fname + "_l", [a, b])
+    ll = nd.load(fname + "_l")
+    assert isinstance(ll, list) and np.allclose(ll[0].asnumpy(), a.asnumpy())
+
+
+def test_context():
+    a = nd.ones((2, 2), ctx=mx.cpu())
+    assert a.ctx.device_type == "cpu"
+    b = a.as_in_context(mx.cpu(0))
+    assert b.ctx == mx.cpu(0)
+    a.wait_to_read()
+    nd.waitall()
+
+
+def test_copyto():
+    a = nd.ones((2, 2))
+    b = nd.zeros((2, 2))
+    a.copyto(b)
+    assert np.allclose(b.asnumpy(), 1)
+
+
+def test_take_pick_onehot():
+    w = nd.array(np.arange(12).reshape(4, 3))
+    idx = nd.array([0, 2])
+    t = nd.take(w, idx, axis=0)
+    assert np.allclose(t.asnumpy(), [[0, 1, 2], [6, 7, 8]])
+    data = nd.array([[1., 2.], [3., 4.]])
+    p = nd.pick(data, nd.array([0, 1]), axis=1)
+    assert np.allclose(p.asnumpy(), [1, 4])
+    oh = nd.one_hot(nd.array([1, 0]), depth=3)
+    assert np.allclose(oh.asnumpy(), [[0, 1, 0], [1, 0, 0]])
+
+
+def test_comparison_ops():
+    a = nd.array([1.0, 2.0, 3.0])
+    b = nd.array([2.0, 2.0, 2.0])
+    assert np.allclose((a > b).asnumpy(), [0, 0, 1])
+    assert np.allclose((a == b).asnumpy(), [0, 1, 0])
+    assert np.allclose((a <= b).asnumpy(), [1, 1, 0])
+
+
+def test_dot():
+    a = nd.array(np.random.rand(3, 4).astype(np.float32))
+    b = nd.array(np.random.rand(4, 5).astype(np.float32))
+    c = nd.dot(a, b)
+    assert np.allclose(c.asnumpy(), a.asnumpy() @ b.asnumpy(), rtol=1e-4)
+    # batch_dot
+    x = nd.array(np.random.rand(2, 3, 4).astype(np.float32))
+    y = nd.array(np.random.rand(2, 4, 5).astype(np.float32))
+    z = nd.batch_dot(x, y)
+    assert np.allclose(z.asnumpy(), x.asnumpy() @ y.asnumpy(), rtol=1e-4)
+
+
+def test_sparse_api():
+    from mxnet_tpu.ndarray import sparse
+    dense = np.array([[0, 1, 0], [2, 0, 3]], dtype=np.float32)
+    rs = nd.array(dense).tostype("row_sparse")
+    assert rs.stype == "row_sparse"
+    assert np.allclose(rs.asnumpy(), dense)
+    back = rs.tostype("default")
+    assert back.stype == "default"
+    csr = nd.array(dense).tostype("csr")
+    assert csr.stype == "csr"
+    assert np.allclose(csr.asnumpy(), dense)
+    z = sparse.zeros("row_sparse", (3, 4))
+    assert z.shape == (3, 4)
+
+
+def test_ndarray_repr_len_iter():
+    a = nd.array([[1, 2], [3, 4]])
+    assert len(a) == 2
+    assert "NDArray" in repr(a)

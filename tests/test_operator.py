@@ -1,0 +1,245 @@
+"""Operator correctness vs NumPy (model: reference tests/python/unittest/test_operator.py).
+
+Includes finite-difference gradient checks via mxnet_tpu.test_utils
+(reference `python/mxnet/test_utils.py:981` check_numeric_gradient — here the
+oracle is jax.vjp vs central differences)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd as ag
+
+
+def test_fully_connected():
+    x = np.random.rand(4, 10).astype(np.float32)
+    w = np.random.rand(5, 10).astype(np.float32)
+    b = np.random.rand(5).astype(np.float32)
+    out = nd.FullyConnected(nd.array(x), nd.array(w), nd.array(b), num_hidden=5)
+    assert np.allclose(out.asnumpy(), x @ w.T + b, rtol=1e-4)
+    out2 = nd.FullyConnected(nd.array(x), nd.array(w), no_bias=True, num_hidden=5)
+    assert np.allclose(out2.asnumpy(), x @ w.T, rtol=1e-4)
+
+
+def test_convolution_shapes():
+    x = nd.random.uniform(shape=(2, 3, 8, 8))
+    w = nd.random.uniform(shape=(4, 3, 3, 3))
+    b = nd.zeros((4,))
+    y = nd.Convolution(x, w, b, kernel=(3, 3), num_filter=4)
+    assert y.shape == (2, 4, 6, 6)
+    y = nd.Convolution(x, w, b, kernel=(3, 3), num_filter=4, pad=(1, 1))
+    assert y.shape == (2, 4, 8, 8)
+    y = nd.Convolution(x, w, b, kernel=(3, 3), num_filter=4, stride=(2, 2),
+                       pad=(1, 1))
+    assert y.shape == (2, 4, 4, 4)
+
+
+def test_convolution_vs_numpy():
+    # 1x1 conv == matmul over channels
+    x = np.random.rand(2, 3, 4, 4).astype(np.float32)
+    w = np.random.rand(5, 3, 1, 1).astype(np.float32)
+    y = nd.Convolution(nd.array(x), nd.array(w), no_bias=True,
+                       kernel=(1, 1), num_filter=5)
+    ref = np.einsum("bchw,oc->bohw", x, w[:, :, 0, 0])
+    assert np.allclose(y.asnumpy(), ref, rtol=1e-4)
+
+
+def test_grouped_conv():
+    x = nd.random.uniform(shape=(1, 4, 5, 5))
+    w = nd.random.uniform(shape=(4, 1, 3, 3))
+    y = nd.Convolution(x, w, no_bias=True, kernel=(3, 3), num_filter=4,
+                       num_group=4)
+    assert y.shape == (1, 4, 3, 3)
+
+
+def test_deconvolution():
+    x = nd.random.uniform(shape=(1, 3, 4, 4))
+    w = nd.random.uniform(shape=(3, 2, 2, 2))
+    y = nd.Deconvolution(x, w, kernel=(2, 2), stride=(2, 2), num_filter=2)
+    assert y.shape == (1, 2, 8, 8)
+
+
+def test_pooling():
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    y = nd.Pooling(nd.array(x), kernel=(2, 2), stride=(2, 2), pool_type="max")
+    assert np.allclose(y.asnumpy(), [[[[5, 7], [13, 15]]]])
+    y = nd.Pooling(nd.array(x), kernel=(2, 2), stride=(2, 2), pool_type="avg")
+    assert np.allclose(y.asnumpy(), [[[[2.5, 4.5], [10.5, 12.5]]]])
+    y = nd.Pooling(nd.array(x), global_pool=True, pool_type="max")
+    assert np.allclose(y.asnumpy(), [[[[15]]]])
+
+
+def test_pooling_full_convention():
+    x = nd.random.uniform(shape=(1, 1, 5, 5))
+    y = nd.Pooling(x, kernel=(2, 2), stride=(2, 2), pool_type="max",
+                   pooling_convention="full")
+    assert y.shape == (1, 1, 3, 3)
+    y = nd.Pooling(x, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    assert y.shape == (1, 1, 2, 2)
+
+
+def test_activation():
+    x = np.array([-1.0, 0.0, 2.0], dtype=np.float32)
+    assert np.allclose(nd.Activation(nd.array(x), act_type="relu").asnumpy(),
+                       [0, 0, 2])
+    assert np.allclose(nd.relu(nd.array(x)).asnumpy(), [0, 0, 2])
+    sig = 1 / (1 + np.exp(-x))
+    assert np.allclose(nd.sigmoid(nd.array(x)).asnumpy(), sig, rtol=1e-5)
+    assert np.allclose(nd.tanh(nd.array(x)).asnumpy(), np.tanh(x), rtol=1e-5)
+    # leaky variants
+    y = nd.LeakyReLU(nd.array(x), act_type="leaky", slope=0.1)
+    assert np.allclose(y.asnumpy(), [-0.1, 0, 2], rtol=1e-5)
+    y = nd.LeakyReLU(nd.array(x), act_type="elu", slope=1.0)
+    assert np.allclose(y.asnumpy(), [np.expm1(-1), 0, 2], rtol=1e-5)
+
+
+def test_softmax():
+    x = np.random.rand(3, 5).astype(np.float32)
+    y = nd.softmax(nd.array(x))
+    e = np.exp(x - x.max(-1, keepdims=True))
+    ref = e / e.sum(-1, keepdims=True)
+    assert np.allclose(y.asnumpy(), ref, rtol=1e-5)
+    ly = nd.log_softmax(nd.array(x))
+    assert np.allclose(ly.asnumpy(), np.log(ref), rtol=1e-4)
+    # temperature
+    yt = nd.softmax(nd.array(x), temperature=2.0)
+    e2 = np.exp(x / 2 - (x / 2).max(-1, keepdims=True))
+    assert np.allclose(yt.asnumpy(), e2 / e2.sum(-1, keepdims=True), rtol=1e-5)
+
+
+def test_batchnorm_inference():
+    x = np.random.rand(2, 3, 4, 4).astype(np.float32)
+    gamma = np.random.rand(3).astype(np.float32)
+    beta = np.random.rand(3).astype(np.float32)
+    mean = np.random.rand(3).astype(np.float32)
+    var = np.random.rand(3).astype(np.float32) + 0.5
+    y = nd.BatchNorm(nd.array(x), nd.array(gamma), nd.array(beta),
+                     nd.array(mean), nd.array(var), fix_gamma=False, eps=1e-5)
+    ref = (x - mean[None, :, None, None]) / np.sqrt(var[None, :, None, None] + 1e-5) \
+        * gamma[None, :, None, None] + beta[None, :, None, None]
+    assert np.allclose(y.asnumpy(), ref, rtol=1e-3, atol=1e-5)
+
+
+def test_batchnorm_training_uses_batch_stats():
+    x = np.random.rand(4, 3, 2, 2).astype(np.float32) * 5
+    with ag.record():
+        y = nd.BatchNorm(nd.array(x), nd.ones((3,)), nd.zeros((3,)),
+                         nd.zeros((3,)), nd.ones((3,)), fix_gamma=True)
+    out = y.asnumpy()
+    assert abs(out.mean()) < 1e-4
+    assert abs(out.std() - 1.0) < 1e-2
+
+
+def test_layernorm():
+    x = np.random.rand(2, 5).astype(np.float32)
+    y = nd.LayerNorm(nd.array(x), nd.ones((5,)), nd.zeros((5,)))
+    mu = x.mean(-1, keepdims=True)
+    sd = x.std(-1, keepdims=True)
+    assert np.allclose(y.asnumpy(), (x - mu) / np.sqrt(sd**2 + 1e-5), rtol=1e-3)
+
+
+def test_embedding():
+    w = np.random.rand(10, 4).astype(np.float32)
+    idx = np.array([1, 3, 5])
+    y = nd.Embedding(nd.array(idx), nd.array(w), input_dim=10, output_dim=4)
+    assert np.allclose(y.asnumpy(), w[idx])
+
+
+def test_dropout_eval_identity():
+    x = nd.random.uniform(shape=(10, 10))
+    y = nd.Dropout(x, p=0.5)  # not in training mode
+    assert np.allclose(y.asnumpy(), x.asnumpy())
+
+
+def test_where():
+    cond = nd.array([1.0, 0.0, 1.0])
+    x = nd.array([1.0, 2.0, 3.0])
+    y = nd.array([10.0, 20.0, 30.0])
+    out = nd.where(cond, x, y)
+    assert np.allclose(out.asnumpy(), [1, 20, 3])
+
+
+def test_topk_sort():
+    x = np.array([[3.0, 1.0, 2.0], [0.0, 5.0, 4.0]], dtype=np.float32)
+    v = nd.topk(nd.array(x), k=2, ret_typ="value")
+    assert np.allclose(v.asnumpy(), [[3, 2], [5, 4]])
+    s = nd.sort(nd.array(x), axis=-1)
+    assert np.allclose(s.asnumpy(), np.sort(x, -1))
+    a = nd.argsort(nd.array(x), axis=-1)
+    assert np.allclose(a.asnumpy(), np.argsort(x, -1))
+
+
+def test_gather_scatter():
+    data = nd.array(np.arange(12, dtype=np.float32).reshape(3, 4))
+    idx = nd.array([[0, 2], [1, 3]])  # 2 points: (0,1), (2,3)
+    out = nd.gather_nd(data, idx)
+    assert np.allclose(out.asnumpy(), [1.0, 11.0])
+
+
+def test_sequence_mask():
+    x = nd.ones((3, 2, 4))  # (T, B, F)
+    sl = nd.array([1, 3])
+    y = nd.SequenceMask(x, sl, use_sequence_length=True, value=0.0)
+    out = y.asnumpy()
+    assert np.allclose(out[:1, 0], 1) and np.allclose(out[1:, 0], 0)
+    assert np.allclose(out[:, 1], 1)
+
+
+def test_control_flow_foreach():
+    from mxnet_tpu.ndarray import contrib
+    data = nd.array(np.arange(6, dtype=np.float32).reshape(3, 2))
+    init = nd.zeros((2,))
+
+    def step(x, state):
+        new = state + x
+        return new, new
+
+    outs, final = contrib.foreach(step, data, init)
+    assert np.allclose(final.asnumpy(), [6.0, 9.0])
+    assert np.allclose(outs.asnumpy()[-1], [6.0, 9.0])
+
+
+def test_control_flow_while_cond():
+    from mxnet_tpu.ndarray import contrib
+    i = nd.array([0.0])
+    out = contrib.while_loop(lambda x: x < 5, lambda x: x + 1, i)
+    assert np.allclose(out.asnumpy(), [5.0])
+    r = contrib.cond(nd.array([1.0]), lambda: nd.array([10.0]),
+                     lambda: nd.array([20.0]))
+    assert np.allclose(r.asnumpy(), [10.0])
+
+
+def test_random_ops():
+    mx.random.seed(42)
+    a = nd.random.uniform(0, 1, shape=(100,))
+    assert 0 <= a.asnumpy().min() and a.asnumpy().max() <= 1
+    b = nd.random.normal(0, 1, shape=(1000,))
+    assert abs(b.asnumpy().mean()) < 0.2
+    c = nd.random.randint(0, 10, shape=(50,))
+    assert c.dtype == np.int32
+    mx.random.seed(42)
+    a2 = nd.random.uniform(0, 1, shape=(100,))
+    assert np.allclose(a.asnumpy(), a2.asnumpy())
+
+
+def test_numeric_gradient_check():
+    from mxnet_tpu.test_utils import check_numeric_gradient
+    x = nd.random.uniform(shape=(3, 4))
+    check_numeric_gradient(lambda a: (nd.tanh(a) * a).sum(), [x])
+
+
+def test_conv_gradient():
+    x = nd.random.uniform(shape=(1, 2, 5, 5))
+    w = nd.random.uniform(shape=(3, 2, 3, 3))
+    x.attach_grad()
+    w.attach_grad()
+    with ag.record():
+        y = nd.Convolution(x, w, no_bias=True, kernel=(3, 3), num_filter=3)
+        loss = (y * y).sum()
+    loss.backward()
+    assert x.grad.asnumpy().std() > 0
+    assert w.grad.asnumpy().std() > 0
+    from mxnet_tpu.test_utils import check_numeric_gradient
+    check_numeric_gradient(
+        lambda a, b: (nd.Convolution(a, b, no_bias=True, kernel=(3, 3),
+                                     num_filter=3) ** 2).sum(),
+        [x, w], rtol=1e-2, atol=1e-2)
