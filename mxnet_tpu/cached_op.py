@@ -1,0 +1,125 @@
+"""CachedOp: trace-once, compile-once graph execution (hybridize backend).
+
+Role parity: reference ``src/imperative/cached_op.cc`` — Gluon's
+``hybridize()`` traces ``hybrid_forward`` into an nnvm graph, then replays it
+through a cached executor with static memory planning
+(`cached_op.cc:1023 Forward`, `:861 StaticForward`, `:414 SetForwardGraph`);
+when autograd is recording, the whole graph is recorded as ONE tape node
+(`_CachedOp`, see `src/imperative/cached_op.cc:1077 DynamicBackward`).
+
+TPU-native design: the graph IS an XLA program. We trace the Python callable
+once per (shapes, dtypes, train-mode) signature with ``jax.jit`` — the
+NDArray handles transparently carry tracers, so the whole eager op surface is
+traceable with zero duplicated code. XLA then does what MXNet's passes did by
+hand: memory planning (`src/nnvm/plan_memory.cc`), pointwise fusion
+(`src/executor/pointwise_fusion_pass.cc`), op bulking, and static buffer
+assignment (`static_alloc`/`static_shape` flags are accepted for API parity
+and are effectively always-on under XLA).
+
+Randomness: a fresh base PRNG key is an *argument* of the compiled program;
+ops that need randomness split from it via ``random.push_trace_key`` — so
+every execution of a cached graph sees new randomness while the trace stays
+pure (the reference holds stateful cuDNN dropout descriptors in op state
+instead).
+"""
+from __future__ import annotations
+
+import jax
+
+from . import _tape
+from . import random as _random
+
+__all__ = ["CachedOp"]
+
+
+class CachedOp:
+    """Compile-cached executor for a callable over NDArrays.
+
+    ``fn`` takes NDArray positional args and returns an NDArray or a
+    list/tuple of NDArrays. Calls dispatch to a jitted pure function,
+    cache-keyed on input (shape, dtype) signature and train mode —
+    the moral equivalent of `SetForwardGraph`'s shape-match check
+    (reference `src/imperative/cached_op.cc:414`).
+    """
+
+    def __init__(self, fn, static_alloc=False, static_shape=False,
+                 inline_limit=2, forward_bulk_size=None,
+                 backward_bulk_size=None, name="CachedOp"):
+        self._fn = fn
+        self._name = name
+        # flags kept for API parity (cached_op.h:33-52); XLA makes them no-ops
+        self._flags = dict(static_alloc=static_alloc,
+                           static_shape=static_shape,
+                           inline_limit=inline_limit,
+                           forward_bulk_size=forward_bulk_size,
+                           backward_bulk_size=backward_bulk_size)
+        self._cache = {}
+
+    def _signature(self, args):
+        return (tuple((a.shape, str(a.dtype)) for a in args),
+                _tape.is_training())
+
+    def _compile(self, args):
+        from .ndarray.ndarray import NDArray
+        fn = self._fn
+        train = _tape.is_training()
+        n_out_box = []
+
+        def pure(rng_key, *vals):
+            nds = [NDArray(v) for v in vals]
+            _random.push_trace_key(rng_key)
+            prev_rec = _tape.set_recording(False)
+            prev_train = _tape.set_training(train)
+            try:
+                outs = fn(*nds)
+            finally:
+                _tape.set_training(prev_train)
+                _tape.set_recording(prev_rec)
+                _random.pop_trace_key()
+            multi = isinstance(outs, (list, tuple))
+            outs_t = tuple(outs) if multi else (outs,)
+            if not n_out_box:
+                n_out_box.append((len(outs_t), multi))
+            return tuple(o._data for o in outs_t)
+
+        jitted = jax.jit(pure)
+        # force trace now so n_out is known before first real dispatch
+        jax.eval_shape(jitted, jax.random.PRNGKey(0),
+                       *[jax.ShapeDtypeStruct(a.shape, a._data.dtype)
+                         for a in args])
+        n_out, multi = n_out_box[0]
+        return jitted, n_out, multi
+
+    def __call__(self, *args, **kwargs):
+        from .ndarray.ndarray import NDArray
+
+        args = [a if isinstance(a, NDArray) else NDArray(a) for a in args]
+        sig = self._signature(args)
+        entry = self._cache.get(sig)
+        if entry is None:
+            entry = self._compile(args)
+            self._cache[sig] = entry
+        jitted, n_out, multi = entry
+
+        key = _random.next_key()
+        vals = [a._data for a in args]
+        out_vals = jitted(key, *vals)
+
+        node = None
+        if _tape.is_recording():
+            parents = [_tape.Const(key)]
+            for a in args:
+                n = a._ag_node
+                if n is None:
+                    parents.append(_tape.Const(a._data))
+                else:
+                    parents.append(n if isinstance(n, tuple) else (n, 0))
+            node = _tape.OpNode(jitted, parents, n_out, {}, self._name)
+
+        results = []
+        for i, v in enumerate(out_vals):
+            arr = NDArray(v, ctx=args[0]._ctx if args else None)
+            if node is not None:
+                arr._ag_node = (node, i)
+            results.append(arr)
+        return results if multi else results[0]

@@ -31,13 +31,18 @@ _state = threading.local()
 
 def _global():
     if not hasattr(_state, "key"):
-        _state.key = jax.random.PRNGKey(_np.random.randint(0, 2**31 - 1))
+        # ensure_compile_time_eval: the global key must be a concrete array
+        # even when first touched inside a jit trace (CachedOp), else the
+        # stateful key would leak a tracer out of the trace.
+        with jax.ensure_compile_time_eval():
+            _state.key = jax.random.PRNGKey(_np.random.randint(0, 2**31 - 1))
     return _state
 
 
 def seed(seed_state, ctx="all"):
     """Parity with mx.random.seed (reference `python/mxnet/random.py:38`)."""
-    _global().key = jax.random.PRNGKey(int(seed_state))
+    with jax.ensure_compile_time_eval():
+        _global().key = jax.random.PRNGKey(int(seed_state))
     _np.random.seed(int(seed_state) % (2**32))
 
 
