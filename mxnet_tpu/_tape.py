@@ -44,6 +44,34 @@ def set_training(flag: bool) -> bool:
     return prev
 
 
+# ---- aux-state sink ---------------------------------------------------------
+# MXNet ops may mutate auxiliary states during forward (BatchNorm moving
+# mean/var — reference `src/operator/nn/batch_norm-inl.h` aux states). Under
+# jit those writes must become extra program *outputs*: a layer calls
+# ``aux_write(handle, value)``; eagerly it writes through immediately, under
+# a CachedOp trace the (handle, traced value) pair is collected by the sink
+# and written back with concrete results after execution.
+
+def push_aux_sink():
+    if not hasattr(_state, "aux_sinks"):
+        _state.aux_sinks = []
+    sink = []
+    _state.aux_sinks.append(sink)
+    return sink
+
+
+def pop_aux_sink():
+    return _state.aux_sinks.pop()
+
+
+def aux_write(handle, value):
+    sinks = getattr(_state, "aux_sinks", None)
+    if sinks:
+        sinks[-1].append((handle, value))
+    else:
+        handle._data = value
+
+
 class Const:
     """A captured non-differentiable input value."""
     __slots__ = ("value",)

@@ -65,14 +65,18 @@ class CachedOp:
         train = _tape.is_training()
         n_out_box = []
 
+        aux_handles_box = []
+
         def pure(rng_key, *vals):
             nds = [NDArray(v) for v in vals]
             _random.push_trace_key(rng_key)
             prev_rec = _tape.set_recording(False)
             prev_train = _tape.set_training(train)
+            sink = _tape.push_aux_sink()
             try:
                 outs = fn(*nds)
             finally:
+                _tape.pop_aux_sink()
                 _tape.set_training(prev_train)
                 _tape.set_recording(prev_rec)
                 _random.pop_trace_key()
@@ -80,7 +84,9 @@ class CachedOp:
             outs_t = tuple(outs) if multi else (outs,)
             if not n_out_box:
                 n_out_box.append((len(outs_t), multi))
-            return tuple(o._data for o in outs_t)
+                aux_handles_box.append([h for h, _ in sink])
+            # aux writes (e.g. BatchNorm moving stats) ride as extra outputs
+            return tuple(o._data for o in outs_t) + tuple(v for _, v in sink)
 
         jitted = jax.jit(pure)
         # force trace now so n_out is known before first real dispatch
@@ -88,22 +94,32 @@ class CachedOp:
                        *[jax.ShapeDtypeStruct(a.shape, a._data.dtype)
                          for a in args])
         n_out, multi = n_out_box[0]
-        return jitted, n_out, multi
+        return jitted, n_out, multi, aux_handles_box[0]
 
     def __call__(self, *args, **kwargs):
+        import jax as _jax
         from .ndarray.ndarray import NDArray
 
         args = [a if isinstance(a, NDArray) else NDArray(a) for a in args]
+        # Inside an enclosing trace (a hybridized parent block), inline this
+        # op's body into the parent program instead of nesting jit — matches
+        # the reference where the whole net becomes ONE CachedOp graph, and
+        # keeps aux-state writes flowing to the outermost sink.
+        if any(isinstance(a._data, _jax.core.Tracer) for a in args):
+            return self._fn(*args)
         sig = self._signature(args)
         entry = self._cache.get(sig)
         if entry is None:
             entry = self._compile(args)
             self._cache[sig] = entry
-        jitted, n_out, multi = entry
+        jitted, n_out, multi, aux_handles = entry
 
         key = _random.next_key()
         vals = [a._data for a in args]
         out_vals = jitted(key, *vals)
+        for h, v in zip(aux_handles, out_vals[n_out:]):
+            h._data = v
+        out_vals = out_vals[:n_out]
 
         node = None
         if _tape.is_recording():

@@ -1,0 +1,31 @@
+"""Gluon: the imperative/hybrid front-end (reference ``python/mxnet/gluon/``)."""
+from .parameter import Parameter, Constant, ParameterDict, \
+    DeferredInitializationError
+from .block import Block, HybridBlock, SymbolBlock
+from . import nn
+from . import loss
+from . import utils
+
+_LAZY = {
+    "trainer": ".trainer",
+    "Trainer": (".trainer", "Trainer"),
+    "data": ".data",
+    "rnn": ".rnn",
+    "model_zoo": ".model_zoo",
+    "contrib": ".contrib",
+}
+
+
+def __getattr__(name):
+    spec = _LAZY.get(name)
+    if spec is None:
+        raise AttributeError("module 'mxnet_tpu.gluon' has no attribute %r"
+                             % name)
+    import importlib
+    if isinstance(spec, tuple):
+        mod = importlib.import_module(spec[0], __name__)
+        val = getattr(mod, spec[1])
+    else:
+        val = importlib.import_module(spec, __name__)
+    globals()[name] = val
+    return val

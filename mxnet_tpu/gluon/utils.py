@@ -1,0 +1,96 @@
+"""Gluon utilities.
+
+Parity surface: reference ``python/mxnet/gluon/utils.py`` —
+``split_data``/``split_and_load`` (:31,100 — the data-parallel batch
+splitter used with multi-context training) and ``clip_global_norm`` (:131).
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from ..context import Context
+from ..ndarray import ndarray as _nd
+from ..ndarray.ndarray import NDArray
+
+__all__ = ["split_data", "split_and_load", "clip_global_norm",
+           "check_sha1", "download"]
+
+
+def split_data(data, num_slice, batch_axis=0, even_split=True):
+    """Split along batch_axis into num_slice slices (reference utils.py:31)."""
+    size = data.shape[batch_axis]
+    if even_split and size % num_slice != 0:
+        raise ValueError(
+            "data with shape %s cannot be evenly split into %d slices along "
+            "axis %d. Use a batch size that's a multiple of the number of "
+            "devices, or set even_split=False." % (
+                str(data.shape), num_slice, batch_axis))
+    if num_slice == 1:
+        return [data]
+    step = size // num_slice
+    slices = []
+    for i in range(num_slice):
+        begin = i * step
+        end = (i + 1) * step if i < num_slice - 1 else size
+        slices.append(data.slice_axis(batch_axis, begin, end))
+    return slices
+
+
+def split_and_load(data, ctx_list, batch_axis=0, even_split=True):
+    """Split batch and load each slice to one context (reference
+    utils.py:100)."""
+    if not isinstance(data, NDArray):
+        data = _nd.array(data, ctx=ctx_list[0])
+    if len(ctx_list) == 1:
+        return [data.as_in_context(ctx_list[0])]
+    slices = split_data(data, len(ctx_list), batch_axis, even_split)
+    return [s.as_in_context(ctx) for s, ctx in zip(slices, ctx_list)]
+
+
+def clip_global_norm(arrays, max_norm, check_isfinite=True):
+    """Rescale arrays so the sum of their 2-norms is <= max_norm (reference
+    utils.py:131)."""
+    def _norm(array):
+        x = array.reshape((-1,))
+        return _nd.NDArray((x._data * x._data).sum())
+
+    assert len(arrays) > 0
+    ctx = arrays[0].ctx
+    total_norm = sum(float(_norm(a).asnumpy()) for a in arrays)
+    total_norm = _np.sqrt(total_norm)
+    if check_isfinite and not _np.isfinite(total_norm):
+        import warnings
+        warnings.warn("nan or inf is detected. Clipping results will be "
+                      "undefined.", stacklevel=2)
+    scale = max_norm / (total_norm + 1e-8)
+    if scale < 1.0:
+        for arr in arrays:
+            arr *= scale
+    return total_norm
+
+
+def check_sha1(filename, sha1_hash):
+    import hashlib
+    sha1 = hashlib.sha1()
+    with open(filename, "rb") as f:
+        while True:
+            data = f.read(1048576)
+            if not data:
+                break
+            sha1.update(data)
+    return sha1.hexdigest() == sha1_hash
+
+
+def download(url, path=None, overwrite=False, sha1_hash=None, retries=5,
+             verify_ssl=True):
+    """Kept for API parity; this environment has no egress, so only
+    file:// URLs or already-present files work."""
+    import os
+    fname = path if path and not os.path.isdir(path) else \
+        os.path.join(path or ".", url.split("/")[-1])
+    if os.path.exists(fname) and not overwrite and \
+            (not sha1_hash or check_sha1(fname, sha1_hash)):
+        return fname
+    raise RuntimeError(
+        "download(%s) unavailable: network egress is disabled; place the "
+        "file at %s manually" % (url, fname))

@@ -10,6 +10,7 @@ from .registry import register, get_op, list_ops, invoke, Op
 from . import core      # noqa: F401  (registers core tensor ops)
 from . import nn        # noqa: F401  (registers NN ops)
 from . import contrib_ops  # noqa: F401
+from . import ctc       # noqa: F401  (CTC loss dynamic program)
 
 
 def populate_namespace(target, names=None):

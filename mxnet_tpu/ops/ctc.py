@@ -1,0 +1,102 @@
+"""CTC loss: log-space alpha recursion as one lax.scan.
+
+Role parity: reference ``src/operator/nn/ctc_loss.cc`` (Baidu warp-ctc,
+vendored headers in `3rdparty/ctc_include/`). TPU-native: the forward
+algorithm is a dense dynamic program over the extended label lattice —
+expressed as ``lax.scan`` over time with vectorized batch/state axes, it
+compiles to one fused XLA loop; the gradient falls out of autodiff through
+the scan (warp-ctc hand-codes the beta recursion instead).
+
+Convention matches Gluon's CTCLoss (reference `python/mxnet/gluon/loss.py`
+CTCLoss): the *last* class index is blank; label padding may be any value
+when ``label_lengths`` is given, otherwise labels < 0 mark padding.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+NEG_INF = -1e30
+
+
+def ctc_loss(pred, labels, pred_lengths=None, label_lengths=None):
+    """pred: (T, B, C) unnormalized activations; labels: (B, L) int.
+
+    Returns per-example negative log likelihood, shape (B,).
+    """
+    T, B, C = pred.shape
+    L = labels.shape[1]
+    S = 2 * L + 1
+    blank = C - 1
+
+    logp = jax.nn.log_softmax(pred.astype(jnp.float32), axis=-1)
+    labels = labels.astype(jnp.int32)
+
+    if pred_lengths is None:
+        pred_lengths = jnp.full((B,), T, dtype=jnp.int32)
+    else:
+        pred_lengths = pred_lengths.astype(jnp.int32)
+    if label_lengths is None:
+        label_lengths = jnp.sum((labels >= 0).astype(jnp.int32), axis=1)
+    else:
+        label_lengths = label_lengths.astype(jnp.int32)
+
+    # extended sequence [blank, l1, blank, l2, ..., blank]: (B, S)
+    ext = jnp.full((B, S), blank, dtype=jnp.int32)
+    ext = ext.at[:, 1::2].set(jnp.where(labels < 0, blank, labels))
+
+    # transition mask: can we skip from s-2 to s?
+    # allowed when ext[s] != blank and ext[s] != ext[s-2]
+    ext_m2 = jnp.concatenate(
+        [jnp.full((B, 2), -1, dtype=jnp.int32), ext[:, :-2]], axis=1)
+    can_skip = (ext != blank) & (ext != ext_m2)
+
+    # states beyond 2*label_len+1 are invalid
+    s_idx = jnp.arange(S)[None, :]
+    valid = s_idx < (2 * label_lengths + 1)[:, None]
+
+    alpha0 = jnp.full((B, S), NEG_INF)
+    alpha0 = alpha0.at[:, 0].set(logp[0, jnp.arange(B), blank])
+    alpha0 = alpha0.at[:, 1].set(
+        jnp.where(label_lengths > 0,
+                  logp[0, jnp.arange(B), ext[:, 1]], NEG_INF))
+    alpha0 = jnp.where(valid, alpha0, NEG_INF)
+
+    def step(alpha, t):
+        a_m1 = jnp.concatenate(
+            [jnp.full((B, 1), NEG_INF), alpha[:, :-1]], axis=1)
+        a_m2 = jnp.concatenate(
+            [jnp.full((B, 2), NEG_INF), alpha[:, :-2]], axis=1)
+        a_m2 = jnp.where(can_skip, a_m2, NEG_INF)
+        merged = jnp.logaddexp(jnp.logaddexp(alpha, a_m1), a_m2)
+        emit = jnp.take_along_axis(logp[t], ext, axis=1)
+        new = merged + emit
+        new = jnp.where(valid, new, NEG_INF)
+        # frozen past pred_lengths: carry alpha unchanged
+        active = (t < pred_lengths)[:, None]
+        new = jnp.where(active, new, alpha)
+        return new, None
+
+    alpha, _ = lax.scan(step, alpha0, jnp.arange(1, T))
+
+    # final: logaddexp of last two valid states
+    b_idx = jnp.arange(B)
+    sl = 2 * label_lengths  # index of final blank
+    last_blank = alpha[b_idx, sl]
+    last_label = jnp.where(label_lengths > 0,
+                           alpha[b_idx, jnp.maximum(sl - 1, 0)], NEG_INF)
+    ll = jnp.logaddexp(last_blank, last_label)
+    return -ll
+
+
+@register("_ctc_loss", aliases=("ctc_loss", "CTCLoss_op", "_contrib_ctc_loss"))
+def _ctc_loss(data, label, data_lengths=None, label_lengths=None,
+              use_data_lengths=False, use_label_lengths=False,
+              blank_label="last"):
+    """Op wrapper: data (T, B, C) — see module docstring."""
+    return ctc_loss(data, label,
+                    None if data_lengths is None else data_lengths,
+                    None if label_lengths is None else label_lengths)
