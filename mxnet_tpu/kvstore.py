@@ -1,0 +1,220 @@
+"""KVStore: the gradient-aggregation / parameter-sync surface.
+
+Parity surface: reference ``python/mxnet/kvstore.py`` +
+``src/kvstore/`` (N12-N15 in SURVEY §2.1): `KVStore::Create` modes
+`local`/`device`/`nccl`/`dist_sync`/`dist_async`/`dist_device_sync`
+(`src/kvstore/kvstore.cc:40`), Init/Push/Pull/PushPull/set_updater
+(`include/mxnet/kvstore.h:105-438`).
+
+TPU-native design (SURVEY §5.8): there are no server processes and no key
+sharding — a single-process store aggregates across local device copies
+(role of `CommDevice` `src/kvstore/comm.h:451`), and the distributed mode
+``dist_tpu_sync`` [aliases: dist_sync, dist_device_sync, nccl] rides XLA
+collectives: `rank`/`num_workers` come from `jax.process_index/count`, and
+cross-host reduction happens *inside* the compiled training step (see
+mxnet_tpu.parallel) — the eager push/pull path here uses a psum over the
+global mesh when multiple processes are attached. `dist_async` is
+anti-idiomatic on TPU and raises (SURVEY §2.4).
+"""
+from __future__ import annotations
+
+import pickle
+
+import numpy as _np
+import jax
+import jax.numpy as jnp
+
+from .base import MXNetError
+from .ndarray import ndarray as _nd
+from .ndarray.ndarray import NDArray
+
+__all__ = ["KVStore", "create"]
+
+
+def _key_str(key):
+    return str(key)
+
+
+class KVStore:
+    """Single-interface store over local devices / TPU mesh."""
+
+    def __init__(self, kind="local"):
+        self._kind = kind
+        self._store = {}
+        self._updater = None
+        self._optimizer = None
+        self._compression_params = None
+        self._is_dist = kind.startswith("dist") or kind == "nccl"
+
+    # ---- identity ---------------------------------------------------------
+    @property
+    def type(self):
+        return self._kind
+
+    @property
+    def rank(self):
+        return jax.process_index() if self._is_dist else 0
+
+    @property
+    def num_workers(self):
+        return jax.process_count() if self._is_dist else 1
+
+    # ---- init/push/pull ---------------------------------------------------
+    def init(self, key, value):
+        keys, values = _key_value(key, value)
+        for k, v in zip(keys, values):
+            self._store[k] = NDArray(v._data, ctx=v._ctx)
+
+    def _reduce(self, values):
+        """Sum gradients across device copies (reference CommDevice::Reduce
+        `src/kvstore/comm.h:451`). On TPU the copies live on one chip or a
+        mesh; the eager sum lowers to XLA adds / ICI transfers."""
+        if len(values) == 1:
+            out = values[0]._data
+        else:
+            dev0 = values[0]._data.devices() if hasattr(values[0]._data, "devices") else None
+            acc = values[0]._data
+            for v in values[1:]:
+                vv = v._data
+                acc = acc + (jax.device_put(vv, next(iter(dev0)))
+                             if dev0 and vv.devices() != values[0]._data.devices()
+                             else vv)
+            out = acc
+        if self._is_dist and jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+            out = multihost_utils.process_allgather(out).sum(axis=0)
+        return out
+
+    def push(self, key, value, priority=0):
+        keys, values = _key_value(key, value)
+        grouped = {}
+        for k, v in zip(keys, values):
+            grouped.setdefault(k, []).append(v)
+        for k, vals in grouped.items():
+            reduced = self._reduce(vals)
+            if k not in self._store:
+                raise MXNetError("key %s has not been initialized" % k)
+            if self._updater is not None:
+                gw = NDArray(reduced)
+                self._updater(_key_int(k), gw, self._store[k])
+            else:
+                self._store[k]._data = self._store[k]._data + reduced
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        keys, outs = _key_value(key, out)
+        for k, o in zip(keys, outs):
+            if k not in self._store:
+                raise MXNetError("key %s has not been initialized" % k)
+            src = self._store[k]
+            val = src._data
+            if o.ctx != src.ctx:
+                val = jax.device_put(val, o.ctx.jax_device)
+            o._data = val.astype(o._data.dtype) if o._data.dtype != val.dtype else val
+
+    def pushpull(self, key, value, out=None, priority=0):
+        """Fused allreduce (reference KVStore::PushPull
+        `include/mxnet/kvstore.h:236`). On TPU this is the natural single
+        collective; push+pull decomposition is the legacy path."""
+        self.push(key, value, priority)
+        self.pull(key, out if out is not None else value, priority)
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        # dense fallback (sparse = API-complete, SURVEY §2.1 note)
+        self.pull(key, out=out, priority=priority)
+
+    def broadcast(self, key, value, out=None, priority=0):
+        self.init(key, value)
+        if out is not None:
+            self.pull(key, out=out, priority=priority)
+
+    # ---- optimizer --------------------------------------------------------
+    def set_updater(self, updater):
+        """reference `kvstore.py` set_updater — local mode runs the
+        optimizer inside the store (update_on_kvstore)."""
+        self._updater = updater
+
+    def set_optimizer(self, optimizer):
+        from . import optimizer as opt_mod
+        self._optimizer = optimizer
+        self._updater = opt_mod.get_updater(optimizer)
+
+    def is_capable(self, capability):
+        if capability.lower() == "optimizer":
+            return not self._is_dist or True
+        return False
+
+    # ---- compression ------------------------------------------------------
+    def set_gradient_compression(self, compression_params):
+        """reference N15 `src/kvstore/gradient_compression.h`. ICI bandwidth
+        makes 2-bit compression unnecessary (SURVEY §2.4); accepted and
+        recorded for API parity, applied as a no-op."""
+        self._compression_params = compression_params
+
+    # ---- distributed control ----------------------------------------------
+    def barrier(self):
+        if self._is_dist and jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices("kvstore_barrier")
+
+    def _barrier(self):
+        self.barrier()
+
+    def send_command_to_servers(self, head, body):
+        pass  # no server processes on TPU (SURVEY §5.8)
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        assert self._updater is not None, "Cannot save states for distributed training"
+        with open(fname, "wb") as fout:
+            fout.write(self._updater.get_states(dump_optimizer))
+
+    def load_optimizer_states(self, fname):
+        assert self._updater is not None, "Cannot load states for distributed training"
+        with open(fname, "rb") as fin:
+            self._updater.set_states(fin.read())
+
+    @property
+    def num_dead_node(self):
+        return 0
+
+
+def _key_int(k):
+    try:
+        return int(k)
+    except (TypeError, ValueError):
+        return k
+
+
+def _key_value(key, value):
+    single = not isinstance(key, (list, tuple))
+    if single:
+        if isinstance(value, (list, tuple)):
+            return [_key_str(key)] * len(value), list(value)
+        return [_key_str(key)], [value]
+    keys, values = [], []
+    for k, v in zip(key, value):
+        if isinstance(v, (list, tuple)):
+            keys.extend([_key_str(k)] * len(v))
+            values.extend(v)
+        else:
+            keys.append(_key_str(k))
+            values.append(v)
+    return keys, values
+
+
+def create(name="local"):
+    """Factory (reference `KVStore::Create` `src/kvstore/kvstore.cc:40`)."""
+    if not isinstance(name, str):
+        raise TypeError("name must be a string")
+    name = name.lower()
+    if name in ("local", "local_update_cpu", "local_allreduce_cpu",
+                "local_allreduce_device", "device"):
+        return KVStore(name)
+    if name in ("dist_tpu_sync", "dist_sync", "dist_device_sync", "nccl",
+                "dist"):
+        return KVStore("dist_tpu_sync")
+    if name == "dist_async":
+        raise MXNetError(
+            "dist_async is unsupported on TPU: asynchronous parameter-server "
+            "updates are anti-idiomatic for an ICI mesh (SURVEY §2.4); use "
+            "dist_tpu_sync")
+    raise MXNetError("unknown KVStore type %s" % name)

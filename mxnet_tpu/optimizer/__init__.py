@@ -1,0 +1,5 @@
+"""Optimizer package (reference ``python/mxnet/optimizer/``)."""
+from .optimizer import *  # noqa: F401,F403
+from .optimizer import Optimizer, create, register, Updater, get_updater  # noqa: F401
+
+opt_registry = None  # populated lazily for introspection parity

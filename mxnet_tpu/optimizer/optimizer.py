@@ -1,0 +1,822 @@
+"""Optimizers.
+
+Parity surface: reference ``python/mxnet/optimizer/optimizer.py`` (2,172 LoC:
+SGD :525, Signum :671, FTML :738, LARS :796, NAG :1305, SGLD :1383,
+Adam :1420, AdaGrad :1504, RMSProp :1563, AdaDelta :1641, Ftrl :1701,
+Adamax :1777, Nadam :1834, DCASGD :1249) and the fused C++ kernels in
+``src/operator/optimizer_op.cc``.
+
+TPU-native design: every update rule is ONE pure jitted function with
+donated weight/state buffers — XLA reuses the parameter's memory in place,
+which is the TPU equivalent of the reference's in-place fused optimizer
+kernels. Hyperparameters (lr, wd, ...) are traced scalars, so changing the
+learning rate never recompiles.
+"""
+from __future__ import annotations
+
+import math
+from functools import partial
+
+import numpy as _np
+import jax
+import jax.numpy as jnp
+
+from ..base import dtype_np
+from ..ndarray import ndarray as _nd
+from ..ndarray.ndarray import NDArray
+
+__all__ = ["Optimizer", "register", "create", "SGD", "Signum", "FTML",
+           "LARS", "LBSGD", "DCASGD", "NAG", "SGLD", "Adam", "AdaGrad",
+           "RMSProp", "AdaDelta", "Ftrl", "Adamax", "Nadam", "LAMB", "Test",
+           "Updater", "get_updater"]
+
+_OPT_REGISTRY = {}
+
+
+def register(klass):
+    """reference `optimizer.py` Optimizer.register."""
+    name = klass.__name__.lower()
+    _OPT_REGISTRY[name] = klass
+    return klass
+
+
+def create(name, **kwargs):
+    if isinstance(name, Optimizer):
+        return name
+    if name.lower() not in _OPT_REGISTRY:
+        raise ValueError("Cannot find optimizer %s (have %s)"
+                         % (name, sorted(_OPT_REGISTRY)))
+    return _OPT_REGISTRY[name.lower()](**kwargs)
+
+
+def _clip(g, clip):
+    return jnp.clip(g, -clip, clip) if clip is not None else g
+
+
+class Optimizer:
+    """Base optimizer (reference `optimizer.py:57`)."""
+
+    def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
+                 clip_gradient=None, learning_rate=0.01, lr_scheduler=None,
+                 sym=None, begin_num_update=0, multi_precision=False,
+                 param_dict=None):
+        self.rescale_grad = rescale_grad
+        self.lr = learning_rate
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None:
+            self.lr_scheduler.base_lr = learning_rate
+        self.wd = wd
+        self.lr_mult = {}
+        self.wd_mult = {}
+        self.begin_num_update = begin_num_update
+        self.num_update = begin_num_update
+        self._all_index_update_counts = {0: {}}
+        self._index_update_count = self._all_index_update_counts[0]
+        self.clip_gradient = clip_gradient
+        self.multi_precision = multi_precision
+        self.aggregate_num = 0
+        if param_idx2name is None:
+            param_idx2name = {}
+        self.idx2name = param_idx2name.copy()
+        self.sym_info = ()
+        self.param_dict = param_dict if param_dict else {}
+
+    create_optimizer = staticmethod(create)
+
+    @property
+    def learning_rate(self):
+        if self.lr_scheduler is not None:
+            return self.lr_scheduler(self.num_update)
+        return self.lr
+
+    def set_learning_rate(self, lr):
+        if self.lr_scheduler is not None:
+            raise UserWarning("LRScheduler of the optimizer has already been "
+                              "defined.")
+        self.lr = lr
+
+    def create_state(self, index, weight):
+        return None
+
+    def create_state_multi_precision(self, index, weight):
+        """fp16/bf16 weights keep an fp32 master copy (reference
+        `optimizer.py:280`; AMP docs `faq/float16.md`)."""
+        if self.multi_precision and weight.dtype in (_np.float16,
+                                                     _np.dtype("bfloat16")):
+            master = NDArray(weight._data.astype(jnp.float32), ctx=weight._ctx)
+            return (master, self.create_state(index, master))
+        return self.create_state(index, weight)
+
+    def update(self, index, weight, grad, state):
+        raise NotImplementedError
+
+    def update_multi_precision(self, index, weight, grad, state):
+        if self.multi_precision and weight.dtype in (_np.float16,
+                                                     _np.dtype("bfloat16")):
+            master, base_state = state
+            grad32 = NDArray(grad._data.astype(jnp.float32), ctx=grad._ctx)
+            self.update(index, master, grad32, base_state)
+            weight._data = master._data.astype(weight._data.dtype)
+            return
+        self.update(index, weight, grad, state)
+
+    def set_lr_mult(self, args_lr_mult):
+        self.lr_mult = args_lr_mult.copy()
+
+    def set_wd_mult(self, args_wd_mult):
+        self.wd_mult = {}
+        for n in self.idx2name.values():
+            is_weight = n.endswith("_weight")
+            if not is_weight:
+                self.wd_mult[n] = 0.0
+        self.wd_mult.update(args_wd_mult)
+
+    def _set_current_context(self, device_id):
+        if device_id not in self._all_index_update_counts:
+            self._all_index_update_counts[device_id] = {}
+        self._index_update_count = self._all_index_update_counts[device_id]
+
+    def _update_count(self, index):
+        if not isinstance(index, (list, tuple)):
+            index = [index]
+        for idx in index:
+            if idx not in self._index_update_count:
+                self._index_update_count[idx] = self.begin_num_update
+            self._index_update_count[idx] += 1
+            self.num_update = max(self._index_update_count[idx],
+                                  self.num_update)
+
+    def _get_lrs(self, indices):
+        if self.lr_scheduler is not None:
+            lr = self.lr_scheduler(self.num_update)
+        else:
+            lr = self.lr
+        lrs = [lr for _ in indices]
+        for i, index in enumerate(indices):
+            if index in self.param_dict:
+                lrs[i] *= self.param_dict[index].lr_mult
+            elif index in self.lr_mult:
+                lrs[i] *= self.lr_mult[index]
+            elif index in self.idx2name:
+                lrs[i] *= self.lr_mult.get(self.idx2name[index], 1.0)
+        return lrs
+
+    def _get_lr(self, index):
+        return self._get_lrs([index])[0]
+
+    def _get_wds(self, indices):
+        wds = [self.wd for _ in indices]
+        for i, index in enumerate(indices):
+            if index in self.param_dict:
+                wds[i] *= self.param_dict[index].wd_mult
+            elif index in self.wd_mult:
+                wds[i] *= self.wd_mult[index]
+            elif index in self.idx2name:
+                wds[i] *= self.wd_mult.get(self.idx2name[index], 1.0)
+        return wds
+
+    def _get_wd(self, index):
+        return self._get_wds([index])[0]
+
+    def __getstate__(self):
+        ret = self.__dict__.copy()
+        return ret
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+
+
+# ---- pure jitted update kernels --------------------------------------------
+# donate weight+state: XLA aliases input and output buffers, so parameter
+# memory is updated in place on device (role of the reference's in-place
+# `src/operator/optimizer_op.cc` kernels).
+
+def _kernel(fn, n_donate):
+    return jax.jit(fn, donate_argnums=tuple(range(n_donate)))
+
+
+@partial(jax.jit, donate_argnums=(0, 1))
+def _sgd_mom(w, mom, g, lr, wd, mo, rescale, clip):
+    g = jnp.clip(g * rescale, -clip, clip)
+    g = g + wd * w
+    mom = mo * mom - lr * g
+    return w + mom, mom
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _sgd(w, g, lr, wd, rescale, clip):
+    g = jnp.clip(g * rescale, -clip, clip)
+    return w - lr * (g + wd * w)
+
+
+@partial(jax.jit, donate_argnums=(0, 1))
+def _nag_mom(w, mom, g, lr, wd, mo, rescale, clip):
+    g = jnp.clip(g * rescale, -clip, clip) + wd * w
+    mom = mo * mom + g
+    return w - lr * (g + mo * mom), mom
+
+
+@partial(jax.jit, donate_argnums=(0, 1, 2))
+def _adam(w, m, v, g, lr, wd, b1, b2, eps, rescale, clip, t):
+    g = jnp.clip(g * rescale, -clip, clip) + wd * w
+    m = b1 * m + (1 - b1) * g
+    v = b2 * v + (1 - b2) * jnp.square(g)
+    coef1 = 1 - b1 ** t
+    coef2 = 1 - b2 ** t
+    lr_t = lr * jnp.sqrt(coef2) / coef1
+    return w - lr_t * m / (jnp.sqrt(v) + eps), m, v
+
+
+@partial(jax.jit, donate_argnums=(0, 1))
+def _adagrad(w, hist, g, lr, wd, eps, rescale, clip):
+    g = jnp.clip(g * rescale, -clip, clip) + wd * w
+    hist = hist + jnp.square(g)
+    return w - lr * g / (jnp.sqrt(hist) + eps), hist
+
+
+@partial(jax.jit, donate_argnums=(0, 1))
+def _rmsprop(w, n, g, lr, wd, rho, eps, rescale, clip):
+    g = jnp.clip(g * rescale, -clip, clip) + wd * w
+    n = rho * n + (1 - rho) * jnp.square(g)
+    return w - lr * g / jnp.sqrt(n + eps), n
+
+
+@partial(jax.jit, donate_argnums=(0, 1, 2, 3))
+def _rmsprop_alex(w, n, gm, delta, g, lr, wd, rho, momentum, eps, rescale, clip):
+    g = jnp.clip(g * rescale, -clip, clip) + wd * w
+    n = rho * n + (1 - rho) * jnp.square(g)
+    gm = rho * gm + (1 - rho) * g
+    delta = momentum * delta - lr * g / jnp.sqrt(n - jnp.square(gm) + eps)
+    return w + delta, n, gm, delta
+
+
+@partial(jax.jit, donate_argnums=(0, 1, 2))
+def _adadelta(w, acc_g, acc_delta, g, wd, rho, eps, rescale, clip):
+    g = jnp.clip(g * rescale, -clip, clip) + wd * w
+    acc_g = rho * acc_g + (1 - rho) * jnp.square(g)
+    delta = jnp.sqrt(acc_delta + eps) / jnp.sqrt(acc_g + eps) * g
+    acc_delta = rho * acc_delta + (1 - rho) * jnp.square(delta)
+    return w - delta, acc_g, acc_delta
+
+
+@partial(jax.jit, donate_argnums=(0, 1, 2))
+def _adamax(w, m, u, g, lr, wd, b1, b2, rescale, clip, t):
+    g = jnp.clip(g * rescale, -clip, clip) + wd * w
+    m = b1 * m + (1 - b1) * g
+    u = jnp.maximum(b2 * u, jnp.abs(g))
+    lr_t = lr / (1 - b1 ** t)
+    return w - lr_t * m / (u + 1e-8), m, u
+
+
+@partial(jax.jit, donate_argnums=(0, 1, 2))
+def _nadam(w, m, v, g, lr, wd, b1, b2, eps, schedule, m_schedule_next,
+           rescale, clip, t):
+    g = jnp.clip(g * rescale, -clip, clip) + wd * w
+    grad_prime = g / (1 - schedule)
+    m = b1 * m + (1 - b1) * g
+    v = b2 * v + (1 - b2) * jnp.square(g)
+    m_prime = m / (1 - m_schedule_next)
+    v_prime = v / (1 - b2 ** t)
+    mu_t1 = b1 * (1 - 0.5 * 0.96 ** (0.004 * t))
+    m_bar = (1 - mu_t1) * grad_prime + \
+        (b1 * (1 - 0.5 * 0.96 ** (0.004 * (t + 1)))) * m_prime
+    return w - lr * m_bar / (jnp.sqrt(v_prime) + eps), m, v
+
+
+@partial(jax.jit, donate_argnums=(0, 1, 2))
+def _ftrl(w, z, n, g, lr, wd, lamda1, beta, rescale, clip):
+    g = jnp.clip(g * rescale, -clip, clip)
+    n_new = n + jnp.square(g)
+    sigma = (jnp.sqrt(n_new) - jnp.sqrt(n)) / lr
+    z = z + g - sigma * w
+    n = n_new
+    w = jnp.where(
+        jnp.abs(z) > lamda1,
+        -(z - jnp.sign(z) * lamda1) / ((beta + jnp.sqrt(n)) / lr + wd),
+        jnp.zeros_like(w))
+    return w, z, n
+
+
+@partial(jax.jit, donate_argnums=(0, 1))
+def _signum(w, mom, g, lr, wd, mo, wd_lh, rescale, clip):
+    g = jnp.clip(g * rescale, -clip, clip)
+    mom = mo * mom - (1 - mo) * (g + wd * w)
+    return (1 - lr * wd_lh) * w + lr * jnp.sign(mom), mom
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _signsgd(w, g, lr, wd, rescale, clip):
+    g = jnp.clip(g * rescale, -clip, clip)
+    return w - lr * jnp.sign(g + wd * w)
+
+
+@partial(jax.jit, donate_argnums=(0, 1, 2))
+def _ftml(w, d, v, z, g, lr, wd, b1, b2, eps, rescale, clip, t):
+    g = jnp.clip(g * rescale, -clip, clip) + wd * w
+    v = b2 * v + (1 - b2) * jnp.square(g)
+    d_t = (1 - b1 ** t) / lr * (jnp.sqrt(v / (1 - b2 ** t)) + eps)
+    sigma = d_t - b1 * d
+    z = b1 * z + (1 - b1) * g - sigma * w
+    return -z / d_t, d_t, v, z
+
+
+@partial(jax.jit, donate_argnums=(0, 1))
+def _sgld(w, key, g, lr, wd, rescale, clip):
+    g = jnp.clip(g * rescale, -clip, clip) + wd * w
+    key, sub = jax.random.split(key)
+    noise = jax.random.normal(sub, w.shape, w.dtype) * jnp.sqrt(lr)
+    return w - 0.5 * lr * g + noise, key
+
+
+@partial(jax.jit, donate_argnums=(0, 1))
+def _lars(w, mom, g, lr, wd, mo, eta, eps, rescale, clip):
+    g = g * rescale
+    w_norm = jnp.linalg.norm(w.astype(jnp.float32))
+    g_norm = jnp.linalg.norm(g.astype(jnp.float32))
+    lratio = jnp.where(
+        (w_norm > 0) & (g_norm > 0),
+        eta * w_norm / (g_norm + wd * w_norm + eps), 1.0)
+    g = jnp.clip(g, -clip, clip)
+    scaled = lratio * (g + wd * w)
+    mom = mo * mom + scaled
+    return w - lr * (mom * mo + scaled), mom
+
+
+@partial(jax.jit, donate_argnums=(0, 1, 2))
+def _lamb(w, m, v, g, lr, wd, b1, b2, eps, t, lower, upper, bc, rescale, clip):
+    g = jnp.clip(g * rescale, -clip, clip)
+    m = b1 * m + (1 - b1) * g
+    v = b2 * v + (1 - b2) * jnp.square(g)
+    mhat = jnp.where(bc > 0, m / (1 - b1 ** t), m)
+    vhat = jnp.where(bc > 0, v / (1 - b2 ** t), v)
+    gnew = mhat / (jnp.sqrt(vhat) + eps) + wd * w
+    r1 = jnp.clip(jnp.linalg.norm(w.astype(jnp.float32)), lower, upper)
+    r2 = jnp.linalg.norm(gnew.astype(jnp.float32))
+    ratio = jnp.where((r1 > 0) & (r2 > 0), r1 / r2, 1.0)
+    return w - lr * ratio * gnew, m, v
+
+
+@partial(jax.jit, donate_argnums=(0, 1, 2))
+def _dcasgd(w, prev_w, mom, g, lr, wd, mo, lamda, rescale, clip):
+    g = jnp.clip(g * rescale, -clip, clip) + wd * w
+    mom = mo * mom - lr * (g + lamda * g * g * (w - prev_w))
+    return w + mom, w, mom
+
+
+_INF = float("inf")
+
+
+def _c(clip):
+    return _INF if clip is None else clip
+
+
+def _zeros_like(weight, dtype=None):
+    return NDArray(jnp.zeros(weight.shape,
+                             dtype=dtype or weight._data.dtype),
+                   ctx=weight._ctx)
+
+
+@register
+class SGD(Optimizer):
+    """SGD with momentum + multi-precision (reference `optimizer.py:525`;
+    kernels `src/operator/optimizer_op.cc` sgd_update/sgd_mom_update)."""
+
+    def __init__(self, momentum=0.0, lazy_update=True, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.lazy_update = lazy_update
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return _zeros_like(weight)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        if state is not None:
+            weight._data, state._data = _sgd_mom(
+                weight._data, state._data, grad._data, lr, wd, self.momentum,
+                self.rescale_grad, _c(self.clip_gradient))
+        else:
+            weight._data = _sgd(weight._data, grad._data, lr, wd,
+                                self.rescale_grad, _c(self.clip_gradient))
+
+
+@register
+class Signum(Optimizer):
+    """reference `optimizer.py:671`."""
+
+    def __init__(self, learning_rate=0.01, momentum=0.9, wd_lh=0.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+        self.wd_lh = wd_lh
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return _zeros_like(weight)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        if state is not None:
+            weight._data, state._data = _signum(
+                weight._data, state._data, grad._data, lr, wd, self.momentum,
+                self.wd_lh, self.rescale_grad, _c(self.clip_gradient))
+        else:
+            weight._data = _signsgd(weight._data, grad._data, lr, wd,
+                                    self.rescale_grad, _c(self.clip_gradient))
+
+
+@register
+class FTML(Optimizer):
+    """reference `optimizer.py:738`."""
+
+    def __init__(self, beta1=0.6, beta2=0.999, epsilon=1e-8, **kwargs):
+        super().__init__(**kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (_zeros_like(weight), _zeros_like(weight), _zeros_like(weight))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        d, v, z = state
+        weight._data, d._data, v._data, z._data = _ftml(
+            weight._data, d._data, v._data, z._data, grad._data, lr, wd,
+            self.beta1, self.beta2, self.epsilon, self.rescale_grad,
+            _c(self.clip_gradient), t)
+
+
+@register
+class LARS(Optimizer):
+    """Layer-wise adaptive rate scaling (reference `optimizer.py:796`)."""
+
+    def __init__(self, momentum=0.0, lars_eta=0.001, lars_eps=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.eta = lars_eta
+        self.eps = lars_eps
+
+    def create_state(self, index, weight):
+        return _zeros_like(weight)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        weight._data, state._data = _lars(
+            weight._data, state._data, grad._data, lr, wd, self.momentum,
+            self.eta, self.eps, self.rescale_grad, _c(self.clip_gradient))
+
+
+@register
+class LBSGD(SGD):
+    """Large-batch SGD with warmup (reference `optimizer.py:1056`) — LARS-style
+    scaling is delegated to LARS; kept as an SGD alias for API parity."""
+
+    def __init__(self, momentum=0.0, multi_precision=False, warmup_strategy="linear",
+                 warmup_epochs=5, batch_scale=1, updates_per_epoch=32,
+                 begin_epoch=0, num_epochs=60, **kwargs):
+        super().__init__(momentum=momentum, multi_precision=multi_precision,
+                         **kwargs)
+
+
+@register
+class DCASGD(Optimizer):
+    """Delay-compensated async SGD (reference `optimizer.py:1249`)."""
+
+    def __init__(self, momentum=0.0, lamda=0.04, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.weight_previous = {}
+        self.lamda = lamda
+
+    def create_state(self, index, weight):
+        return (NDArray(weight._data, ctx=weight._ctx), _zeros_like(weight))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        prev, mom = state
+        weight._data, prev._data, mom._data = _dcasgd(
+            weight._data, prev._data, mom._data, grad._data, lr, wd,
+            self.momentum, self.lamda, self.rescale_grad,
+            _c(self.clip_gradient))
+
+
+@register
+class NAG(Optimizer):
+    """Nesterov accelerated SGD (reference `optimizer.py:1305`)."""
+
+    def __init__(self, momentum=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return _zeros_like(weight)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        if state is not None:
+            weight._data, state._data = _nag_mom(
+                weight._data, state._data, grad._data, lr, wd, self.momentum,
+                self.rescale_grad, _c(self.clip_gradient))
+        else:
+            weight._data = _sgd(weight._data, grad._data, lr, wd,
+                                self.rescale_grad, _c(self.clip_gradient))
+
+
+@register
+class SGLD(Optimizer):
+    """Stochastic gradient Langevin dynamics (reference `optimizer.py:1383`)."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+
+    def create_state(self, index, weight):
+        from .. import random as _rnd
+        return NDArray(_rnd.next_key())
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        weight._data, state._data = _sgld(
+            weight._data, state._data, grad._data, lr, wd,
+            self.rescale_grad, _c(self.clip_gradient))
+
+
+@register
+class Adam(Optimizer):
+    """reference `optimizer.py:1420`; kernel `optimizer_op.cc` adam_update."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, lazy_update=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+        self.lazy_update = lazy_update
+
+    def create_state(self, index, weight):
+        return (_zeros_like(weight), _zeros_like(weight))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        m, v = state
+        weight._data, m._data, v._data = _adam(
+            weight._data, m._data, v._data, grad._data, lr, wd, self.beta1,
+            self.beta2, self.epsilon, self.rescale_grad,
+            _c(self.clip_gradient), t)
+
+
+@register
+class AdaGrad(Optimizer):
+    """reference `optimizer.py:1504`."""
+
+    def __init__(self, eps=1e-7, **kwargs):
+        super().__init__(**kwargs)
+        self.float_stable_eps = eps
+
+    def create_state(self, index, weight):
+        return _zeros_like(weight)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        weight._data, state._data = _adagrad(
+            weight._data, state._data, grad._data, lr, wd,
+            self.float_stable_eps, self.rescale_grad, _c(self.clip_gradient))
+
+
+@register
+class RMSProp(Optimizer):
+    """reference `optimizer.py:1563` (centered=True uses Alex Graves'
+    variant with mean-grad + momentum states)."""
+
+    def __init__(self, learning_rate=0.001, gamma1=0.9, gamma2=0.9,
+                 epsilon=1e-8, centered=False, clip_weights=None, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.gamma1 = gamma1
+        self.gamma2 = gamma2
+        self.centered = centered
+        self.epsilon = epsilon
+        self.clip_weights = clip_weights
+
+    def create_state(self, index, weight):
+        if self.centered:
+            return (_zeros_like(weight), _zeros_like(weight),
+                    _zeros_like(weight))
+        return _zeros_like(weight)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        if self.centered:
+            n, gm, delta = state
+            weight._data, n._data, gm._data, delta._data = _rmsprop_alex(
+                weight._data, n._data, gm._data, delta._data, grad._data,
+                lr, wd, self.gamma1, self.gamma2, self.epsilon,
+                self.rescale_grad, _c(self.clip_gradient))
+        else:
+            weight._data, state._data = _rmsprop(
+                weight._data, state._data, grad._data, lr, wd, self.gamma1,
+                self.epsilon, self.rescale_grad, _c(self.clip_gradient))
+        if self.clip_weights:
+            weight._data = jnp.clip(weight._data, -self.clip_weights,
+                                    self.clip_weights)
+
+
+@register
+class AdaDelta(Optimizer):
+    """reference `optimizer.py:1641`."""
+
+    def __init__(self, rho=0.90, epsilon=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.rho = rho
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (_zeros_like(weight), _zeros_like(weight))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        wd = self._get_wd(index)
+        acc_g, acc_delta = state
+        weight._data, acc_g._data, acc_delta._data = _adadelta(
+            weight._data, acc_g._data, acc_delta._data, grad._data, wd,
+            self.rho, self.epsilon, self.rescale_grad, _c(self.clip_gradient))
+
+
+@register
+class Ftrl(Optimizer):
+    """reference `optimizer.py:1701`."""
+
+    def __init__(self, lamda1=0.01, learning_rate=0.1, beta=1, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.lamda1 = lamda1
+        self.beta = beta
+
+    def create_state(self, index, weight):
+        return (_zeros_like(weight), _zeros_like(weight))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        z, n = state
+        weight._data, z._data, n._data = _ftrl(
+            weight._data, z._data, n._data, grad._data, lr, wd, self.lamda1,
+            self.beta, self.rescale_grad, _c(self.clip_gradient))
+
+
+@register
+class Adamax(Optimizer):
+    """reference `optimizer.py:1777`."""
+
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+
+    def create_state(self, index, weight):
+        return (_zeros_like(weight), _zeros_like(weight))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        m, u = state
+        weight._data, m._data, u._data = _adamax(
+            weight._data, m._data, u._data, grad._data, lr, wd, self.beta1,
+            self.beta2, self.rescale_grad, _c(self.clip_gradient), t)
+
+
+@register
+class Nadam(Optimizer):
+    """reference `optimizer.py:1834`."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, schedule_decay=0.004, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+        self.schedule_decay = schedule_decay
+        self.m_schedule = 1.0
+
+    def create_state(self, index, weight):
+        return (_zeros_like(weight), _zeros_like(weight))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        mu_t = self.beta1 * (1.0 - 0.5 * 0.96 ** (t * self.schedule_decay))
+        mu_t1 = self.beta1 * (1.0 - 0.5 * 0.96 ** ((t + 1) * self.schedule_decay))
+        self.m_schedule = self.m_schedule * mu_t
+        m_schedule_next = self.m_schedule * mu_t1
+        m, v = state
+        weight._data, m._data, v._data = _nadam(
+            weight._data, m._data, v._data, grad._data, lr, wd, self.beta1,
+            self.beta2, self.epsilon, self.m_schedule, m_schedule_next,
+            self.rescale_grad, _c(self.clip_gradient), t)
+
+
+@register
+class LAMB(Optimizer):
+    """Layer-wise adaptive moments for batch training (reference
+    `optimizer.py` LAMB, MXNet 1.6)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-6, lower_bound=None, upper_bound=None,
+                 bias_correction=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+        self.lower_bound = lower_bound
+        self.upper_bound = upper_bound
+        self.bias_correction = bias_correction
+
+    def create_state(self, index, weight):
+        return (_zeros_like(weight), _zeros_like(weight))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        m, v = state
+        weight._data, m._data, v._data = _lamb(
+            weight._data, m._data, v._data, grad._data, lr, wd, self.beta1,
+            self.beta2, self.epsilon, t,
+            0.0 if self.lower_bound is None else self.lower_bound,
+            _INF if self.upper_bound is None else self.upper_bound,
+            1.0 if self.bias_correction else 0.0,
+            self.rescale_grad, _c(self.clip_gradient))
+
+
+@register
+class Test(Optimizer):
+    """reference `optimizer.py` Test optimizer (for unit tests)."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+
+    def create_state(self, index, weight):
+        return _zeros_like(weight)
+
+    def update(self, index, weight, grad, state):
+        weight._data = weight._data - self.rescale_grad * grad._data
+
+
+# aliases matching reference registry names
+_OPT_REGISTRY["ccsgd"] = SGD
+_OPT_REGISTRY["adamw"] = LAMB
+
+
+class Updater:
+    """KVStore updater closure (reference `optimizer.py:2046` get_updater)."""
+
+    def __init__(self, optimizer):
+        self.optimizer = optimizer
+        self.states = {}
+        self.states_synced = {}
+        self.aggregate_updates = optimizer.aggregate_num > 0
+
+    def __call__(self, index, grad, weight):
+        if index not in self.states:
+            self.states[index] = \
+                self.optimizer.create_state_multi_precision(index, weight)
+            self.states_synced[index] = True
+        self.optimizer.update_multi_precision(index, weight, grad,
+                                              self.states[index])
+
+    def sync_state_context(self, state, context):
+        return state
+
+    def set_states(self, states):
+        import pickle
+        states = pickle.loads(states)
+        if isinstance(states, tuple) and len(states) == 2:
+            self.states, self.optimizer = states
+        else:
+            self.states = states
+        self.states_synced = dict.fromkeys(self.states.keys(), False)
+
+    def get_states(self, dump_optimizer=False):
+        import pickle
+        return pickle.dumps((self.states, self.optimizer)
+                            if dump_optimizer else self.states)
+
+
+def get_updater(optimizer):
+    return Updater(optimizer)
