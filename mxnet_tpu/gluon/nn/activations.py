@@ -37,7 +37,9 @@ class PReLU(HybridBlock):
                                          init=alpha_initializer)
 
     def hybrid_forward(self, F, x, alpha):
-        return F.LeakyReLU(x, gamma=alpha, act_type="prelu")
+        # gamma is positional: tensor args must be positional for the op
+        # registry to record them on the tape (grads flow to alpha)
+        return F.LeakyReLU(x, alpha, act_type="prelu")
 
 
 class ELU(HybridBlock):

@@ -497,7 +497,9 @@ class DCASGD(Optimizer):
         self.lamda = lamda
 
     def create_state(self, index, weight):
-        return (NDArray(weight._data, ctx=weight._ctx), _zeros_like(weight))
+        # prev_w must be its own buffer: it is donated separately from w
+        return (NDArray(jnp.array(weight._data, copy=True),
+                        ctx=weight._ctx), _zeros_like(weight))
 
     def update(self, index, weight, grad, state):
         self._update_count(index)

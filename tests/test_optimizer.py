@@ -1,0 +1,182 @@
+"""Optimizer tests — semantics from the reference
+`tests/python/unittest/test_optimizer.py` (numeric parity vs. hand-rolled
+numpy reference updates)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import optimizer as opt
+
+
+def _run_steps(name, kwargs, steps=5, shape=(10,), seed=0):
+    np.random.seed(seed)
+    w0 = np.random.randn(*shape).astype("float32")
+    grads = [np.random.randn(*shape).astype("float32") for _ in range(steps)]
+    o = opt.create(name, **kwargs)
+    w = mx.nd.array(w0.copy())
+    state = o.create_state(0, w)
+    for g in grads:
+        o.update(0, w, mx.nd.array(g), state)
+    return w0, grads, w.asnumpy()
+
+
+def test_sgd_matches_numpy():
+    w0, grads, got = _run_steps("sgd", {"learning_rate": 0.1,
+                                        "momentum": 0.9, "wd": 0.01})
+    w = w0.copy()
+    mom = np.zeros_like(w)
+    for g in grads:
+        g = g + 0.01 * w
+        mom = 0.9 * mom - 0.1 * g
+        w = w + mom
+    np.testing.assert_allclose(got, w, rtol=1e-5, atol=1e-6)
+
+
+def test_sgd_no_momentum():
+    w0, grads, got = _run_steps("sgd", {"learning_rate": 0.5})
+    w = w0.copy()
+    for g in grads:
+        w = w - 0.5 * g
+    np.testing.assert_allclose(got, w, rtol=1e-5, atol=1e-6)
+
+
+def test_adam_matches_numpy():
+    lr, b1, b2, eps = 1e-2, 0.9, 0.999, 1e-8
+    w0, grads, got = _run_steps("adam", {"learning_rate": lr})
+    w = w0.copy()
+    m = np.zeros_like(w)
+    v = np.zeros_like(w)
+    for t, g in enumerate(grads, 1):
+        m = b1 * m + (1 - b1) * g
+        v = b2 * v + (1 - b2) * g * g
+        lr_t = lr * np.sqrt(1 - b2 ** t) / (1 - b1 ** t)
+        w = w - lr_t * m / (np.sqrt(v) + eps)
+    np.testing.assert_allclose(got, w, rtol=1e-5, atol=1e-6)
+
+
+def test_nag():
+    lr, mom = 0.1, 0.9
+    w0, grads, got = _run_steps("nag", {"learning_rate": lr,
+                                        "momentum": mom})
+    w = w0.copy()
+    m = np.zeros_like(w)
+    for g in grads:
+        m = mom * m + g
+        w = w - lr * (g + mom * m)
+    np.testing.assert_allclose(got, w, rtol=1e-5, atol=1e-6)
+
+
+def test_rmsprop():
+    lr, rho, eps = 0.01, 0.9, 1e-8
+    w0, grads, got = _run_steps("rmsprop", {"learning_rate": lr,
+                                            "gamma1": rho, "epsilon": eps})
+    w = w0.copy()
+    n = np.zeros_like(w)
+    for g in grads:
+        n = rho * n + (1 - rho) * g * g
+        w = w - lr * g / np.sqrt(n + eps)
+    np.testing.assert_allclose(got, w, rtol=1e-5, atol=1e-6)
+
+
+def test_adagrad():
+    lr, eps = 0.1, 1e-7
+    w0, grads, got = _run_steps("adagrad", {"learning_rate": lr})
+    w = w0.copy()
+    h = np.zeros_like(w)
+    for g in grads:
+        h += g * g
+        w = w - lr * g / (np.sqrt(h) + eps)
+    np.testing.assert_allclose(got, w, rtol=1e-5, atol=1e-6)
+
+
+def test_clip_and_rescale():
+    o = opt.create("sgd", learning_rate=1.0, rescale_grad=0.5,
+                   clip_gradient=0.1)
+    w = mx.nd.array(np.zeros(3, "float32"))
+    g = mx.nd.array(np.array([10.0, -10.0, 0.1], "float32"))
+    o.update(0, w, g, None)
+    np.testing.assert_allclose(w.asnumpy(), [-0.1, 0.1, -0.05], rtol=1e-6)
+
+
+@pytest.mark.parametrize("name", ["sgd", "adam", "rmsprop", "adagrad",
+                                  "adadelta", "adamax", "nadam", "ftrl",
+                                  "ftml", "signum", "nag", "lars", "lamb",
+                                  "dcasgd", "sgld"])
+def test_all_optimizers_run_and_move_weights(name):
+    o = opt.create(name, learning_rate=0.05)
+    np.random.seed(1)
+    w = mx.nd.array(np.random.randn(8, 4).astype("float32"))
+    before = w.asnumpy().copy()
+    state = o.create_state(0, w)
+    for _ in range(3):
+        g = mx.nd.array(np.random.randn(8, 4).astype("float32"))
+        o.update(0, w, g, state)
+    after = w.asnumpy()
+    assert np.isfinite(after).all()
+    assert not np.allclose(before, after)
+
+
+def test_lr_mult_wd_mult():
+    o = opt.create("sgd", learning_rate=1.0)
+    o.idx2name = {0: "a_weight", 1: "b_weight"}
+    o.set_lr_mult({"a_weight": 0.1})
+    o.set_wd_mult({"b_weight": 2.0})
+    assert o._get_lr(0) == pytest.approx(0.1)
+    assert o._get_lr(1) == pytest.approx(1.0)
+    assert o._get_wd(1) == pytest.approx(0.0)
+
+
+def test_multi_precision_bf16():
+    o = opt.create("sgd", learning_rate=0.1, multi_precision=True)
+    w = mx.nd.array(np.ones(4, "float32")).astype("bfloat16")
+    state = o.create_state_multi_precision(0, w)
+    assert isinstance(state, tuple)
+    master = state[0]
+    assert master.dtype == np.float32
+    g = mx.nd.array(np.full(4, 0.001, "float32")).astype("bfloat16")
+    for _ in range(10):
+        o.update_multi_precision(0, w, g, state)
+    # master accumulates small updates that bf16 alone would lose
+    np.testing.assert_allclose(master.asnumpy(), 1.0 - 0.1 * 0.001 * 10,
+                               rtol=1e-2)
+
+
+def test_lr_scheduler_factor():
+    from mxnet_tpu.lr_scheduler import (FactorScheduler, MultiFactorScheduler,
+                                        PolyScheduler, CosineScheduler)
+    s = FactorScheduler(step=10, factor=0.5, base_lr=1.0)
+    assert s(1) == 1.0
+    assert s(25) == pytest.approx(0.25)
+    m = MultiFactorScheduler(step=[5, 15], factor=0.1, base_lr=1.0)
+    assert m(2) == pytest.approx(1.0)
+    assert m(10) == pytest.approx(0.1)
+    assert m(20) == pytest.approx(0.01)
+    p = PolyScheduler(max_update=100, base_lr=1.0, pwr=1)
+    assert p(0) == pytest.approx(1.0)
+    assert p(50) == pytest.approx(0.5)
+    c = CosineScheduler(max_update=100, base_lr=1.0)
+    assert c(0) == pytest.approx(1.0)
+    assert c(100) == pytest.approx(0.0, abs=1e-6)
+
+
+def test_scheduler_warmup():
+    from mxnet_tpu.lr_scheduler import PolyScheduler
+    s = PolyScheduler(max_update=100, base_lr=1.0, warmup_steps=10,
+                      warmup_begin_lr=0.0)
+    assert s(0) == pytest.approx(0.0)
+    assert s(5) == pytest.approx(0.5)
+
+
+def test_optimizer_in_trainer_with_scheduler():
+    from mxnet_tpu import gluon, autograd as ag
+    from mxnet_tpu.lr_scheduler import FactorScheduler
+    p = gluon.Parameter("w", shape=(2,))
+    p.initialize(ctx=mx.cpu())
+    sched = FactorScheduler(step=2, factor=0.5, base_lr=0.1)
+    tr = gluon.Trainer({"w": p}, "sgd", {"learning_rate": 0.1,
+                                         "lr_scheduler": sched})
+    for _ in range(3):
+        with ag.record():
+            (p.data().sum()).backward()
+        tr.step(1)
+    assert np.isfinite(p.data().asnumpy()).all()
