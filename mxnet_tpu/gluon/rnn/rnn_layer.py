@@ -1,0 +1,213 @@
+"""Fused recurrent layers: RNN / LSTM / GRU.
+
+Parity surface: reference ``python/mxnet/gluon/rnn/rnn_layer.py`` (_RNNLayer
+base; parameter naming {l,r}{i}_{i2h,h2h}_{weight,bias} so checkpoints map
+1:1; layouts TNC/NTC; bidirectional; multi-layer; begin_state).
+Backend: `mxnet_tpu.ops.rnn.rnn_scan_layer` (lax.scan) instead of the
+reference's cuDNN fused kernel (`src/operator/rnn-inl.h:414`).
+"""
+from __future__ import annotations
+
+from ... import initializer as init_mod
+from ..block import HybridBlock
+from ...ndarray import ndarray as _nd
+
+__all__ = ["RNN", "LSTM", "GRU"]
+
+
+class _RNNLayer(HybridBlock):
+    def __init__(self, hidden_size, num_layers, layout, dropout,
+                 bidirectional, input_size, i2h_weight_initializer,
+                 h2h_weight_initializer, i2h_bias_initializer,
+                 h2h_bias_initializer, mode, projection_size=None, **kwargs):
+        self._mode = mode  # before super(): _alias() runs during Block init
+        super().__init__(**kwargs)
+        assert layout in ("TNC", "NTC"), \
+            "Invalid layout %s; must be one of ['TNC' or 'NTC']" % layout
+        self._hidden_size = hidden_size
+        self._projection_size = projection_size
+        self._num_layers = num_layers
+        self._mode = mode
+        self._layout = layout
+        self._dropout = dropout
+        self._dir = 2 if bidirectional else 1
+        self._input_size = input_size
+        self._i2h_weight_initializer = i2h_weight_initializer
+        self._h2h_weight_initializer = h2h_weight_initializer
+        self._i2h_bias_initializer = i2h_bias_initializer
+        self._h2h_bias_initializer = h2h_bias_initializer
+        self._gates = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}[mode]
+        ng, ni, nh = self._gates, input_size, hidden_size
+        for i in range(num_layers):
+            for j in ["l", "r"][:self._dir]:
+                self._register_param("{}{}_i2h_weight".format(j, i),
+                                     shape=(ng * nh, ni),
+                                     init=i2h_weight_initializer)
+                self._register_param("{}{}_h2h_weight".format(j, i),
+                                     shape=(ng * nh, nh),
+                                     init=h2h_weight_initializer)
+                self._register_param("{}{}_i2h_bias".format(j, i),
+                                     shape=(ng * nh,),
+                                     init=i2h_bias_initializer)
+                self._register_param("{}{}_h2h_bias".format(j, i),
+                                     shape=(ng * nh,),
+                                     init=h2h_bias_initializer)
+            ni = nh * self._dir
+
+    def _register_param(self, name, shape, init):
+        p = self.params.get(name, shape=shape, init=init,
+                            allow_deferred_init=True)
+        setattr(self, name, p)
+
+    def __repr__(self):
+        s = "{name}({mapping}, {_layout}"
+        if self._num_layers != 1:
+            s += ", num_layers={_num_layers}"
+        if self._dropout != 0:
+            s += ", dropout={_dropout}"
+        if self._dir == 2:
+            s += ", bidirectional"
+        s += ")"
+        shape = self.l0_i2h_weight.shape
+        mapping = "{0} -> {1}".format(
+            shape[1] if shape[1] else None, shape[0] // self._gates)
+        return s.format(name=self.__class__.__name__, mapping=mapping,
+                        **self.__dict__)
+
+    def _alias(self):
+        return self._mode
+
+    def state_info(self, batch_size=0):
+        raise NotImplementedError
+
+    def infer_shape(self, inputs, *states):
+        ni = inputs.shape[2] if self._layout == "TNC" else inputs.shape[2]
+        ng, nh = self._gates, self._hidden_size
+        for i in range(self._num_layers):
+            for j in ["l", "r"][:self._dir]:
+                getattr(self, "{}{}_i2h_weight".format(j, i)).shape = \
+                    (ng * nh, ni)
+            ni = nh * self._dir
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        """Initial recurrent states (reference rnn_layer.py begin_state)."""
+        states = []
+        for i, info in enumerate(self.state_info(batch_size)):
+            if info is not None:
+                info.update(kwargs)
+            else:
+                info = kwargs
+            shape = info.pop("shape")
+            states.append(_nd.zeros(shape, **{k: v for k, v in info.items()
+                                              if k in ("dtype", "ctx")}))
+        return states
+
+    def hybrid_forward(self, F, inputs, states=None, **params):
+        if self._layout == "NTC":
+            inputs = F.swapaxes(inputs, dim1=0, dim2=1)
+        batch_size = inputs.shape[1]
+        skip_states = states is None
+        if skip_states:
+            states = self.begin_state(batch_size, ctx=inputs.ctx,
+                                      dtype=inputs.dtype)
+        if isinstance(states, _nd.NDArray):
+            states = [states]
+        out, new_states = self._forward_kernel(F, inputs, states, params)
+        if self._layout == "NTC":
+            out = F.swapaxes(out, dim1=0, dim2=1)
+        if skip_states:
+            return out
+        return out, new_states
+
+    def _forward_kernel(self, F, inputs, states, params):
+        """Stack layers/directions over the scan primitive."""
+        ns = len(states)
+        h_all = states[0]
+        c_all = states[1] if ns > 1 else None
+        x = inputs
+        h_outs, c_outs = [], []
+        for i in range(self._num_layers):
+            dir_outs = []
+            for d, j in enumerate(["l", "r"][:self._dir]):
+                idx = i * self._dir + d
+                w_ih = params["{}{}_i2h_weight".format(j, i)]
+                w_hh = params["{}{}_h2h_weight".format(j, i)]
+                b_ih = params["{}{}_i2h_bias".format(j, i)]
+                b_hh = params["{}{}_h2h_bias".format(j, i)]
+                h0 = h_all[idx]
+                if self._mode == "lstm":
+                    y, hT, cT = F._rnn_scan_layer(
+                        x, w_ih, w_hh, b_ih, b_hh, h0, c_all[idx],
+                        mode=self._mode, reverse=(d == 1))
+                    c_outs.append(cT)
+                else:
+                    y, hT = F._rnn_scan_layer(
+                        x, w_ih, w_hh, b_ih, b_hh, h0,
+                        mode=self._mode, reverse=(d == 1))
+                h_outs.append(hT)
+                dir_outs.append(y)
+            x = dir_outs[0] if self._dir == 1 else \
+                F.concat(*dir_outs, dim=2)
+            if self._dropout and i < self._num_layers - 1:
+                x = F.Dropout(x, p=self._dropout)
+        new_states = [F.stack(*h_outs, axis=0)]
+        if self._mode == "lstm":
+            new_states.append(F.stack(*c_outs, axis=0))
+        return x, new_states
+
+
+class RNN(_RNNLayer):
+    """Elman RNN (reference rnn_layer.py:287)."""
+
+    def __init__(self, hidden_size, num_layers=1, activation="relu",
+                 layout="TNC", dropout=0, bidirectional=False,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 input_size=0, **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, i2h_weight_initializer,
+                         h2h_weight_initializer, i2h_bias_initializer,
+                         h2h_bias_initializer, "rnn_" + activation, **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), "__layout__": "LNC"}]
+
+
+class LSTM(_RNNLayer):
+    """LSTM (reference rnn_layer.py:388)."""
+
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 projection_size=None, **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, i2h_weight_initializer,
+                         h2h_weight_initializer, i2h_bias_initializer,
+                         h2h_bias_initializer, "lstm", projection_size,
+                         **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), "__layout__": "LNC"},
+                {"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), "__layout__": "LNC"}]
+
+
+class GRU(_RNNLayer):
+    """GRU (reference rnn_layer.py:499)."""
+
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, i2h_weight_initializer,
+                         h2h_weight_initializer, i2h_bias_initializer,
+                         h2h_bias_initializer, "gru", **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), "__layout__": "LNC"}]

@@ -11,6 +11,7 @@ from . import core      # noqa: F401  (registers core tensor ops)
 from . import nn        # noqa: F401  (registers NN ops)
 from . import contrib_ops  # noqa: F401
 from . import ctc       # noqa: F401  (CTC loss dynamic program)
+from . import rnn       # noqa: F401  (fused RNN scan layers)
 
 
 def populate_namespace(target, names=None):

@@ -1,0 +1,146 @@
+"""RNN layer/cell tests — semantics from reference
+`tests/python/unittest/test_gluon_rnn.py`."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd as ag, gluon
+from mxnet_tpu.gluon import rnn, nn
+
+
+def test_rnn_cells_unroll():
+    for cell_t, nstate in [(rnn.RNNCell, 1), (rnn.LSTMCell, 2),
+                           (rnn.GRUCell, 1)]:
+        cell = cell_t(16, input_size=8)
+        cell.initialize()
+        inputs = [mx.nd.array(np.random.rand(4, 8).astype("float32"))
+                  for _ in range(3)]
+        outputs, states = cell.unroll(3, inputs)
+        assert len(outputs) == 3
+        assert outputs[0].shape == (4, 16)
+        assert len(states) == nstate
+
+
+def test_lstm_cell_step():
+    cell = rnn.LSTMCell(16)
+    cell.initialize()
+    x = mx.nd.array(np.random.rand(4, 8).astype("float32"))
+    states = cell.begin_state(4)
+    out, new_states = cell(x, states)
+    assert out.shape == (4, 16)
+    assert new_states[0].shape == (4, 16)
+    assert new_states[1].shape == (4, 16)
+
+
+def test_sequential_rnn_cell():
+    stack = rnn.SequentialRNNCell()
+    stack.add(rnn.LSTMCell(8, input_size=4))
+    stack.add(rnn.LSTMCell(8, input_size=8))
+    stack.initialize()
+    inputs = [mx.nd.array(np.random.rand(2, 4).astype("float32"))
+              for _ in range(3)]
+    outputs, states = stack.unroll(3, inputs)
+    assert outputs[-1].shape == (2, 8)
+    assert len(states) == 4
+
+
+def test_residual_zoneout_dropout_cells():
+    base = rnn.GRUCell(8, input_size=8)
+    res = rnn.ResidualCell(base)
+    res.initialize()
+    inputs = [mx.nd.array(np.random.rand(2, 8).astype("float32"))
+              for _ in range(2)]
+    outputs, _ = res.unroll(2, inputs)
+    assert outputs[0].shape == (2, 8)
+
+    d = rnn.DropoutCell(0.5)
+    out, st = d(inputs[0], [])
+    assert out.shape == (2, 8)
+
+    z = rnn.ZoneoutCell(rnn.LSTMCell(8, input_size=8), 0.2, 0.2)
+    z.initialize()
+    outputs, _ = z.unroll(2, inputs)
+    assert outputs[0].shape == (2, 8)
+
+
+def test_bidirectional_cell():
+    bi = rnn.BidirectionalCell(rnn.LSTMCell(4, input_size=4),
+                               rnn.LSTMCell(4, input_size=4))
+    bi.initialize()
+    inputs = [mx.nd.array(np.random.rand(2, 4).astype("float32"))
+              for _ in range(3)]
+    outputs, states = bi.unroll(3, inputs)
+    assert outputs[0].shape == (2, 8)
+
+
+@pytest.mark.parametrize("layer_t,mode_states", [
+    (rnn.LSTM, 2), (rnn.GRU, 1), (rnn.RNN, 1)])
+def test_rnn_layers_shapes(layer_t, mode_states):
+    layer = layer_t(16, num_layers=2, input_size=8)
+    layer.initialize()
+    x = mx.nd.array(np.random.rand(5, 3, 8).astype("float32"))  # TNC
+    out = layer(x)
+    assert out.shape == (5, 3, 16)
+    states = layer.begin_state(3)
+    out, new_states = layer(x, states)
+    assert out.shape == (5, 3, 16)
+    assert len(new_states) == mode_states
+    assert new_states[0].shape == (2, 3, 16)
+
+
+def test_rnn_layer_bidirectional_ntc():
+    layer = rnn.LSTM(16, num_layers=1, bidirectional=True, layout="NTC",
+                     input_size=8)
+    layer.initialize()
+    x = mx.nd.array(np.random.rand(3, 5, 8).astype("float32"))
+    out = layer(x)
+    assert out.shape == (3, 5, 32)
+
+
+def test_rnn_layer_gradient_flows():
+    layer = rnn.LSTM(8, input_size=4)
+    layer.initialize()
+    x = mx.nd.array(np.random.rand(6, 2, 4).astype("float32"))
+    with ag.record():
+        out = layer(x)
+        out.sum().backward()
+    g = layer.l0_i2h_weight.grad().asnumpy()
+    assert np.isfinite(g).all() and np.abs(g).sum() > 0
+
+
+def test_lstm_layer_matches_cell_unroll():
+    """Fused scan layer must agree with step-by-step cell unroll."""
+    np.random.seed(0)
+    layer = rnn.LSTM(8, input_size=4)
+    layer.initialize()
+    cell = rnn.LSTMCell(8, input_size=4)
+    cell.initialize()
+    # copy layer params into cell
+    cell.i2h_weight.set_data(layer.l0_i2h_weight.data())
+    cell.h2h_weight.set_data(layer.l0_h2h_weight.data())
+    cell.i2h_bias.set_data(layer.l0_i2h_bias.data())
+    cell.h2h_bias.set_data(layer.l0_h2h_bias.data())
+    x = mx.nd.array(np.random.rand(5, 2, 4).astype("float32"))
+    out_layer = layer(x).asnumpy()
+    inputs = [mx.nd.array(x.asnumpy()[t]) for t in range(5)]
+    outs, _ = cell.unroll(5, inputs)
+    out_cell = np.stack([o.asnumpy() for o in outs], axis=0)
+    np.testing.assert_allclose(out_layer, out_cell, rtol=1e-4, atol=1e-5)
+
+
+def test_rnn_layer_deferred_init():
+    layer = rnn.GRU(8)
+    layer.initialize()
+    x = mx.nd.array(np.random.rand(5, 2, 4).astype("float32"))
+    assert layer(x).shape == (5, 2, 8)
+    assert layer.l0_i2h_weight.shape == (24, 4)
+
+
+def test_rnn_layer_hybridize():
+    layer = rnn.LSTM(8, input_size=4)
+    layer.initialize()
+    x = mx.nd.array(np.random.rand(5, 2, 4).astype("float32"))
+    ref = layer(x).asnumpy()
+    layer.hybridize()
+    out = layer(x).asnumpy()
+    np.testing.assert_allclose(ref, out, rtol=1e-5, atol=1e-6)
