@@ -246,6 +246,10 @@ class Parameter:
         for d in self._data:
             val = data._data if isinstance(data, NDArray) else data
             import jax
+            import jax.numpy as jnp
+            # copy=True: the new buffer must not alias the source — the
+            # optimizer kernels donate parameter buffers in place
+            val = jnp.array(val, copy=True)
             d._data = jax.device_put(val, d.ctx.jax_device).astype(d.dtype)
 
     def row_sparse_data(self, row_id):

@@ -1,0 +1,5 @@
+"""First-class model families beyond the vision zoo."""
+from .transformer import (TransformerLM, MultiHeadAttention,
+                          TransformerEncoderLayer, transformer_lm_tiny,
+                          transformer_lm_small, transformer_lm_base, tp_rules)
+from .lstm_lm import RNNModel

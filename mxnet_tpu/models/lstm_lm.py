@@ -1,0 +1,56 @@
+"""LSTM word language model (reference
+`example/gluon/word_language_model/model.py` RNNModel)."""
+from __future__ import annotations
+
+from ..gluon.block import HybridBlock
+from ..gluon import nn, rnn
+
+__all__ = ["RNNModel"]
+
+
+class RNNModel(HybridBlock):
+    """Embedding → (LSTM/GRU/RNN) → Dense decoder, optional tied weights."""
+
+    def __init__(self, mode="lstm", vocab_size=10000, num_embed=200,
+                 num_hidden=200, num_layers=2, dropout=0.5, tie_weights=False,
+                 **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.drop = nn.Dropout(dropout)
+            self.encoder = nn.Embedding(
+                vocab_size, num_embed,
+                weight_initializer=None)
+            if mode == "lstm":
+                self.rnn = rnn.LSTM(num_hidden, num_layers, dropout=dropout,
+                                    input_size=num_embed)
+            elif mode == "gru":
+                self.rnn = rnn.GRU(num_hidden, num_layers, dropout=dropout,
+                                   input_size=num_embed)
+            else:
+                self.rnn = rnn.RNN(num_hidden, num_layers, dropout=dropout,
+                                   input_size=num_embed,
+                                   activation="relu" if mode == "rnn_relu"
+                                   else "tanh")
+            if tie_weights:
+                assert num_embed == num_hidden
+                self.decoder = nn.Dense(vocab_size, flatten=False,
+                                        params=self.encoder.params)
+            else:
+                self.decoder = nn.Dense(vocab_size, flatten=False,
+                                        in_units=num_hidden)
+            self.num_hidden = num_hidden
+
+    def hybrid_forward(self, F, inputs, hidden=None):
+        # inputs: (T, B) int tokens
+        emb = self.drop(self.encoder(inputs))
+        if hidden is None:
+            output = self.rnn(emb)
+            output = self.drop(output)
+            return self.decoder(output)
+        output, hidden = self.rnn(emb, hidden)
+        output = self.drop(output)
+        decoded = self.decoder(output)
+        return decoded, hidden
+
+    def begin_state(self, *args, **kwargs):
+        return self.rnn.begin_state(*args, **kwargs)

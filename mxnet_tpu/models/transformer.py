@@ -1,0 +1,136 @@
+"""Transformer language model (flagship model for the TPU build).
+
+The reference ships LSTM/attention examples built from ops
+(`example/gluon/word_language_model`, `example/nmt`); this provides the
+modern equivalent as a first-class Gluon model, designed mesh-first:
+parameter names carry `qkv`/`proj`/`ffn_up`/`ffn_down` markers so
+tensor-parallel PartitionSpec rules (mxnet_tpu.parallel.shard_params) apply
+by regex — the Megatron split: qkv/ffn_up column-sharded on 'tp', proj/
+ffn_down row-sharded — and attention routes through the
+`_contrib_dot_product_attention` op (swappable for the pallas flash kernel
+/ ring attention under sequence parallelism).
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from ..gluon.block import HybridBlock
+from ..gluon import nn
+
+__all__ = ["MultiHeadAttention", "TransformerEncoderLayer", "TransformerLM",
+           "transformer_lm_tiny", "transformer_lm_small", "transformer_lm_base"]
+
+
+class MultiHeadAttention(HybridBlock):
+    def __init__(self, units, num_heads, dropout=0.0, causal=True, **kwargs):
+        super().__init__(**kwargs)
+        assert units % num_heads == 0
+        self._units = units
+        self._num_heads = num_heads
+        self._dropout = dropout
+        self._causal = causal
+        with self.name_scope():
+            self.qkv = nn.Dense(3 * units, flatten=False, use_bias=False,
+                                in_units=units, prefix="qkv_")
+            self.proj = nn.Dense(units, flatten=False, use_bias=False,
+                                 in_units=units, prefix="proj_")
+
+    def hybrid_forward(self, F, x):
+        # x: (B, T, C)
+        B, T, C = x.shape
+        H = self._num_heads
+        qkv = self.qkv(x)  # (B, T, 3C)
+        qkv = qkv.reshape((B, T, 3, H, C // H))
+        qkv = F.transpose(qkv, axes=(2, 0, 3, 1, 4))  # (3, B, H, T, D)
+        q, k, v = qkv[0], qkv[1], qkv[2]
+        out = F._contrib_dot_product_attention(
+            q, k, v, dropout=self._dropout, causal=self._causal)
+        out = F.transpose(out, axes=(0, 2, 1, 3)).reshape((B, T, C))
+        return self.proj(out)
+
+
+class TransformerEncoderLayer(HybridBlock):
+    """Pre-norm block (attention + MLP)."""
+
+    def __init__(self, units, num_heads, hidden_size, dropout=0.0,
+                 causal=True, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.ln1 = nn.LayerNorm(in_channels=units)
+            self.attn = MultiHeadAttention(units, num_heads, dropout, causal)
+            self.ln2 = nn.LayerNorm(in_channels=units)
+            self.ffn_up = nn.Dense(hidden_size, flatten=False,
+                                   in_units=units, prefix="ffn_up_")
+            self.ffn_down = nn.Dense(units, flatten=False,
+                                     in_units=hidden_size,
+                                     prefix="ffn_down_")
+            self.dropout = nn.Dropout(dropout)
+
+    def hybrid_forward(self, F, x):
+        x = x + self.dropout(self.attn(self.ln1(x)))
+        h = F.LeakyReLU(self.ffn_up(self.ln2(x)), act_type="gelu")
+        x = x + self.dropout(self.ffn_down(h))
+        return x
+
+
+class TransformerLM(HybridBlock):
+    """Decoder-only LM: embed → N blocks → norm → logits."""
+
+    def __init__(self, vocab_size, units=256, num_layers=4, num_heads=8,
+                 hidden_size=None, max_len=2048, dropout=0.0, **kwargs):
+        super().__init__(**kwargs)
+        hidden_size = hidden_size or 4 * units
+        self._units = units
+        self._max_len = max_len
+        with self.name_scope():
+            self.embed = nn.Embedding(vocab_size, units, prefix="embed_")
+            self.pos_embed = nn.Embedding(max_len, units, prefix="pos_")
+            self.blocks = nn.HybridSequential(prefix="blocks_")
+            with self.blocks.name_scope():
+                for _ in range(num_layers):
+                    self.blocks.add(TransformerEncoderLayer(
+                        units, num_heads, hidden_size, dropout))
+            self.ln_f = nn.LayerNorm(in_channels=units)
+            self.head = nn.Dense(vocab_size, flatten=False, use_bias=False,
+                                 in_units=units, prefix="head_")
+
+    def hybrid_forward(self, F, tokens):
+        # tokens: (B, T) int
+        B, T = tokens.shape
+        from .. import ndarray as nd
+        pos = nd.arange(0, T, dtype="int32")
+        x = self.embed(tokens) + self.pos_embed(pos)
+        x = self.blocks(x)
+        x = self.ln_f(x)
+        return self.head(x)
+
+
+def tp_rules(spec_cls=None):
+    """Megatron-style tensor-parallel rules for TransformerLM params."""
+    from jax.sharding import PartitionSpec as P
+    return [
+        (r"qkv_weight$", P("tp", None)),       # column parallel (out, in)
+        (r"ffn_up_weight$", P("tp", None)),
+        (r"proj_weight$", P(None, "tp")),      # row parallel
+        (r"ffn_down_weight$", P(None, "tp")),
+        (r"embed_weight$", P(None, "tp")),
+        (r"head_weight$", P("tp", None)),
+    ]
+
+
+def transformer_lm_tiny(vocab_size=1024, **kwargs):
+    return TransformerLM(vocab_size, units=64, num_layers=2, num_heads=4,
+                         max_len=256, **kwargs)
+
+
+def transformer_lm_small(vocab_size=32000, **kwargs):
+    return TransformerLM(vocab_size, units=512, num_layers=8, num_heads=8,
+                         **kwargs)
+
+
+def transformer_lm_base(vocab_size=32000, **kwargs):
+    """BERT-base scale (~110M) decoder."""
+    return TransformerLM(vocab_size, units=768, num_layers=12, num_heads=12,
+                         **kwargs)

@@ -1,0 +1,77 @@
+"""Device mesh management.
+
+Role parity: the reference's device topology layer
+(`src/kvstore/gpu_topology.h` link-matrix tree building + ctx lists in
+Module/Trainer). TPU-native: a named ``jax.sharding.Mesh`` with the
+standard axes — dp (data), tp (tensor), pp (pipeline), sp (sequence) — and
+PartitionSpec rules. XLA lays collectives on ICI along mesh axes; there is
+no topology detection code to write (the scaling-book recipe: pick a mesh,
+annotate shardings, let XLA insert collectives).
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+__all__ = ["MeshConfig", "make_mesh", "current_mesh", "set_mesh",
+           "replicated", "batch_sharding", "PartitionSpec", "NamedSharding"]
+
+_CURRENT = [None]
+
+AXES = ("dp", "pp", "tp", "sp")
+
+
+class MeshConfig:
+    """Sizes per logical axis; -1 on dp means 'use remaining devices'."""
+
+    def __init__(self, dp=-1, pp=1, tp=1, sp=1):
+        self.dp, self.pp, self.tp, self.sp = dp, pp, tp, sp
+
+    def resolve(self, n_devices):
+        fixed = self.pp * self.tp * self.sp
+        dp = self.dp
+        if dp == -1:
+            assert n_devices % fixed == 0, \
+                "device count %d not divisible by pp*tp*sp=%d" % (n_devices,
+                                                                  fixed)
+            dp = n_devices // fixed
+        assert dp * fixed == n_devices, \
+            "mesh %s does not cover %d devices" % (
+                (dp, self.pp, self.tp, self.sp), n_devices)
+        return (dp, self.pp, self.tp, self.sp)
+
+
+def make_mesh(dp=-1, pp=1, tp=1, sp=1, devices=None):
+    """Create a Mesh over the given (default: all) devices.
+
+    Axis order is (dp, pp, tp, sp): tp/sp innermost so tensor/sequence
+    collectives ride the fastest ICI links (scaling-book layout rule).
+    """
+    if devices is None:
+        devices = jax.devices()
+    shape = MeshConfig(dp, pp, tp, sp).resolve(len(devices))
+    arr = np.array(devices).reshape(shape)
+    mesh = Mesh(arr, AXES)
+    return mesh
+
+
+def set_mesh(mesh):
+    _CURRENT[0] = mesh
+    return mesh
+
+
+def current_mesh() -> Optional[Mesh]:
+    return _CURRENT[0]
+
+
+def replicated(mesh):
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def batch_sharding(mesh, axes=("dp",)):
+    """Shard the leading (batch) dim over the data axes."""
+    return NamedSharding(mesh, PartitionSpec(axes))

@@ -1,0 +1,200 @@
+"""Multi-chip sharding tests on the virtual 8-device CPU mesh (role of the
+reference's local-process distributed tests, `tests/nightly/dist_sync_kvstore.py`
+run via tools/launch.py — SURVEY §4)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, parallel
+from mxnet_tpu.gluon import nn
+
+
+def _devices():
+    return jax.devices()
+
+
+def test_mesh_creation():
+    assert len(_devices()) == 8
+    mesh = parallel.make_mesh(dp=4, tp=2)
+    assert mesh.shape == {"dp": 4, "pp": 1, "tp": 2, "sp": 1}
+    mesh2 = parallel.make_mesh()  # all devices on dp
+    assert mesh2.shape["dp"] == 8
+
+
+def test_sharded_trainer_dp_matches_single_device():
+    """DP training over 8 virtual chips must match single-device training
+    exactly (the reference asserts the same invariant for dist kvstore —
+    dist_sync_kvstore.py check_diff)."""
+    np.random.seed(0)
+    mx.random.seed(0)
+    X = np.random.randn(32, 10).astype("float32")
+    Y = np.random.randint(0, 4, 32)
+
+    def make_net():
+        net = nn.HybridSequential()
+        with net.name_scope():
+            net.add(nn.Dense(16, activation="relu", in_units=10),
+                    nn.Dense(4, in_units=16))
+        net.initialize(mx.init.Xavier())
+        return net
+
+    net1 = make_net()
+    # copy net1 params into net2 for identical init
+    net2 = make_net()
+    for p1, p2 in zip(net1.collect_params().values(),
+                      net2.collect_params().values()):
+        p2.set_data(p1.data())
+
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    # single-device reference via eager Trainer
+    from mxnet_tpu import autograd as ag
+    trainer = gluon.Trainer(net1.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    for i in range(3):
+        x = mx.nd.array(X)
+        y = mx.nd.array(Y)
+        with ag.record():
+            l = loss_fn(net1(x), y)
+        l.backward()
+        trainer.step(32)
+
+    # sharded trainer on 8-way dp mesh
+    mesh = parallel.make_mesh(dp=8)
+    st = parallel.ShardedTrainer(net2, loss_fn, "sgd",
+                                 {"learning_rate": 0.1}, mesh=mesh)
+    for i in range(3):
+        st.step(mx.nd.array(X), mx.nd.array(Y))
+    st.sync_back()
+
+    for p1, p2 in zip(net1.collect_params().values(),
+                      net2.collect_params().values()):
+        np.testing.assert_allclose(p1.data().asnumpy(), p2.data().asnumpy(),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_sharded_trainer_loss_decreases():
+    np.random.seed(1)
+    X = np.random.randn(64, 8).astype("float32")
+    W = np.random.randn(8, 4).astype("float32")
+    Y = (X @ W).argmax(1)
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(32, activation="relu", in_units=8),
+                nn.Dense(4, in_units=32))
+    net.initialize(mx.init.Xavier())
+    mesh = parallel.make_mesh(dp=8)
+    st = parallel.ShardedTrainer(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                                 "adam", {"learning_rate": 0.01}, mesh=mesh)
+    losses = [float(st.step(mx.nd.array(X), mx.nd.array(Y)).asnumpy())
+              for _ in range(20)]
+    assert losses[-1] < losses[0] * 0.5
+
+
+def test_tensor_parallel_transformer_step():
+    """dp=2 x tp=2 x sp=2-capable mesh; Megatron-sharded params compile and
+    run one step."""
+    from mxnet_tpu.models import transformer_lm_tiny, tp_rules
+    np.random.seed(0)
+    net = transformer_lm_tiny(vocab_size=64)
+    net.initialize(mx.init.Xavier())
+    tokens = np.random.randint(0, 64, (8, 16))
+    # resolve deferred shapes before sharding
+    net(mx.nd.array(tokens.astype("int32")))
+    mesh = parallel.make_mesh(dp=4, tp=2)
+
+    class _ShiftLoss(gluon.loss.Loss):
+        def __init__(self):
+            super().__init__(None, 0)
+            self._ce = gluon.loss.SoftmaxCrossEntropyLoss()
+
+        def hybrid_forward(self, F, logits, tokens):
+            return self._ce(logits[:, :-1].reshape((-3, 0)),
+                            tokens[:, 1:].reshape((-1,)))
+
+    st = parallel.ShardedTrainer(net, _ShiftLoss(), "adam",
+                                 {"learning_rate": 1e-3}, mesh=mesh,
+                                 param_rules=tp_rules())
+    l0 = float(st.step(mx.nd.array(tokens.astype("int32")),
+                       mx.nd.array(tokens.astype("int32"))).asnumpy())
+    l1 = float(st.step(mx.nd.array(tokens.astype("int32")),
+                       mx.nd.array(tokens.astype("int32"))).asnumpy())
+    assert np.isfinite([l0, l1]).all()
+    assert l1 < l0  # learning on repeated batch
+    # params actually sharded over tp
+    qkv_idx = [i for i, p in enumerate(st._params)
+               if "qkv_weight" in p.name][0]
+    shards = st._values[qkv_idx].sharding
+    assert shards.spec in (P("tp", None), P("tp"))
+
+
+def test_ring_attention_matches_dense():
+    np.random.seed(0)
+    B, H, S, D = 2, 4, 32, 16
+    q = np.random.randn(B, H, S, D).astype("float32")
+    k = np.random.randn(B, H, S, D).astype("float32")
+    v = np.random.randn(B, H, S, D).astype("float32")
+
+    def dense_attn(q, k, v, causal):
+        s = np.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(D)
+        if causal:
+            mask = np.tril(np.ones((S, S), bool))
+            s = np.where(mask, s, -np.inf)
+        p = np.exp(s - s.max(-1, keepdims=True))
+        p = p / p.sum(-1, keepdims=True)
+        return np.einsum("bhqk,bhkd->bhqd", p, v)
+
+    mesh = parallel.make_mesh(dp=1, sp=8)
+    for causal in (False, True):
+        out = parallel.ring_attention_sharded(
+            jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), mesh,
+            causal=causal, batch_axis="dp")
+        ref = dense_attn(q, k, v, causal)
+        np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-3,
+                                   atol=2e-3)
+
+
+def test_kvstore_local_pushpull():
+    kv = mx.kvstore.create("local")
+    kv.init("3", mx.nd.ones((2, 3)))
+    out = mx.nd.zeros((2, 3))
+    kv.pull("3", out=out)
+    np.testing.assert_allclose(out.asnumpy(), np.ones((2, 3)))
+    kv.push("3", mx.nd.ones((2, 3)) * 4)
+    kv.pull("3", out=out)
+    np.testing.assert_allclose(out.asnumpy(), np.ones((2, 3)) * 5)
+
+
+def test_kvstore_aggregates_device_copies():
+    kv = mx.kvstore.create("local")
+    kv.init("k", mx.nd.zeros((4,)))
+    vals = [mx.nd.ones((4,), ctx=mx.cpu(i)) for i in range(4)]
+    kv.push("k", vals)
+    out = mx.nd.zeros((4,))
+    kv.pull("k", out=out)
+    np.testing.assert_allclose(out.asnumpy(), np.full(4, 4.0))
+
+
+def test_kvstore_updater():
+    kv = mx.kvstore.create("device")
+    kv.init("w", mx.nd.ones((3,)))
+    opt = mx.optimizer.create("sgd", learning_rate=0.5)
+    kv.set_optimizer(opt)
+    kv.push("w", mx.nd.ones((3,)))
+    out = mx.nd.zeros((3,))
+    kv.pull("w", out=out)
+    np.testing.assert_allclose(out.asnumpy(), np.full(3, 0.5), rtol=1e-6)
+
+
+def test_kvstore_dist_mode_single_process():
+    kv = mx.kvstore.create("dist_tpu_sync")
+    assert kv.rank == 0
+    assert kv.num_workers == 1
+    kv.init("0", mx.nd.ones((2,)))
+    kv.barrier()
+    with pytest.raises(mx.MXNetError):
+        mx.kvstore.create("dist_async")
