@@ -1,0 +1,80 @@
+"""Benchmark: ResNet-50 v1 training throughput (images/sec) on one chip.
+
+Matches the reference's headline benchmark (`BASELINE.md`: ResNet-50
+training, batch 32, 298.51 img/s on 1x V100 fp32,
+`docs/.../perf.md:252` in the reference tree). The training step is the
+fused SPMD program from mxnet_tpu.parallel (fwd+bwd+update, bf16 compute,
+fp32 BN stats + master-quality updates via XLA), on a dp=1 mesh.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "img/s", "vs_baseline": N}
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+BASELINE_IMG_S = 298.51  # reference perf.md:252 (V100, fp32, batch 32)
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def main():
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, parallel
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    batch = int(os.environ.get("BENCH_BATCH", "32"))
+    steps = int(os.environ.get("BENCH_STEPS", "20"))
+    warmup = int(os.environ.get("BENCH_WARMUP", "3"))
+    image = int(os.environ.get("BENCH_IMAGE", "224"))
+
+    mx.random.seed(0)
+    np.random.seed(0)
+    log("devices:", jax.devices())
+
+    net = vision.resnet50_v1()
+    net.initialize(mx.init.Xavier())
+    net(mx.nd.zeros((1, 3, image, image)))  # resolve deferred shapes
+    net.cast("bfloat16")
+
+    mesh = parallel.make_mesh(dp=1)
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = parallel.ShardedTrainer(
+        net, loss_fn, "sgd", {"learning_rate": 0.1, "momentum": 0.9},
+        mesh=mesh)
+
+    x = mx.nd.array(np.random.rand(batch, 3, image, image),
+                    dtype="float32").astype("bfloat16")
+    y = mx.nd.array(np.random.randint(0, 1000, batch).astype("float32"))
+
+    log("compiling + warmup (%d steps)..." % warmup)
+    t0 = time.time()
+    for _ in range(warmup):
+        l = trainer.step(x, y)
+    l.wait_to_read()
+    log("warmup done in %.1fs, loss=%s" % (time.time() - t0,
+                                           float(l.asnumpy())))
+
+    t0 = time.time()
+    for _ in range(steps):
+        l = trainer.step(x, y)
+    l.wait_to_read()
+    dt = time.time() - t0
+    img_s = batch * steps / dt
+
+    print(json.dumps({
+        "metric": "resnet50_train_img_per_sec_per_chip_b%d" % batch,
+        "value": round(img_s, 2),
+        "unit": "img/s",
+        "vs_baseline": round(img_s / BASELINE_IMG_S, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,16 @@
+"""Contrib namespace (reference ``python/mxnet/contrib/``)."""
+from . import amp
+
+_LAZY = {"quantization": ".quantization", "tensorboard": ".tensorboard",
+         "onnx": ".onnx"}
+
+
+def __getattr__(name):
+    spec = _LAZY.get(name)
+    if spec is None:
+        raise AttributeError("module 'mxnet_tpu.contrib' has no attribute %r"
+                             % name)
+    import importlib
+    mod = importlib.import_module(spec, __name__)
+    globals()[name] = mod
+    return mod

@@ -1,0 +1,37 @@
+"""Dynamic loss scaler (reference ``contrib/amp/loss_scaler.py``): grow the
+scale every `scale_window` clean steps, halve it on overflow. Needed only
+for true fp16; bf16 on TPU keeps scale at 1."""
+from __future__ import annotations
+
+import numpy as _np
+
+__all__ = ["LossScaler"]
+
+
+class LossScaler:
+    def __init__(self, init_scale=2.0 ** 16, scale_factor=2.0,
+                 scale_window=2000, tolerance=0.05):
+        self.loss_scale = init_scale
+        self._scale_factor = scale_factor
+        self._scale_window = scale_window
+        self._unskipped = 0
+
+    def has_overflow(self, params):
+        """True if any gradient is non-finite (reference loss_scaler.py)."""
+        for param in params:
+            if param.grad_req != "null":
+                for grad in param.list_grad():
+                    g = grad.asnumpy()
+                    if not _np.isfinite(g).all():
+                        return True
+        return False
+
+    def update_scale(self, overflow):
+        if overflow:
+            self.loss_scale = max(self.loss_scale / self._scale_factor, 1)
+            self._unskipped = 0
+        else:
+            self._unskipped += 1
+        if self._unskipped == self._scale_window:
+            self.loss_scale *= self._scale_factor
+            self._unskipped = 0

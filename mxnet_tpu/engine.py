@@ -1,0 +1,46 @@
+"""Engine control surface (reference ``python/mxnet/engine.py`` over
+`src/engine/`: bulk scope + engine type).
+
+TPU-native: XLA *is* the engine (SURVEY §7) — program order + async PJRT
+dispatch replace the dependency scheduler's var/opr queues
+(`src/engine/threaded_engine.h:282`). The knobs are kept for API parity:
+`bulk` is a no-op scope (XLA fuses/bulks on its own), and the env var
+`MXNET_ENGINE_TYPE=NaiveEngine` maps to blocking dispatch (every op result
+synchronized immediately — the reference's serializing debug engine,
+`src/engine/naive_engine.cc`).
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+
+__all__ = ["bulk", "set_bulk_size"]
+
+_bulk_size = [int(os.environ.get("MXNET_EXEC_BULK_EXEC_MAX_NODE_TRAIN", 15))]
+_naive = [os.environ.get("MXNET_ENGINE_TYPE", "") == "NaiveEngine"]
+
+
+def set_bulk_size(size):
+    """reference engine.py set_bulk_size (MXEngineSetBulkSize)."""
+    prev = _bulk_size[0]
+    _bulk_size[0] = size
+    return prev
+
+
+@contextlib.contextmanager
+def bulk(size):
+    """reference engine.py bulk scope."""
+    prev = set_bulk_size(size)
+    try:
+        yield
+    finally:
+        set_bulk_size(prev)
+
+
+def is_naive():
+    return _naive[0]
+
+
+def set_naive(flag=True):
+    """Blocking debug dispatch (MXNET_ENGINE_TYPE=NaiveEngine)."""
+    _naive[0] = bool(flag)

@@ -1,0 +1,62 @@
+"""Checkpoint helpers + legacy kvstore-placement logic (reference
+``python/mxnet/model.py``: save_checkpoint, load_checkpoint,
+_create_kvstore :95 and the BatchEndParam consumed by callbacks)."""
+from __future__ import annotations
+
+import logging
+
+from . import symbol as sym_mod
+from .ndarray import ndarray as _nd
+
+__all__ = ["save_checkpoint", "load_checkpoint", "BatchEndParam"]
+
+from .callback import BatchEndParam  # noqa: F401  (re-export, ref model.py:69)
+
+
+def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
+                    remove_amp_cast=True):
+    """reference model.py save_checkpoint: prefix-symbol.json +
+    prefix-%04d.params."""
+    if symbol is not None:
+        symbol.save("%s-symbol.json" % prefix)
+    save_dict = {("arg:%s" % k): v for k, v in arg_params.items()}
+    save_dict.update({("aux:%s" % k): v for k, v in aux_params.items()})
+    param_name = "%s-%04d.params" % (prefix, epoch)
+    _nd.save(param_name, save_dict)
+    logging.info('Saved checkpoint to "%s"', param_name)
+
+
+def load_checkpoint(prefix, epoch):
+    """reference model.py load_checkpoint → (symbol, arg_params,
+    aux_params)."""
+    symbol = sym_mod.load("%s-symbol.json" % prefix)
+    save_dict = _nd.load("%s-%04d.params" % (prefix, epoch))
+    arg_params = {}
+    aux_params = {}
+    for k, v in save_dict.items():
+        tp, name = k.split(":", 1)
+        if tp == "arg":
+            arg_params[name] = v
+        if tp == "aux":
+            aux_params[name] = v
+    return symbol, arg_params, aux_params
+
+
+def _create_kvstore(kvstore, num_device, arg_params):
+    """reference model.py:95 — decide store + update placement. On TPU the
+    update always runs on-worker; a store is only created for multi-device
+    aggregation or dist modes."""
+    from . import kvstore as kvs
+    update_on_kvstore = False
+    if kvstore is None:
+        kv = None
+    elif isinstance(kvstore, kvs.KVStore):
+        kv = kvstore
+    elif isinstance(kvstore, str):
+        if num_device == 1 and "dist" not in kvstore:
+            kv = None
+        else:
+            kv = kvs.create(kvstore)
+    else:
+        raise TypeError("kvstore must be KVStore, str or None")
+    return (kv, update_on_kvstore)

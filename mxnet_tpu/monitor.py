@@ -1,0 +1,72 @@
+"""Monitor: per-op numeric debugging (reference ``python/mxnet/monitor.py``
+— Monitor installed via executor.set_monitor_callback, stat_func over
+outputs)."""
+from __future__ import annotations
+
+import logging
+import re
+
+from .ndarray.ndarray import NDArray
+
+__all__ = ["Monitor"]
+
+
+class Monitor:
+    """reference monitor.py:33."""
+
+    def __init__(self, interval, stat_func=None, pattern=".*", sort=False):
+        if stat_func is None:
+            def asum_stat(x):
+                return float(abs(x.asnumpy()).sum() / x.size)
+            stat_func = asum_stat
+        self.stat_func = stat_func
+        self.interval = interval
+        self.activated = False
+        self.queue = []
+        self.step = 0
+        self.exes = []
+        self.re_prog = re.compile(pattern)
+        self.sort = sort
+
+    def install(self, exe, monitor_all=False):
+        exe.set_monitor_callback(self.stat_helper, monitor_all)
+        self.exes.append(exe)
+
+    def stat_helper(self, name, array):
+        if not self.activated or not self.re_prog.match(str(name)):
+            return
+        if isinstance(array, NDArray):
+            self.queue.append((self.step, name, self.stat_func(array)))
+
+    def tic(self):
+        """Start collecting for this batch (reference monitor.py:86)."""
+        if self.step % self.interval == 0:
+            for exe in self.exes:
+                for arr in getattr(exe, "outputs", []):
+                    arr.wait_to_read()
+            self.queue = []
+            self.activated = True
+        self.step += 1
+
+    def toc(self):
+        """Finish a batch; returns list of (step, name, stat)."""
+        if not self.activated:
+            return []
+        for exe in self.exes:
+            for name, arr in getattr(exe, "arg_dict", {}).items():
+                if self.re_prog.match(name):
+                    self.queue.append((self.step, name,
+                                       self.stat_func(arr)))
+        self.activated = False
+        res = []
+        if self.sort:
+            self.queue.sort(key=lambda x: x[1])
+        for n, k, v_list in self.queue:
+            res.append((n, k, str(v_list)))
+        self.queue = []
+        return res
+
+    def toc_print(self):
+        res = self.toc()
+        for n, k, v in res:
+            logging.info("Batch: %7d %30s %s", n, k, v)

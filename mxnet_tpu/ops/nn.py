@@ -73,13 +73,13 @@ def Convolution(data, weight, bias=None, kernel=(), stride=(), dilate=(),
               2: ("NCHW", "OIHW", "NCHW"),
               3: ("NCDHW", "OIDHW", "NCDHW")}[nd]
     dn = lax.conv_dimension_numbers(data.shape, weight.shape, dn_str)
+    # NB: no preferred_element_type override — XLA already accumulates bf16
+    # convs in fp32 on the TPU MXU, and an explicit f32 override breaks the
+    # transpose (VJP) rule's dtype matching.
     out = lax.conv_general_dilated(
         data, weight, window_strides=stride, padding=padding,
         rhs_dilation=dilate, dimension_numbers=dn,
-        feature_group_count=num_group,
-        preferred_element_type=jnp.float32 if data.dtype == jnp.bfloat16 else None)
-    if out.dtype != data.dtype:
-        out = out.astype(data.dtype)
+        feature_group_count=num_group)
     if not no_bias and bias is not None:
         out = out + bias.reshape((1, -1) + (1,) * nd)
     return out
@@ -320,29 +320,37 @@ def SoftmaxOutput(data, label, grad_scale=1.0, ignore_label=-1.0,
                            float(use_ignore), float(multi_output))
 
 
-@jax.custom_vjp
-def _softmax_output(data, label, grad_scale, ignore_label, use_ignore, multi_output):
+from functools import partial as _partial
+
+
+@_partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5))
+def _softmax_output(data, label, grad_scale, ignore_label, use_ignore,
+                    multi_output):
     axis = 1 if multi_output else -1
     return jax.nn.softmax(data, axis=axis)
 
 
-def _softmax_output_fwd(data, label, grad_scale, ignore_label, use_ignore, multi_output):
-    out = _softmax_output(data, label, grad_scale, ignore_label, use_ignore, multi_output)
-    return out, (out, label, grad_scale, ignore_label, use_ignore, multi_output)
+def _softmax_output_fwd(data, label, grad_scale, ignore_label, use_ignore,
+                        multi_output):
+    axis = 1 if multi_output else -1
+    out = jax.nn.softmax(data, axis=axis)
+    return out, (out, label)
 
 
-def _softmax_output_bwd(res, g):
-    out, label, grad_scale, ignore_label, use_ignore, multi_output = res
+def _softmax_output_bwd(grad_scale, ignore_label, use_ignore, multi_output,
+                        res, g):
+    out, label = res
     axis = 1 if multi_output else -1
     depth = out.shape[axis]
-    oh = jax.nn.one_hot(label.astype(jnp.int32), depth, axis=axis, dtype=out.dtype)
+    oh = jax.nn.one_hot(label.astype(jnp.int32), depth, axis=axis,
+                        dtype=out.dtype)
     grad = (out - oh) * grad_scale
     if use_ignore:
         keep = (label != ignore_label).astype(out.dtype)
         keep = jnp.expand_dims(keep, axis=axis)
         grad = grad * keep
     # match batch mean semantics of MXNet: grad already per-example
-    return (grad, jnp.zeros_like(label, dtype=out.dtype), None, None, None, None)
+    return (grad, jnp.zeros_like(label, dtype=out.dtype))
 
 
 _softmax_output.defvjp(_softmax_output_fwd, _softmax_output_bwd)

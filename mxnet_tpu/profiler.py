@@ -1,0 +1,175 @@
+"""Profiler (reference ``python/mxnet/profiler.py`` over ``src/profiler/``).
+
+Parity surface: set_config :33, set_state, dumps :151, pause/resume, scoped
+Task/Frame/Marker objects :314-396. TPU-native: backed by jax.profiler —
+traces are XPlane/perfetto (viewable in TensorBoard/Perfetto, the modern
+equivalent of the reference's chrome://tracing JSON output), plus host-side
+aggregate timing tables kept by this module (role of
+`src/profiler/aggregate_stats.cc`).
+"""
+from __future__ import annotations
+
+import os
+import time
+from collections import defaultdict
+
+__all__ = ["set_config", "profiler_set_config", "set_state",
+           "profiler_set_state", "dump", "dumps", "pause", "resume",
+           "Domain", "Task", "Frame", "Event", "Counter", "Marker"]
+
+_state = {"running": False, "dir": "/tmp/mxnet_tpu_profile",
+          "aggregate": defaultdict(lambda: [0, 0.0])}
+
+
+def set_config(**kwargs):
+    """reference profiler.py:33 — accepts the reference's kwargs
+    (profile_symbolic, profile_imperative, profile_memory, profile_api,
+    filename, aggregate_stats...); filename maps to the trace dir."""
+    filename = kwargs.get("filename")
+    if filename:
+        _state["dir"] = os.path.dirname(os.path.abspath(filename)) or "."
+    _state["config"] = kwargs
+
+
+profiler_set_config = set_config
+
+
+def set_state(state="stop", profile_process="worker"):
+    """'run' starts a jax.profiler trace; 'stop' ends it."""
+    import jax
+    if state == "run" and not _state["running"]:
+        os.makedirs(_state["dir"], exist_ok=True)
+        jax.profiler.start_trace(_state["dir"])
+        _state["running"] = True
+    elif state == "stop" and _state["running"]:
+        jax.profiler.stop_trace()
+        _state["running"] = False
+
+
+profiler_set_state = set_state
+
+
+def pause(profile_process="worker"):
+    if _state["running"]:
+        import jax
+        jax.profiler.stop_trace()
+        _state["running"] = False
+
+
+def resume(profile_process="worker"):
+    set_state("run")
+
+
+def dump(finished=True, profile_process="worker"):
+    if _state["running"] and finished:
+        set_state("stop")
+
+
+def dumps(reset=False, format="table", sort_by="total", ascending=False):
+    """Aggregate stats table (role of aggregate_stats.cc Dump)."""
+    lines = ["Profile Statistics:",
+             "%-40s %10s %14s" % ("Name", "Calls", "Total ms")]
+    for name, (calls, total) in sorted(_state["aggregate"].items(),
+                                       key=lambda kv: -kv[1][1]):
+        lines.append("%-40s %10d %14.3f" % (name, calls, total * 1e3))
+    if reset:
+        _state["aggregate"].clear()
+    return "\n".join(lines)
+
+
+class Domain:
+    """reference profiler.py Domain."""
+
+    def __init__(self, name):
+        self.name = name
+
+    def new_task(self, name):
+        return Task(self, name)
+
+    def new_frame(self, name):
+        return Frame(self, name)
+
+    def new_counter(self, name, value=None):
+        return Counter(self, name, value)
+
+    def new_marker(self, name):
+        return Marker(self, name)
+
+
+class _Scoped:
+    def __init__(self, domain, name):
+        self.domain = domain
+        self.name = name
+        self._t0 = None
+        self._ann = None
+
+    def start(self):
+        import jax
+        self._t0 = time.time()
+        self._ann = jax.profiler.TraceAnnotation(self.name)
+        self._ann.__enter__()
+
+    def stop(self):
+        if self._ann is not None:
+            self._ann.__exit__(None, None, None)
+            self._ann = None
+        if self._t0 is not None:
+            entry = _state["aggregate"][self.name]
+            entry[0] += 1
+            entry[1] += time.time() - self._t0
+            self._t0 = None
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *a):
+        self.stop()
+
+
+class Task(_Scoped):
+    """reference profiler.py:314."""
+
+
+class Frame(_Scoped):
+    """reference profiler.py:342."""
+
+
+class Event(_Scoped):
+    """reference profiler.py:370."""
+
+
+class Counter:
+    """reference profiler.py Counter."""
+
+    def __init__(self, domain, name, value=None):
+        self.name = name
+        self.value = value or 0
+
+    def set_value(self, value):
+        self.value = value
+
+    def increment(self, delta=1):
+        self.value += delta
+
+    def decrement(self, delta=1):
+        self.value -= delta
+
+    def __iadd__(self, v):
+        self.value += v
+        return self
+
+    def __isub__(self, v):
+        self.value -= v
+        return self
+
+
+class Marker:
+    """Instant marker (reference profiler.py:396)."""
+
+    def __init__(self, domain, name):
+        self.name = name
+
+    def mark(self, scope="process"):
+        entry = _state["aggregate"]["marker:" + self.name]
+        entry[0] += 1

@@ -1,0 +1,15 @@
+"""Symbol API (reference ``python/mxnet/symbol/``)."""
+from .symbol import (Symbol, var, Variable, Group, load, load_json, zeros,
+                     ones, arange)
+from .symbol import _populate_ops as _pop
+
+_pop(globals())
+
+
+def __getattr__(name):
+    from .symbol import _sym_op
+    from ..ops.registry import get_op
+    if get_op(name) is not None:
+        return _sym_op(name)
+    raise AttributeError("module 'mxnet_tpu.symbol' has no attribute %r"
+                         % name)

@@ -1,0 +1,180 @@
+"""Executor: compiled binding of a Symbol.
+
+Parity surface: reference ``include/mxnet/executor.h`` Executor::
+Forward/Backward/outputs + GraphExecutor (`src/executor/graph_executor.cc`:
+Init :392, RunOps :1425). TPU-native: forward = one jitted XLA program over
+the graph; backward = jax.vjp of that program (the symbolic-gradient pass
+`src/nnvm/gradient.cc` is subsumed by autodiff); memory planning/fusion are
+XLA's (`plan_memory.cc`, `pointwise_fusion_pass.cc` have no analogue here).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray
+from .. import random as _random
+from .. import _tape
+from .symbol import evaluate_graph, _out_key
+
+__all__ = ["Executor"]
+
+
+class Executor:
+    def __init__(self, symbol, ctx, args, args_grad=None, grad_req="write",
+                 aux_states=None):
+        self._symbol = symbol
+        self._ctx = ctx
+        arg_names = symbol.list_arguments()
+        aux_names = symbol.list_auxiliary_states()
+        if isinstance(args, (list, tuple)):
+            args = dict(zip(arg_names, args))
+        if isinstance(args_grad, (list, tuple)):
+            args_grad = dict(zip(arg_names, args_grad))
+        if isinstance(aux_states, (list, tuple)):
+            aux_states = dict(zip(aux_names, aux_states))
+        self.arg_dict = dict(args)
+        self.grad_dict = dict(args_grad) if args_grad else {}
+        self.aux_dict = dict(aux_states) if aux_states else {}
+        if isinstance(grad_req, str):
+            self._grad_req = {n: grad_req for n in arg_names}
+        else:
+            self._grad_req = dict(grad_req) if isinstance(grad_req, dict) \
+                else dict(zip(arg_names, grad_req))
+        self._arg_names = arg_names
+        self._aux_names = aux_names
+        self.outputs = []
+        self._fwd_cache = {}
+        self._vjp_fn = None
+        self._monitor = None
+
+    # ---- forward ----------------------------------------------------------
+    def _bindings(self):
+        b = {n: a._data for n, a in self.arg_dict.items()}
+        b.update({n: a._data for n, a in self.aux_dict.items()})
+        return b
+
+    def forward(self, is_train=False, **kwargs):
+        """reference Executor::Forward (graph_executor.cc:79)."""
+        for n, v in kwargs.items():
+            if n in self.arg_dict:
+                self.arg_dict[n][:] = v
+            else:
+                raise MXNetError("unknown argument %r" % n)
+        key_names = tuple(sorted(self._bindings()))
+        sig = (tuple((n, tuple(self.arg_dict[n].shape))
+                     for n in self._arg_names), is_train)
+        fn = self._fwd_cache.get(sig)
+        if fn is None:
+            symbol = self._symbol
+
+            names_c, train_c = key_names, is_train
+
+            def run(rng, binding_vals):
+                _random.push_trace_key(rng)
+                try:
+                    binds = dict(zip(names_c, binding_vals))
+                    return evaluate_graph(symbol, binds, train=train_c)
+                finally:
+                    _random.pop_trace_key()
+
+            fn = jax.jit(run)
+            self._fwd_cache[sig] = fn
+        binds = self._bindings()
+        vals = [binds[n] for n in key_names]
+        outs = fn(_random.next_key(), vals)
+        self.outputs = [NDArray(o, ctx=self._ctx) for o in outs]
+        self._last_train = is_train
+        return self.outputs
+
+    # ---- backward ---------------------------------------------------------
+    def backward(self, out_grads=None, is_train=True):
+        """reference Executor::Backward (graph_executor.cc:92) — jax.vjp of
+        the whole forward program; grads written into grad_dict honoring
+        grad_req write/add/null."""
+        wanted = [n for n in self._arg_names
+                  if self._grad_req.get(n, "null") != "null"
+                  and n in self.grad_dict]
+        if not wanted:
+            return
+        binds = self._bindings()
+        key = _random.next_key()
+        symbol = self._symbol
+
+        def fwd(vals):
+            _random.push_trace_key(key)
+            try:
+                b = dict(binds)
+                b.update(dict(zip(wanted, vals)))
+                return evaluate_graph(symbol, b, train=True)
+            finally:
+                _random.pop_trace_key()
+
+        primal = [binds[n] for n in wanted]
+        outs, vjp = jax.vjp(fwd, primal)
+        if out_grads is None:
+            cts = [jnp.ones_like(o) for o in outs]
+        else:
+            if isinstance(out_grads, NDArray):
+                out_grads = [out_grads]
+            cts = [g._data if isinstance(g, NDArray) else jnp.asarray(g)
+                   for g in out_grads]
+        (grads,) = vjp(cts)
+        for n, g in zip(wanted, grads):
+            tgt = self.grad_dict[n]
+            if self._grad_req.get(n) == "add":
+                tgt._data = tgt._data + g
+            else:
+                tgt._data = g
+
+    # ---- misc parity ------------------------------------------------------
+    @property
+    def arg_arrays(self):
+        return [self.arg_dict[n] for n in self._arg_names]
+
+    @property
+    def grad_arrays(self):
+        return [self.grad_dict.get(n) for n in self._arg_names]
+
+    @property
+    def aux_arrays(self):
+        return [self.aux_dict[n] for n in self._aux_names]
+
+    def copy_params_from(self, arg_params, aux_params=None,
+                         allow_extra_params=False):
+        for name, array in arg_params.items():
+            if name in self.arg_dict:
+                self.arg_dict[name][:] = array
+            elif not allow_extra_params:
+                raise ValueError("Find name \"%s\" that is not in the "
+                                 "arguments" % name)
+        if aux_params:
+            for name, array in aux_params.items():
+                if name in self.aux_dict:
+                    self.aux_dict[name][:] = array
+                elif not allow_extra_params:
+                    raise ValueError("Find name \"%s\" that is not in the "
+                                     "auxiliary states" % name)
+
+    def reshape(self, partial_shaping=False, allow_up_sizing=False, **kwargs):
+        from ..ndarray import ndarray as _nd
+        arg_shapes, _, aux_shapes = self._symbol.infer_shape(**kwargs)
+        new_args = {n: _nd.zeros(s, ctx=self._ctx)
+                    for n, s in zip(self._arg_names, arg_shapes)}
+        for n, a in self.arg_dict.items():
+            if n in new_args and new_args[n].shape == a.shape:
+                new_args[n] = a
+        grads = {n: _nd.zeros(s, ctx=self._ctx)
+                 for n, s in zip(self._arg_names, arg_shapes)} \
+            if self.grad_dict else None
+        aux = {n: _nd.zeros(s, ctx=self._ctx)
+               for n, s in zip(self._aux_names, aux_shapes)}
+        return Executor(self._symbol, self._ctx, new_args, grads,
+                        self._grad_req, aux)
+
+    def set_monitor_callback(self, callback, monitor_all=False):
+        self._monitor = callback
+
+    def debug_str(self):
+        return self._symbol.tojson()

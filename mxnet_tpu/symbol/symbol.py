@@ -1,0 +1,610 @@
+"""Symbol: the declarative graph-building front-end.
+
+Parity surface: reference ``python/mxnet/symbol/symbol.py`` (10.7K LoC over
+nnvm: var/compose, list_arguments/outputs/auxiliary_states, infer_shape,
+simple_bind :1504, bind :1806, eval, save/load JSON) and the GraphExecutor
+(`src/executor/graph_executor.cc`).
+
+TPU-native design: a Symbol is a lightweight DAG over the SAME op registry
+the eager API uses. ``bind`` produces an Executor whose forward is one
+jitted XLA program (the role of GraphExecutor::Init's pass pipeline —
+shape inference, memory planning, fusion — is all inside XLA), and whose
+backward is ``jax.vjp`` over that program. Parameter-shape inference
+(`InferShape` pass, `src/executor/infer_graph_attr_pass.cc`) is done by
+forward shape propagation with per-op parameter rules.
+"""
+from __future__ import annotations
+
+import json
+
+import numpy as _np
+
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError, dtype_np
+from ..context import current_context
+from ..ops.registry import get_op, list_ops
+from .. import _tape
+from .. import random as _random
+
+__all__ = ["Symbol", "var", "Variable", "Group", "load", "load_json",
+           "zeros", "ones", "arange"]
+
+
+class Symbol:
+    """A node (or group of outputs) in a symbolic graph."""
+
+    def __init__(self, op=None, inputs=(), kwargs=None, name=None,
+                 outputs=None, attr=None):
+        self._op = op                 # None for variables / groups
+        self._inputs = list(inputs)   # list of (Symbol, out_index)
+        self._kwargs = kwargs or {}
+        self._name = name
+        self._num_out = 1
+        self._group = outputs         # list of (Symbol, idx) when Group
+        self._attr = dict(attr or {})
+        self._shape_hint = None
+        self._dtype_hint = None
+
+    # ---- identity ---------------------------------------------------------
+    @property
+    def name(self):
+        return self._name
+
+    def attr(self, key):
+        return self._attr.get(key)
+
+    def list_attr(self):
+        return dict(self._attr)
+
+    def _set_attr(self, **kwargs):
+        self._attr.update(kwargs)
+
+    def __repr__(self):
+        if self._group is not None:
+            return "<Symbol group [%s]>" % ", ".join(
+                s._name or "?" for s, _ in self._group)
+        return "<Symbol %s>" % (self._name or (self._op and self._op.name))
+
+    # ---- graph traversal --------------------------------------------------
+    def _toposort(self):
+        order, seen = [], set()
+        stack = [s for s, _ in self._outputs_list()]
+        stack2 = [(s, False) for s in stack]
+        while stack2:
+            node, done = stack2.pop()
+            if done:
+                order.append(node)
+                continue
+            if id(node) in seen:
+                continue
+            seen.add(id(node))
+            stack2.append((node, True))
+            # reversed: LIFO pop order then matches MXNet's left-to-right
+            # DFS postorder (data before weights, layer by layer)
+            for parent, _ in reversed(node._inputs):
+                stack2.append((parent, False))
+        return order
+
+    def _outputs_list(self):
+        if self._group is not None:
+            return list(self._group)
+        return [(self, 0)]
+
+    def list_arguments(self):
+        """Variables in topo order (reference symbol.py list_arguments)."""
+        return [n._name for n in self._toposort()
+                if n._op is None and not n._attr.get("__aux__")]
+
+    def list_auxiliary_states(self):
+        return [n._name for n in self._toposort()
+                if n._op is None and n._attr.get("__aux__")]
+
+    def list_outputs(self):
+        outs = []
+        for s, i in self._outputs_list():
+            base = s._name or s._op.name
+            outs.append("%s_output" % base if s._op else base)
+        return outs
+
+    def list_inputs(self):
+        return [n._name for n in self._toposort() if n._op is None]
+
+    def get_internals(self):
+        nodes = self._toposort()
+        return Group([Symbol_from(n) for n in nodes])
+
+    def __getitem__(self, index):
+        outs = self._outputs_list()
+        if isinstance(index, str):
+            names = self.list_outputs()
+            index = names.index(index)
+        s, i = outs[index]
+        if i == 0 and s._group is None:
+            return s
+        proxy = Symbol(op=None, name=(s._name or "out"))
+        proxy._group = [(s, i)]
+        return proxy
+
+    def __iter__(self):
+        return (self[i] for i in range(len(self._outputs_list())))
+
+    def __len__(self):
+        return len(self._outputs_list())
+
+    # ---- composition operators -------------------------------------------
+    def _binop(self, other, opname, reverse=False):
+        op = _sym_op(opname)
+        if reverse:
+            return op(other, self)
+        return op(self, other)
+
+    def __add__(self, o):
+        return self._binop(o, "_plus_scalar" if _scalar(o) else "add")
+
+    def __radd__(self, o):
+        return self.__add__(o)
+
+    def __sub__(self, o):
+        return self._binop(o, "_minus_scalar" if _scalar(o) else "subtract")
+
+    def __rsub__(self, o):
+        return self._binop(o, "_rminus_scalar" if _scalar(o) else "subtract",
+                           reverse=not _scalar(o))
+
+    def __mul__(self, o):
+        return self._binop(o, "_mul_scalar" if _scalar(o) else "multiply")
+
+    def __rmul__(self, o):
+        return self.__mul__(o)
+
+    def __truediv__(self, o):
+        return self._binop(o, "_div_scalar" if _scalar(o) else "divide")
+
+    def __rtruediv__(self, o):
+        return self._binop(o, "_rdiv_scalar" if _scalar(o) else "divide",
+                           reverse=not _scalar(o))
+
+    def __pow__(self, o):
+        return self._binop(o, "_power_scalar" if _scalar(o) else "power")
+
+    def __neg__(self):
+        return self.__mul__(-1.0)
+
+    # ---- shape/type inference --------------------------------------------
+    def infer_shape(self, *args, **kwargs):
+        """Forward shape propagation (role of the reference InferShape pass,
+        `src/executor/infer_graph_attr_pass.cc`). Returns
+        (arg_shapes, out_shapes, aux_shapes)."""
+        known = dict(kwargs)
+        arg_names = self.list_arguments()
+        for name, shape in zip(arg_names, args):
+            if shape is not None:
+                known[name] = shape
+        shapes = _infer_shapes(self, known)
+        if shapes is None:
+            return None, None, None
+        arg_shapes = [shapes.get(n) for n in arg_names]
+        out_shapes = [shapes[_out_key(s, i)]
+                      for s, i in self._outputs_list()]
+        aux_shapes = [shapes.get(n) for n in self.list_auxiliary_states()]
+        return arg_shapes, out_shapes, aux_shapes
+
+    def infer_shape_partial(self, *args, **kwargs):
+        try:
+            return self.infer_shape(*args, **kwargs)
+        except Exception:
+            return None, None, None
+
+    def infer_type(self, *args, **kwargs):
+        arg_names = self.list_arguments()
+        dt = [(_np.float32 if a is None else dtype_np(a))
+              for a in (list(args) + [None] * (len(arg_names) - len(args)))]
+        return dt, [_np.float32] * len(self._outputs_list()), \
+            [_np.float32] * len(self.list_auxiliary_states())
+
+    # ---- evaluation -------------------------------------------------------
+    def eval(self, ctx=None, **kwargs):
+        """Immediate evaluation with NDArray bindings (reference
+        symbol.py eval)."""
+        ex = self.bind(ctx or current_context(), kwargs)
+        return ex.forward()
+
+    def bind(self, ctx, args, args_grad=None, grad_req="write",
+             aux_states=None, group2ctx=None, shared_exec=None):
+        from .executor import Executor
+        return Executor(self, ctx, args, args_grad, grad_req, aux_states)
+
+    def simple_bind(self, ctx, grad_req="write", type_dict=None,
+                    stype_dict=None, group2ctx=None, shared_arg_names=None,
+                    shared_exec=None, shared_buffer=None, **kwargs):
+        """Allocate arg/grad arrays from inferred shapes (reference
+        symbol.py:1504 → GraphExecutor::Init graph_executor.cc:392)."""
+        from ..ndarray import ndarray as _nd
+        from .executor import Executor
+        arg_shapes, _, aux_shapes = self.infer_shape(**kwargs)
+        if arg_shapes is None or any(s is None for s in arg_shapes):
+            raise MXNetError(
+                "simple_bind could not infer all argument shapes for %s; "
+                "provide shapes for the data variables" % self)
+        arg_names = self.list_arguments()
+        args = {n: _nd.zeros(s, ctx=ctx) for n, s in zip(arg_names,
+                                                         arg_shapes)}
+        if grad_req != "null":
+            grads = {n: _nd.zeros(s, ctx=ctx)
+                     for n, s in zip(arg_names, arg_shapes)}
+        else:
+            grads = None
+        aux = {n: _nd.zeros(s, ctx=ctx)
+               for n, s in zip(self.list_auxiliary_states(), aux_shapes)}
+        return Executor(self, ctx, args, grads, grad_req, aux)
+
+    # ---- serialization ----------------------------------------------------
+    def tojson(self):
+        """Versioned JSON graph (reference `save`/`legacy_json_util.cc`)."""
+        nodes = self._toposort()
+        idx = {id(n): i for i, n in enumerate(nodes)}
+        out = {"nodes": [], "arg_nodes": [], "heads": [],
+               "mxnet_tpu_version": 1}
+        for i, n in enumerate(nodes):
+            entry = {"op": n._op.name if n._op else "null",
+                     "name": n._name or ("node%d" % i),
+                     "inputs": [[idx[id(p)], oi] for p, oi in n._inputs]}
+            if n._kwargs:
+                entry["attrs"] = {k: json.dumps(v) if not isinstance(v, str)
+                                  else v for k, v in n._kwargs.items()}
+            if n._attr:
+                entry["node_attrs"] = {k: str(v) for k, v in n._attr.items()}
+            out["nodes"].append(entry)
+            if n._op is None:
+                out["arg_nodes"].append(i)
+        for s, oi in self._outputs_list():
+            out["heads"].append([idx[id(s)], oi])
+        return json.dumps(out, indent=2)
+
+    def save(self, fname):
+        with open(fname, "w") as f:
+            f.write(self.tojson())
+
+    def get_backend_symbol(self, backend):
+        return self  # XLA is the only backend; partitioning is internal
+
+    # ---- misc parity ------------------------------------------------------
+    def attr_dict(self):
+        ret = {}
+        for n in self._toposort():
+            if n._attr:
+                ret[n._name] = {k: str(v) for k, v in n._attr.items()}
+        return ret
+
+    @property
+    def nd(self):
+        raise AttributeError
+
+
+def Symbol_from(node):
+    return node
+
+
+def _scalar(v):
+    import numbers
+    return isinstance(v, numbers.Number)
+
+
+def _out_key(sym, idx):
+    return "%s#%d" % (id(sym), idx)
+
+
+def var(name, attr=None, shape=None, lr_mult=None, wd_mult=None, dtype=None,
+        init=None, stype=None, **kwargs):
+    """Create a variable symbol (reference symbol.py var)."""
+    s = Symbol(op=None, name=name, attr=attr)
+    s._shape_hint = tuple(shape) if shape is not None else None
+    s._dtype_hint = dtype
+    s._init = init
+    s._lr_mult = lr_mult
+    s._wd_mult = wd_mult
+    return s
+
+
+Variable = var
+
+
+def Group(symbols):
+    """Group several symbols into one multi-output symbol."""
+    outs = []
+    for s in symbols:
+        outs.extend(s._outputs_list())
+    g = Symbol(op=None, name="group")
+    g._group = outs
+    return g
+
+
+# ---- symbolic op wrappers ---------------------------------------------------
+
+# ops whose extra tensor parameters are auto-created as vars when omitted:
+# name -> (param slots after data, aux flags)
+_PARAM_SLOTS = {
+    "FullyConnected": (["weight", "bias"], []),
+    "Convolution": (["weight", "bias"], []),
+    "Deconvolution": (["weight", "bias"], []),
+    "BatchNorm": (["gamma", "beta"], ["moving_mean", "moving_var"]),
+    "Embedding": (["weight"], []),
+    "LayerNorm": (["gamma", "beta"], []),
+    "InstanceNorm": (["gamma", "beta"], []),
+    "GroupNorm": (["gamma", "beta"], []),
+}
+
+_counters = {}
+
+
+def _auto_name(opname):
+    k = opname.lower()
+    c = _counters.get(k, 0)
+    _counters[k] = c + 1
+    return "%s%d" % (k, c)
+
+
+def _sym_op(opname):
+    op = get_op(opname)
+    if op is None:
+        raise AttributeError("no operator %r" % opname)
+
+    def make(*args, name=None, attr=None, **kwargs):
+        name = name or _auto_name(opname)
+        inputs = []
+        pos_syms = []
+        for a in args:
+            if isinstance(a, Symbol):
+                pos_syms.append(a)
+            else:
+                pos_syms.append(a)
+        # kwargs may carry tensor inputs by name (mxnet style)
+        slots, aux_slots = _PARAM_SLOTS.get(op.name, ([], []))
+        no_bias = kwargs.get("no_bias", False)
+        tensor_args = []
+        for a in pos_syms:
+            tensor_args.append(a)
+        # auto-create missing param vars
+        n_tensors = len([a for a in tensor_args if isinstance(a, Symbol)])
+        if slots and n_tensors <= 1:
+            for slot in slots:
+                if slot == "bias" and no_bias:
+                    tensor_args.append(None)
+                    continue
+                if slot in kwargs and isinstance(kwargs[slot], Symbol):
+                    tensor_args.append(kwargs.pop(slot))
+                else:
+                    tensor_args.append(var("%s_%s" % (name, slot)))
+            for slot in aux_slots:
+                v = var("%s_%s" % (name, slot), attr={"__aux__": True})
+                v._attr["__aux__"] = True
+                tensor_args.append(v)
+        node_inputs = []
+        const_prefix = []
+        for a in tensor_args:
+            if isinstance(a, Symbol):
+                outs = a._outputs_list()
+                assert len(outs) == 1, \
+                    "cannot compose multi-output symbol directly"
+                node_inputs.append(outs[0])
+            else:
+                node_inputs.append(("const", a))
+        node = Symbol(op=op, inputs=[], kwargs=kwargs, name=name, attr=attr)
+        node._raw_inputs = node_inputs
+        node._inputs = [p for p in node_inputs if p[0] != "const"]
+        return node
+
+    make.__name__ = opname
+    return make
+
+
+def _populate_ops(ns):
+    for opname in list_ops():
+        if opname not in ns:
+            ns[opname] = _sym_op(opname)
+
+
+# ---- evaluation machinery (shared with Executor) ---------------------------
+
+def _node_arg_values(node, values):
+    args = []
+    for p in getattr(node, "_raw_inputs", node._inputs):
+        if isinstance(p, tuple) and p and p[0] == "const":
+            args.append(p[1])
+        else:
+            sym, oi = p
+            v = values[_out_key(sym, oi)]
+            args.append(v)
+    return args
+
+
+def evaluate_graph(root, bindings, train=False):
+    """Evaluate symbol graph given name→jax-array bindings for variables."""
+    order = root._toposort()
+    values = {}
+    prev_train = _tape.set_training(train)
+    prev_rec = _tape.set_recording(False)
+    try:
+        for node in order:
+            if node._op is None:
+                if node._name not in bindings:
+                    raise MXNetError("unbound variable %r" % node._name)
+                values[_out_key(node, 0)] = bindings[node._name]
+                continue
+            args = _node_arg_values(node, values)
+            out = node._op.fn(*args, **node._kwargs)
+            if isinstance(out, tuple):
+                for i, v in enumerate(out):
+                    values[_out_key(node, i)] = v
+            else:
+                values[_out_key(node, 0)] = out
+    finally:
+        _tape.set_recording(prev_rec)
+        _tape.set_training(prev_train)
+    return [values[_out_key(s, i)] for s, i in root._outputs_list()]
+
+
+def _infer_shapes(root, known_shapes):
+    """Forward-propagate shapes; resolve parameter shapes via jax.eval_shape
+    with per-op parameter rules."""
+    order = root._toposort()
+    shapes = dict(known_shapes)
+
+    for node in order:
+        if node._op is None:
+            if node._name not in shapes and node._shape_hint is not None \
+                    and all(d > 0 for d in node._shape_hint):
+                shapes[node._name] = node._shape_hint
+            continue
+        raw = getattr(node, "_raw_inputs", node._inputs)
+        in_shapes = []
+        in_syms = []
+        for p in raw:
+            if isinstance(p, tuple) and p and p[0] == "const":
+                in_shapes.append(("const", p[1]))
+                in_syms.append(None)
+            else:
+                sym, oi = p
+                key = sym._name if sym._op is None else _out_key(sym, oi)
+                in_shapes.append(shapes.get(key))
+                in_syms.append((sym, oi))
+        # resolve unknown param shapes from the data shape
+        data_shape = None
+        for s in in_shapes:
+            if isinstance(s, tuple) and s and s[0] != "const":
+                data_shape = s
+                break
+        rule = _PARAM_SHAPE_RULES.get(node._op.name)
+        if rule is not None and data_shape is not None:
+            slot_names = _PARAM_SLOTS[node._op.name][0] + \
+                _PARAM_SLOTS[node._op.name][1]
+            for j, (s, sy) in enumerate(zip(in_shapes, in_syms)):
+                if s is None and sy is not None and j >= 1:
+                    slot = slot_names[j - 1] if j - 1 < len(slot_names) \
+                        else None
+                    if slot:
+                        inferred = rule(data_shape, node._kwargs, slot)
+                        if inferred is not None:
+                            in_shapes[j] = inferred
+                            if sy[0]._op is None:
+                                shapes[sy[0]._name] = inferred
+        # evaluate output shapes
+        ok = all(s is not None for s in in_shapes)
+        if not ok:
+            raise MXNetError(
+                "infer_shape: cannot resolve inputs of %s (%s)"
+                % (node._name, node._op.name))
+
+        def fake(*tensors):
+            vals = []
+            ti = 0
+            for s in in_shapes:
+                if isinstance(s, tuple) and s and s[0] == "const":
+                    vals.append(s[1])
+                else:
+                    vals.append(tensors[ti])
+                    ti += 1
+            return node._op.fn(*vals, **node._kwargs)
+
+        tensor_specs = [jax.ShapeDtypeStruct(tuple(s), _np.float32)
+                        for s in in_shapes
+                        if not (isinstance(s, tuple) and s and
+                                s[0] == "const")]
+        out = jax.eval_shape(fake, *tensor_specs)
+        if isinstance(out, tuple):
+            for i, o in enumerate(out):
+                shapes[_out_key(node, i)] = tuple(o.shape)
+        else:
+            shapes[_out_key(node, 0)] = tuple(out.shape)
+    return shapes
+
+
+def _prod_tail(shape):
+    r = 1
+    for d in shape[1:]:
+        r *= d
+    return r
+
+
+_PARAM_SHAPE_RULES = {
+    "FullyConnected": lambda ds, kw, slot: {
+        "weight": (kw.get("num_hidden"), _prod_tail(ds)
+                   if kw.get("flatten", True) else ds[-1]),
+        "bias": (kw.get("num_hidden"),)}.get(slot),
+    "Convolution": lambda ds, kw, slot: {
+        "weight": (kw.get("num_filter"),
+                   ds[1] // kw.get("num_group", 1)) +
+        tuple(_pairify(kw.get("kernel"), len(ds) - 2)),
+        "bias": (kw.get("num_filter"),)}.get(slot),
+    "Deconvolution": lambda ds, kw, slot: {
+        "weight": (ds[1], kw.get("num_filter") // kw.get("num_group", 1)) +
+        tuple(_pairify(kw.get("kernel"), len(ds) - 2)),
+        "bias": (kw.get("num_filter"),)}.get(slot),
+    "BatchNorm": lambda ds, kw, slot: (ds[kw.get("axis", 1)],),
+    "LayerNorm": lambda ds, kw, slot: (ds[kw.get("axis", -1)],),
+    "InstanceNorm": lambda ds, kw, slot: (ds[1],),
+    "GroupNorm": lambda ds, kw, slot: (ds[1],),
+    "Embedding": lambda ds, kw, slot: (kw.get("input_dim"),
+                                       kw.get("output_dim")),
+}
+
+
+def _pairify(v, n):
+    if v is None:
+        return (1,) * n
+    if isinstance(v, int):
+        return (v,) * n
+    return tuple(v)
+
+
+# ---- creation helpers -------------------------------------------------------
+
+def zeros(shape, dtype=None, **kwargs):
+    op = _sym_op("zeros_like")
+    raise NotImplementedError("use mx.sym.var + executor bindings")
+
+
+def ones(shape, dtype=None, **kwargs):
+    raise NotImplementedError("use mx.sym.var + executor bindings")
+
+
+def arange(start, stop=None, step=1.0, **kwargs):
+    raise NotImplementedError("use mx.sym.var + executor bindings")
+
+
+def load(fname):
+    with open(fname) as f:
+        return load_json(f.read())
+
+
+def load_json(json_str):
+    """Rebuild a Symbol graph from tojson output."""
+    data = json.loads(json_str)
+    nodes = []
+    for entry in data["nodes"]:
+        if entry["op"] == "null":
+            v = var(entry["name"],
+                    attr=entry.get("node_attrs"))
+            nodes.append(v)
+        else:
+            op = get_op(entry["op"])
+            kwargs = {}
+            for k, sv in (entry.get("attrs") or {}).items():
+                try:
+                    kwargs[k] = json.loads(sv)
+                except (ValueError, TypeError):
+                    kwargs[k] = sv
+            node = Symbol(op=op, inputs=[], kwargs=kwargs,
+                          name=entry["name"])
+            raw = [(nodes[i], oi) for i, oi in entry["inputs"]]
+            node._raw_inputs = raw
+            node._inputs = raw
+            nodes.append(node)
+    heads = [(nodes[i], oi) for i, oi in data["heads"]]
+    if len(heads) == 1 and heads[0][1] == 0:
+        return heads[0][0]
+    g = Symbol(op=None, name="group")
+    g._group = heads
+    return g

@@ -1,0 +1,114 @@
+"""Network visualization (reference ``python/mxnet/visualization.py``:
+print_summary, plot_network)."""
+from __future__ import annotations
+
+import json
+
+__all__ = ["print_summary", "plot_network", "block_summary"]
+
+
+def print_summary(symbol, shape=None, line_length=120, positions=(.44, .64,
+                                                                  .74, 1.)):
+    """Layer-by-layer table for a Symbol (reference visualization.py:28)."""
+    if shape is not None:
+        _, out_shapes, _ = symbol.infer_shape(**shape)
+    nodes = symbol._toposort()
+    fields = ["Layer (type)", "Output Shape", "Param #", "Previous Layer"]
+    line = "%-40s %-20s %-12s %-30s" % tuple(fields)
+    print("=" * line_length)
+    print(line)
+    print("=" * line_length)
+    total = 0
+    shapes = {}
+    if shape is not None:
+        from .symbol.symbol import _infer_shapes
+        shapes = _infer_shapes(symbol, dict(shape))
+    for node in nodes:
+        if node._op is None:
+            continue
+        prev = ",".join(p._name or "?" for p, _ in node._inputs
+                        if not (isinstance(p, tuple)))
+        from .symbol.symbol import _out_key
+        oshape = shapes.get(_out_key(node, 0), "")
+        params = 0
+        for p, _ in node._inputs:
+            if getattr(p, "_op", 1) is None and p._name != "data" and \
+                    p._name in shapes:
+                n = 1
+                for d in shapes[p._name]:
+                    n *= d
+                params += n
+        total += params
+        print("%-40s %-20s %-12s %-30s" % (
+            "%s (%s)" % (node._name, node._op.name), str(oshape),
+            str(params), prev[:30]))
+    print("=" * line_length)
+    print("Total params: %d" % total)
+    return total
+
+
+def block_summary(block, *inputs):
+    """Gluon Block.summary backend: forward hooks collecting shapes."""
+    rows = []
+    hooks = []
+
+    def make_hook(name):
+        def hook(blk, inp, out):
+            o = out[0] if isinstance(out, (list, tuple)) else out
+            n_params = sum(int(_prod(p.shape))
+                           for p in blk._reg_params.values()
+                           if p.shape and all(s > 0 for s in p.shape))
+            rows.append((name, type(blk).__name__, tuple(o.shape), n_params))
+        return hook
+
+    def walk(blk, prefix):
+        for name, child in blk._children.items():
+            hooks.append(child.register_forward_hook(
+                make_hook(prefix + name)))
+            walk(child, prefix + name + ".")
+
+    walk(block, "")
+    try:
+        block(*inputs)
+    finally:
+        for h in hooks:
+            h.detach()
+    print("%-30s %-24s %-20s %-12s" % ("Layer", "Type", "Output Shape",
+                                       "Param #"))
+    print("-" * 90)
+    total = 0
+    for name, tp, shape, n in rows:
+        total += n
+        print("%-30s %-24s %-20s %-12d" % (name, tp, str(shape), n))
+    print("-" * 90)
+    print("Total params: %d" % total)
+    return total
+
+
+def _prod(t):
+    r = 1
+    for x in t:
+        r *= x
+    return r
+
+
+def plot_network(symbol, title="plot", save_format="pdf", shape=None,
+                 dtype=None, node_attrs=None, hide_weights=True):
+    """Graphviz rendering (reference visualization.py:214). Requires the
+    graphviz python package; raises otherwise (not baked into this image)."""
+    try:
+        from graphviz import Digraph
+    except ImportError:
+        raise ImportError("plot_network requires the graphviz package")
+    dot = Digraph(name=title)
+    for node in symbol._toposort():
+        if node._op is None:
+            if not hide_weights or node._name in ("data",):
+                dot.node(str(id(node)), node._name, shape="oval")
+            continue
+        dot.node(str(id(node)), "%s\n%s" % (node._name, node._op.name),
+                 shape="box")
+        for p, _ in node._inputs:
+            if p._op is not None or not hide_weights or p._name == "data":
+                dot.edge(str(id(p)), str(id(node)))
+    return dot

@@ -1,0 +1,145 @@
+"""Symbol/Module tests — semantics from reference
+`tests/python/unittest/test_module.py` + `tests/python/train/test_mlp.py`
+(tiny convergence run)."""
+import logging
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.io import NDArrayIter, DataBatch
+
+
+def _mlp_symbol(num_classes=4):
+    data = mx.sym.var("data")
+    net = mx.sym.FullyConnected(data, num_hidden=32, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu", name="relu1")
+    net = mx.sym.FullyConnected(net, num_hidden=num_classes, name="fc2")
+    return mx.sym.SoftmaxOutput(net, mx.sym.var("softmax_label"),
+                                name="softmax")
+
+
+def test_symbol_compose_and_infer():
+    out = _mlp_symbol()
+    args = out.list_arguments()
+    assert args[0] == "data"
+    assert "fc1_weight" in args and "fc2_bias" in args
+    arg_shapes, out_shapes, _ = out.infer_shape(data=(8, 10),
+                                                softmax_label=(8,))
+    d = dict(zip(args, arg_shapes))
+    assert d["fc1_weight"] == (32, 10)
+    assert d["fc2_weight"] == (4, 32)
+    assert out_shapes == [(8, 4)]
+
+
+def test_symbol_arith_and_eval():
+    a = mx.sym.var("a")
+    b = mx.sym.var("b")
+    c = 2 * a + b / a
+    ex = c.bind(mx.cpu(), {"a": mx.nd.ones((3,)) * 2,
+                           "b": mx.nd.ones((3,)) * 4})
+    out = ex.forward()[0]
+    np.testing.assert_allclose(out.asnumpy(), np.full(3, 6.0), rtol=1e-6)
+
+
+def test_symbol_json_roundtrip(tmp_path):
+    out = _mlp_symbol()
+    path = str(tmp_path / "sym.json")
+    out.save(path)
+    loaded = mx.sym.load(path)
+    assert loaded.list_arguments() == out.list_arguments()
+    a1, o1, _ = loaded.infer_shape(data=(2, 6), softmax_label=(2,))
+    assert o1 == [(2, 4)]
+
+
+def test_executor_backward_grads():
+    out = _mlp_symbol()
+    ex = out.simple_bind(mx.cpu(), data=(8, 10), softmax_label=(8,))
+    np.random.seed(0)
+    ex.arg_dict["data"][:] = np.random.randn(8, 10)
+    ex.arg_dict["fc1_weight"][:] = np.random.randn(32, 10) * 0.1
+    ex.arg_dict["fc2_weight"][:] = np.random.randn(4, 32) * 0.1
+    ex.arg_dict["softmax_label"][:] = np.arange(8) % 4
+    ex.forward(is_train=True)
+    ex.backward()
+    for name in ("fc1_weight", "fc2_weight", "fc1_bias", "fc2_bias"):
+        g = ex.grad_dict[name].asnumpy()
+        assert np.isfinite(g).all()
+        assert np.abs(g).sum() > 0
+
+
+def test_module_fit_converges():
+    """Tiny MLP convergence (reference tests/python/train/test_mlp.py)."""
+    np.random.seed(0)
+    mx.random.seed(0)
+    N, D, C = 256, 10, 4
+    X = np.random.randn(N, D).astype("float32")
+    W = np.random.randn(D, C).astype("float32")
+    Y = (X @ W).argmax(1).astype("float32")
+    train = NDArrayIter(X, Y, batch_size=32, shuffle=True)
+    val = NDArrayIter(X, Y, batch_size=32)
+    mod = mx.mod.Module(_mlp_symbol(C), context=mx.cpu())
+    mod.fit(train, eval_data=val, optimizer="adam",
+            optimizer_params={"learning_rate": 0.01},
+            num_epoch=6, initializer=mx.init.Xavier())
+    score = mod.score(val, "acc")
+    assert score[0][1] > 0.9, score
+
+
+def test_module_predict_and_outputs():
+    np.random.seed(0)
+    X = np.random.randn(40, 10).astype("float32")
+    Y = np.zeros(40, "float32")
+    it = NDArrayIter(X, Y, batch_size=8)
+    mod = mx.mod.Module(_mlp_symbol(), context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params()
+    pred = mod.predict(it)
+    assert pred.shape == (40, 4)
+
+
+def test_module_save_load_checkpoint(tmp_path):
+    np.random.seed(0)
+    prefix = str(tmp_path / "mlp")
+    X = np.random.randn(16, 10).astype("float32")
+    Y = np.zeros(16, "float32")
+    it = NDArrayIter(X, Y, batch_size=8)
+    mod = mx.mod.Module(_mlp_symbol(), context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params()
+    mod.save_checkpoint(prefix, 3)
+    mod2 = mx.mod.Module.load(prefix, 3, context=mx.cpu())
+    mod2.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod2.init_params()
+    p1 = mod.predict(it).asnumpy()
+    it.reset()
+    p2 = mod2.predict(it).asnumpy()
+    np.testing.assert_allclose(p1, p2, rtol=1e-5, atol=1e-6)
+
+
+def test_bucketing_module():
+    np.random.seed(0)
+
+    def sym_gen(seq_len):
+        data = mx.sym.var("data")
+        fc = mx.sym.FullyConnected(data, num_hidden=4, name="fc_shared")
+        out = mx.sym.SoftmaxOutput(fc, mx.sym.var("softmax_label"),
+                                   name="softmax")
+        return out, ("data",), ("softmax_label",)
+
+    mod = mx.mod.BucketingModule(sym_gen, default_bucket_key=10,
+                                 context=mx.cpu())
+    mod.bind(data_shapes=[("data", (4, 10))],
+             label_shapes=[("softmax_label", (4,))])
+    mod.init_params()
+    mod.init_optimizer()
+    from mxnet_tpu.io import DataDesc
+    batch = DataBatch(data=[mx.nd.ones((4, 10))],
+                      label=[mx.nd.zeros((4,))], bucket_key=10,
+                      provide_data=[DataDesc("data", (4, 10))],
+                      provide_label=[DataDesc("softmax_label", (4,))])
+    mod.forward(batch, is_train=True)
+    mod.backward()
+    mod.update()
+    out = mod.get_outputs()[0]
+    assert out.shape == (4, 4)
