@@ -1,0 +1,414 @@
+"""``mx.np``: NumPy-compatible array frontend.
+
+Parity surface: reference ``python/mxnet/numpy/`` (8.6K LoC: `ndarray`
+subclass with NumPy semantics over the same runtime, function namespace,
+dispatch protocol `numpy_dispatch_protocol.py`).
+
+TPU-native design: the same NDArray handle layer, NumPy semantics supplied
+directly by jax.numpy (which IS a NumPy-compatible API) — every function
+here unwraps handles, calls the identical-named jnp function, wraps, and
+records on the autograd tape via a generic recorded-op path, so
+``mx.np`` arrays work under ``autograd.record`` and inside hybridized
+blocks exactly like ``mx.nd`` arrays.
+"""
+from __future__ import annotations
+
+import numpy as _onp
+
+import jax
+import jax.numpy as _jnp
+
+from ..base import dtype_np
+from ..context import current_context
+from ..ndarray.ndarray import NDArray as _NDArrayBase
+from .. import _tape
+
+pi = _onp.pi
+e = _onp.e
+inf = _onp.inf
+nan = _onp.nan
+newaxis = None
+euler_gamma = _onp.euler_gamma
+
+float32 = _onp.float32
+float64 = _onp.float64
+float16 = _onp.float16
+int8 = _onp.int8
+int32 = _onp.int32
+int64 = _onp.int64
+uint8 = _onp.uint8
+bool_ = _onp.bool_
+_np = _onp
+
+
+class ndarray(_NDArrayBase):
+    """mx.np.ndarray — NumPy-semantics array (reference
+    `python/mxnet/numpy/multiarray.py:70`)."""
+
+    def __repr__(self):
+        try:
+            return "array(%s)" % _onp.array2string(self.asnumpy(),
+                                                   separator=", ")
+        except Exception:
+            return "array(<traced>)"
+
+    def __getitem__(self, key):
+        # numpy basic+advanced indexing straight through jax
+        if isinstance(key, _NDArrayBase):
+            key = key._data
+        if isinstance(key, tuple):
+            key = tuple(k._data if isinstance(k, _NDArrayBase) else k
+                        for k in key)
+        return _wrap_record("getitem", lambda v, key=key: v[key], self)
+
+    def __len__(self):
+        if not self.shape:
+            raise TypeError("len() of 0-d array")
+        return self.shape[0]
+
+    def _binop(self, other, name, reverse=False):
+        out = super()._binop(other, name, reverse)
+        return _as_np(out)
+
+    def reshape(self, *shape, **kwargs):
+        if len(shape) == 1 and isinstance(shape[0], (list, tuple)):
+            shape = tuple(shape[0])
+        return _wrap_record("reshape",
+                            lambda v: _jnp.reshape(v, shape), self)
+
+    def astype(self, dtype, copy=True):
+        return _wrap_record("astype",
+                            lambda v: v.astype(dtype_np(dtype)), self)
+
+    def item(self):
+        return self.asnumpy().item()
+
+    def tolist(self):
+        return self.asnumpy().tolist()
+
+    def as_nd_ndarray(self):
+        from ..ndarray.ndarray import NDArray
+        out = NDArray(self._data, ctx=self._ctx)
+        out._ag_node = self._ag_node
+        return out
+
+    def as_np_ndarray(self):
+        return self
+
+
+def _as_np(arr):
+    if isinstance(arr, tuple):
+        return tuple(_as_np(a) for a in arr)
+    if isinstance(arr, _NDArrayBase) and not isinstance(arr, ndarray):
+        out = ndarray(arr._data, ctx=arr._ctx)
+        out._ag_node = arr._ag_node
+        return out
+    return arr
+
+
+def _wrap_record(name, fn, *arrays, n_out=1):
+    """Apply a pure jnp closure to handles, recording on the tape."""
+    vals = []
+    parents = []
+    for a in arrays:
+        if isinstance(a, _NDArrayBase):
+            vals.append(a._data)
+            node = a._ag_node
+            parents.append(node if node is not None else _tape.Const(a._data))
+        else:
+            vals.append(a)
+            parents.append(_tape.Const(a))
+    out_vals = fn(*vals)
+    multi = isinstance(out_vals, (tuple, list))
+    outs = tuple(out_vals) if multi else (out_vals,)
+    node = None
+    if _tape.is_recording():
+        node = _tape.OpNode(fn, parents, len(outs), {}, "np." + name)
+    results = []
+    for i, v in enumerate(outs):
+        r = ndarray(v)
+        if node is not None:
+            r._ag_node = (node, i)
+        results.append(r)
+    return tuple(results) if multi else results[0]
+
+
+def array(object, dtype=None, ctx=None):
+    if isinstance(object, _NDArrayBase):
+        out = ndarray(object._data, ctx=ctx)
+        if dtype is not None:
+            out = out.astype(dtype)
+        return out
+    from_py = not isinstance(object, (_onp.ndarray, _jnp.ndarray))
+    a = _onp.asarray(object, dtype=dtype_np(dtype) if dtype else None)
+    if dtype is None and (a.dtype == _onp.float64 or
+                          (from_py and a.dtype.kind in "iu")):
+        # python containers default to float32 (reference mx.np.array doc)
+        a = a.astype(_onp.float32)
+    return ndarray(a, ctx=ctx)
+
+
+def _creation(jnp_fn):
+    def fn(*args, dtype=None, ctx=None, **kwargs):
+        kwargs.pop("order", None)
+        v = jnp_fn(*args, dtype=dtype_np(dtype) if dtype else None, **kwargs)
+        return ndarray(v, ctx=ctx)
+    return fn
+
+
+zeros = _creation(_jnp.zeros)
+ones = _creation(_jnp.ones)
+empty = _creation(_jnp.zeros)
+
+
+def full(shape, fill_value, dtype=None, ctx=None, **kwargs):
+    return ndarray(_jnp.full(shape, fill_value,
+                             dtype=dtype_np(dtype) if dtype else None))
+
+
+def arange(start, stop=None, step=1, dtype=None, ctx=None):
+    return ndarray(_jnp.arange(start, stop, step,
+                               dtype=dtype_np(dtype) if dtype else None))
+
+
+def linspace(start, stop, num=50, endpoint=True, retstep=False, dtype=None,
+             axis=0, ctx=None):
+    v = _jnp.linspace(start, stop, num, endpoint=endpoint, retstep=retstep,
+                      dtype=dtype_np(dtype) if dtype else None, axis=axis)
+    if retstep:
+        return ndarray(v[0]), v[1]
+    return ndarray(v)
+
+
+def logspace(start, stop, num=50, endpoint=True, base=10.0, dtype=None,
+             axis=0, ctx=None):
+    return ndarray(_jnp.logspace(start, stop, num, endpoint, base,
+                                 dtype_np(dtype) if dtype else None, axis))
+
+
+def eye(N, M=None, k=0, dtype=None, ctx=None):
+    return ndarray(_jnp.eye(N, M, k=k,
+                            dtype=dtype_np(dtype) if dtype else None))
+
+
+def identity(n, dtype=None, ctx=None):
+    return eye(n, dtype=dtype)
+
+
+def _unwrap(a):
+    return a._data if isinstance(a, _NDArrayBase) else a
+
+
+def _make_unary(name):
+    jfn = getattr(_jnp, name)
+
+    def fn(x, *args, **kwargs):
+        kwargs.pop("out", None)
+        if isinstance(x, _NDArrayBase):
+            return _wrap_record(name,
+                                lambda v: jfn(v, *map(_unwrap, args),
+                                              **kwargs), x)
+        return ndarray(jfn(x, *args, **kwargs))
+    fn.__name__ = name
+    return fn
+
+
+def _make_binary(name):
+    jfn = getattr(_jnp, name)
+
+    def fn(a, b, *args, **kwargs):
+        kwargs.pop("out", None)
+        arrs = []
+        if isinstance(a, _NDArrayBase):
+            arrs.append(a)
+        if isinstance(b, _NDArrayBase):
+            arrs.append(b)
+        av = _unwrap(a)
+        bv = _unwrap(b)
+        if isinstance(a, _NDArrayBase) and isinstance(b, _NDArrayBase):
+            return _wrap_record(name, lambda x, y: jfn(x, y, **kwargs), a, b)
+        if isinstance(a, _NDArrayBase):
+            return _wrap_record(name, lambda x: jfn(x, bv, **kwargs), a)
+        if isinstance(b, _NDArrayBase):
+            return _wrap_record(name, lambda y: jfn(av, y, **kwargs), b)
+        return ndarray(jfn(av, bv, **kwargs))
+    fn.__name__ = name
+    return fn
+
+
+_UNARY = ["abs", "absolute", "sign", "sqrt", "cbrt", "square", "exp",
+          "expm1", "log", "log2", "log10", "log1p", "sin", "cos", "tan",
+          "arcsin", "arccos", "arctan", "sinh", "cosh", "tanh", "arcsinh",
+          "arccosh", "arctanh", "floor", "ceil", "trunc", "rint", "fix",
+          "negative", "reciprocal", "degrees", "radians", "isnan", "isinf",
+          "isfinite", "logical_not", "sort", "argsort", "copy", "conj",
+          "real", "imag", "angle", "exp2", "positive", "invert"]
+
+_BINARY = ["add", "subtract", "multiply", "divide", "true_divide", "mod",
+           "remainder", "power", "float_power", "maximum", "minimum",
+           "hypot", "arctan2", "logaddexp", "copysign", "fmod", "fmax",
+           "fmin", "equal", "not_equal", "greater", "greater_equal", "less",
+           "less_equal", "logical_and", "logical_or", "logical_xor",
+           "bitwise_and", "bitwise_or", "bitwise_xor", "left_shift",
+           "right_shift", "matmul", "dot", "outer", "inner", "cross",
+           "kron", "gcd", "lcm", "heaviside", "ldexp"]
+
+for _n in _UNARY:
+    globals()[_n] = _make_unary(_n)
+for _n in _BINARY:
+    globals()[_n] = _make_binary(_n)
+
+
+def _make_axis_fn(name):
+    jfn = getattr(_jnp, name)
+
+    def fn(a, *args, **kwargs):
+        kwargs.pop("out", None)
+        return _wrap_record(name,
+                            lambda v: jfn(v, *[_unwrap(x) for x in args],
+                                          **kwargs), a) \
+            if isinstance(a, _NDArrayBase) else ndarray(jfn(a, *args,
+                                                            **kwargs))
+    fn.__name__ = name
+    return fn
+
+
+_AXIS_FNS = ["sum", "mean", "std", "var", "prod", "max", "min", "amax",
+             "amin", "argmax", "argmin", "cumsum", "cumprod", "all", "any",
+             "median", "quantile", "percentile", "nanmean", "nansum",
+             "transpose", "squeeze", "expand_dims", "ravel", "flip",
+             "flipud", "fliplr", "roll", "rot90", "tile", "repeat", "unique",
+             "diff", "clip", "around", "round", "reshape", "swapaxes",
+             "moveaxis", "rollaxis", "broadcast_to", "atleast_1d",
+             "atleast_2d", "atleast_3d", "trace", "diagonal", "diag",
+             "tril", "triu", "nonzero", "count_nonzero", "searchsorted",
+             "partition", "argpartition", "pad", "average", "nan_to_num",
+             "take", "compress", "delete", "insert", "append", "resize",
+             "trim_zeros", "ediff1d", "bincount", "digitize", "histogram"]
+
+for _n in _AXIS_FNS:
+    if hasattr(_jnp, _n):
+        globals()[_n] = _make_axis_fn(_n)
+
+
+def concatenate(seq, axis=0, out=None):
+    return _wrap_record("concatenate",
+                        lambda *vs: _jnp.concatenate(vs, axis=axis), *seq)
+
+
+def stack(arrays, axis=0, out=None):
+    return _wrap_record("stack",
+                        lambda *vs: _jnp.stack(vs, axis=axis), *arrays)
+
+
+def vstack(tup):
+    return _wrap_record("vstack", lambda *vs: _jnp.vstack(vs), *tup)
+
+
+def hstack(tup):
+    return _wrap_record("hstack", lambda *vs: _jnp.hstack(vs), *tup)
+
+
+def dstack(tup):
+    return _wrap_record("dstack", lambda *vs: _jnp.dstack(vs), *tup)
+
+
+def column_stack(tup):
+    return _wrap_record("column_stack",
+                        lambda *vs: _jnp.column_stack(vs), *tup)
+
+
+def split(ary, indices_or_sections, axis=0):
+    return _wrap_record(
+        "split",
+        lambda v: tuple(_jnp.split(v, indices_or_sections, axis=axis)), ary)
+
+
+def array_split(ary, indices_or_sections, axis=0):
+    return _wrap_record(
+        "array_split",
+        lambda v: tuple(_jnp.array_split(v, indices_or_sections,
+                                         axis=axis)), ary)
+
+
+def where(condition, x=None, y=None):
+    if x is None and y is None:
+        return _wrap_record("where",
+                            lambda c: tuple(_jnp.where(c)), condition)
+    arrs = [a for a in (condition, x, y)]
+    return _wrap_record("where",
+                        lambda c, xx, yy: _jnp.where(c, xx, yy),
+                        *arrs)
+
+
+def einsum(subscripts, *operands, **kwargs):
+    return _wrap_record(
+        "einsum", lambda *vs: _jnp.einsum(subscripts, *vs, **kwargs),
+        *operands)
+
+
+def tensordot(a, b, axes=2):
+    return _wrap_record("tensordot",
+                        lambda x, y: _jnp.tensordot(x, y, axes=axes), a, b)
+
+
+def meshgrid(*xi, **kwargs):
+    return _wrap_record("meshgrid",
+                        lambda *vs: tuple(_jnp.meshgrid(*vs, **kwargs)), *xi)
+
+
+def zeros_like(a, dtype=None, order="C", ctx=None):
+    return _wrap_record("zeros_like",
+                        lambda v: _jnp.zeros_like(
+                            v, dtype=dtype_np(dtype) if dtype else None), a)
+
+
+def ones_like(a, dtype=None, order="C", ctx=None):
+    return _wrap_record("ones_like",
+                        lambda v: _jnp.ones_like(
+                            v, dtype=dtype_np(dtype) if dtype else None), a)
+
+
+def full_like(a, fill_value, dtype=None, ctx=None):
+    return _wrap_record("full_like",
+                        lambda v: _jnp.full_like(
+                            v, fill_value,
+                            dtype=dtype_np(dtype) if dtype else None), a)
+
+
+def may_share_memory(a, b, max_work=None):
+    return False
+
+
+def shares_memory(a, b, max_work=None):
+    return False
+
+
+def asnumpy(a):
+    return a.asnumpy()
+
+
+def isscalar(x):
+    return _onp.isscalar(x)
+
+
+def result_type(*arrays_and_dtypes):
+    return _onp.result_type(*[a.dtype if isinstance(a, _NDArrayBase) else a
+                              for a in arrays_and_dtypes])
+
+
+def broadcast_arrays(*args):
+    return _wrap_record("broadcast_arrays",
+                        lambda *vs: tuple(_jnp.broadcast_arrays(*vs)), *args)
+
+
+def allclose(a, b, rtol=1e-05, atol=1e-08, equal_nan=False):
+    return bool(_jnp.allclose(_unwrap(a), _unwrap(b), rtol, atol, equal_nan))
+
+
+def array_equal(a1, a2, equal_nan=False):
+    return bool(_jnp.array_equal(_unwrap(a1), _unwrap(a2)))
+
+
+from . import random  # noqa: E402,F401
+from . import linalg  # noqa: E402,F401

@@ -1,0 +1,52 @@
+"""mx.np.linalg (reference ``python/mxnet/numpy/linalg.py``) over
+jax.numpy.linalg."""
+from __future__ import annotations
+
+import jax.numpy as _jnp
+
+
+def _np():
+    from .. import numpy as np_mod
+    return np_mod
+
+
+def _wrap(name):
+    jfn = getattr(_jnp.linalg, name)
+
+    def fn(*args, **kwargs):
+        np_mod = _np()
+        arrs = [a for a in args if hasattr(a, "_data")]
+        rest = [a._data if hasattr(a, "_data") else a for a in args]
+
+        def run(*vals):
+            it = iter(vals)
+            real_args = [next(it) if hasattr(a, "_data") else a
+                         for a in args]
+            out = jfn(*real_args, **kwargs)
+            if isinstance(out, tuple):
+                return tuple(out)
+            return out
+        return np_mod._wrap_record("linalg." + name, run, *arrs)
+    fn.__name__ = name
+    return fn
+
+
+norm = _wrap("norm")
+svd = _wrap("svd")
+inv = _wrap("inv")
+pinv = _wrap("pinv")
+det = _wrap("det")
+slogdet = _wrap("slogdet")
+cholesky = _wrap("cholesky")
+qr = _wrap("qr")
+eig = _wrap("eig")
+eigh = _wrap("eigh")
+eigvals = _wrap("eigvals")
+eigvalsh = _wrap("eigvalsh")
+solve = _wrap("solve")
+lstsq = _wrap("lstsq")
+matrix_rank = _wrap("matrix_rank")
+matrix_power = _wrap("matrix_power")
+tensorinv = _wrap("tensorinv")
+tensorsolve = _wrap("tensorsolve")
+multi_dot = _wrap("multi_dot")

@@ -1,0 +1,126 @@
+"""mx.np.random (reference ``python/mxnet/numpy/random.py``) — stateful
+NumPy-style RNG over the framework key service."""
+from __future__ import annotations
+
+import numpy as _onp
+import jax
+
+from .. import random as _rnd
+from ..base import dtype_np
+
+
+def _np():
+    from .. import numpy as np_mod
+    return np_mod
+
+
+def _shape(size):
+    if size is None:
+        return ()
+    if isinstance(size, int):
+        return (size,)
+    return tuple(size)
+
+
+def seed(seed=None):
+    _rnd.seed(seed if seed is not None else _onp.random.randint(2 ** 31))
+
+
+def uniform(low=0.0, high=1.0, size=None, dtype=None, ctx=None, out=None):
+    v = jax.random.uniform(_rnd.next_key(), _shape(size),
+                           dtype_np(dtype or "float32"), low, high)
+    return _np().ndarray(v)
+
+
+def normal(loc=0.0, scale=1.0, size=None, dtype=None, ctx=None, out=None):
+    v = loc + scale * jax.random.normal(_rnd.next_key(), _shape(size),
+                                        dtype_np(dtype or "float32"))
+    return _np().ndarray(v)
+
+
+def randn(*size):
+    return normal(size=size or None)
+
+
+def rand(*size):
+    return uniform(size=size or None)
+
+
+def randint(low, high=None, size=None, dtype=None, ctx=None, out=None):
+    if high is None:
+        low, high = 0, low
+    v = jax.random.randint(_rnd.next_key(), _shape(size), low, high,
+                           dtype_np(dtype or "int64"))
+    return _np().ndarray(v)
+
+
+def choice(a, size=None, replace=True, p=None, ctx=None, out=None):
+    av = a._data if hasattr(a, "_data") else a
+    pv = p._data if hasattr(p, "_data") else p
+    v = jax.random.choice(_rnd.next_key(), av, _shape(size), replace, pv)
+    return _np().ndarray(v)
+
+
+def shuffle(x):
+    perm = jax.random.permutation(_rnd.next_key(), x.shape[0])
+    import jax.numpy as jnp
+    x._data = jnp.take(x._data, perm, axis=0)
+
+
+def permutation(x):
+    import jax.numpy as jnp
+    if isinstance(x, int):
+        return _np().ndarray(jax.random.permutation(_rnd.next_key(), x))
+    xv = x._data if hasattr(x, "_data") else jnp.asarray(x)
+    perm = jax.random.permutation(_rnd.next_key(), xv.shape[0])
+    return _np().ndarray(jnp.take(xv, perm, axis=0))
+
+
+def gamma(shape, scale=1.0, size=None, dtype=None, ctx=None, out=None):
+    v = jax.random.gamma(_rnd.next_key(), shape, _shape(size),
+                         dtype_np(dtype or "float32")) * scale
+    return _np().ndarray(v)
+
+
+def beta(a, b, size=None, dtype=None, ctx=None):
+    v = jax.random.beta(_rnd.next_key(), a, b, _shape(size))
+    return _np().ndarray(v.astype(dtype_np(dtype or "float32")))
+
+
+def exponential(scale=1.0, size=None, dtype=None, ctx=None, out=None):
+    v = jax.random.exponential(_rnd.next_key(), _shape(size)) * scale
+    return _np().ndarray(v.astype(dtype_np(dtype or "float32")))
+
+
+def poisson(lam=1.0, size=None, dtype=None, ctx=None, out=None):
+    v = jax.random.poisson(_rnd.next_key(), lam, _shape(size))
+    return _np().ndarray(v)
+
+
+def multinomial(n, pvals, size=None):
+    import jax.numpy as jnp
+    pv = pvals._data if hasattr(pvals, "_data") else jnp.asarray(pvals)
+    shape = _shape(size) + (len(pv),)
+    counts = jnp.zeros(shape)
+    draws = jax.random.categorical(
+        _rnd.next_key(), jnp.log(jnp.maximum(pv, 1e-37)),
+        shape=_shape(size) + (n,))
+    oh = jax.nn.one_hot(draws, len(pv)).sum(axis=-2)
+    return _np().ndarray(oh.astype("int64"))
+
+
+def logistic(loc=0.0, scale=1.0, size=None, ctx=None, out=None):
+    v = loc + scale * jax.random.logistic(_rnd.next_key(), _shape(size))
+    return _np().ndarray(v)
+
+
+def gumbel(loc=0.0, scale=1.0, size=None, ctx=None, out=None):
+    v = loc + scale * jax.random.gumbel(_rnd.next_key(), _shape(size))
+    return _np().ndarray(v)
+
+
+def lognormal(mean=0.0, sigma=1.0, size=None, dtype=None, ctx=None, out=None):
+    import jax.numpy as jnp
+    v = jnp.exp(mean + sigma * jax.random.normal(_rnd.next_key(),
+                                                 _shape(size)))
+    return _np().ndarray(v)

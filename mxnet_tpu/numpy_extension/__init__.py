@@ -1,0 +1,123 @@
+"""``mx.npx``: numpy-extension namespace (reference
+``python/mxnet/numpy_extension/``) — NN operators + utility entry points
+for numpy-mode code."""
+from __future__ import annotations
+
+from ..ndarray.ndarray import NDArray as _NDArrayBase
+from ..ops.registry import get_op as _get_op, list_ops as _list_ops
+
+_np_mode = [False]
+
+
+def set_np(shape=True, array=True, dtype=False):
+    """reference numpy_extension set_np/use_np."""
+    _np_mode[0] = True
+
+
+def reset_np():
+    _np_mode[0] = False
+
+
+def is_np_array():
+    return _np_mode[0]
+
+
+def is_np_shape():
+    return _np_mode[0]
+
+
+def use_np(func):
+    """Decorator parity (reference npx.use_np) — numpy semantics are always
+    on in this build, so this is identity."""
+    return func
+
+
+use_np_shape = use_np
+use_np_array = use_np
+
+
+class _OpProxy:
+    def __init__(self, op):
+        self._op = op
+
+    def __call__(self, *args, **kwargs):
+        from .. import numpy as np_mod
+        out = self._op(*args, **kwargs)
+        return np_mod._as_np(out)
+
+
+def __getattr__(name):
+    op = _get_op(name)
+    if op is not None:
+        return _OpProxy(op)
+    raise AttributeError("module 'mxnet_tpu.numpy_extension' has no "
+                         "attribute %r" % name)
+
+
+# commonly used npx entry points
+def softmax(data, axis=-1, **kwargs):
+    return __getattr__("softmax")(data, axis=axis, **kwargs)
+
+
+def log_softmax(data, axis=-1, **kwargs):
+    return __getattr__("log_softmax")(data, axis=axis, **kwargs)
+
+
+def relu(data):
+    return __getattr__("relu")(data)
+
+
+def sigmoid(data):
+    return __getattr__("sigmoid")(data)
+
+
+def batch_norm(x, gamma, beta, running_mean, running_var, **kwargs):
+    return __getattr__("BatchNorm")(x, gamma, beta, running_mean,
+                                    running_var, **kwargs)
+
+
+def convolution(data=None, weight=None, bias=None, **kwargs):
+    return __getattr__("Convolution")(data, weight, bias, **kwargs)
+
+
+def fully_connected(x, weight, bias=None, **kwargs):
+    return __getattr__("FullyConnected")(x, weight, bias, **kwargs)
+
+
+def pooling(data, **kwargs):
+    return __getattr__("Pooling")(data, **kwargs)
+
+
+def one_hot(data, depth, **kwargs):
+    return __getattr__("one_hot")(data, depth=depth, **kwargs)
+
+
+def pick(data, index, axis=-1, **kwargs):
+    return __getattr__("pick")(data, index, axis=axis, **kwargs)
+
+
+def reshape_like(lhs, rhs):
+    return __getattr__("reshape_like")(lhs, rhs)
+
+
+def topk(data, axis=-1, k=1, **kwargs):
+    return __getattr__("topk")(data, axis=axis, k=k, **kwargs)
+
+
+def waitall():
+    from ..ndarray import ndarray as _nd
+    _nd.waitall()
+
+
+def load(fname):
+    from ..ndarray import ndarray as _nd
+    from .. import numpy as np_mod
+    out = _nd.load(fname)
+    if isinstance(out, dict):
+        return {k: np_mod._as_np(v) for k, v in out.items()}
+    return [np_mod._as_np(v) for v in out]
+
+
+def save(fname, data):
+    from ..ndarray import ndarray as _nd
+    _nd.save(fname, data)

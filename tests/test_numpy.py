@@ -1,0 +1,135 @@
+"""mx.np frontend tests (reference `tests/python/unittest/test_numpy_op.py`
+/ `test_numpy_ndarray.py` semantics, reduced)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd as ag
+
+np = mx.np
+npx = mx.npx
+
+
+def test_array_creation():
+    a = np.array([[1, 2], [3, 4]])
+    assert isinstance(a, np.ndarray)
+    assert a.shape == (2, 2)
+    assert a.dtype == onp.float32
+    onp.testing.assert_allclose(np.zeros((2, 3)).asnumpy(),
+                                onp.zeros((2, 3)))
+    onp.testing.assert_allclose(np.ones((2,)).asnumpy(), onp.ones(2))
+    onp.testing.assert_allclose(np.arange(5).asnumpy(), onp.arange(5))
+    onp.testing.assert_allclose(np.eye(3).asnumpy(), onp.eye(3))
+    onp.testing.assert_allclose(np.linspace(0, 1, 5).asnumpy(),
+                                onp.linspace(0, 1, 5), rtol=1e-6)
+
+
+@pytest.mark.parametrize("fn,args", [
+    ("sqrt", ([4.0, 9.0],)), ("exp", ([0.0, 1.0],)),
+    ("log", ([1.0, onp.e],)), ("sin", ([0.0, 1.0],)),
+    ("tanh", ([0.0, 1.0],)), ("floor", ([1.5, -1.5],)),
+    ("abs", ([-2.0, 3.0],)),
+])
+def test_unary_matches_numpy(fn, args):
+    x = onp.array(args[0], dtype="float32")
+    got = getattr(np, fn)(np.array(x)).asnumpy()
+    want = getattr(onp, fn)(x)
+    onp.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_binary_and_broadcasting():
+    a = np.array([[1.0, 2], [3, 4]])
+    b = np.array([10.0, 20])
+    onp.testing.assert_allclose((a + b).asnumpy(),
+                                a.asnumpy() + b.asnumpy())
+    onp.testing.assert_allclose((a * 2).asnumpy(), a.asnumpy() * 2)
+    onp.testing.assert_allclose(np.maximum(a, b).asnumpy(),
+                                onp.maximum(a.asnumpy(), b.asnumpy()))
+    onp.testing.assert_allclose(np.matmul(a, a).asnumpy(),
+                                a.asnumpy() @ a.asnumpy(), rtol=1e-5)
+
+
+def test_reductions_and_shapes():
+    x = np.array(onp.arange(24, dtype="float32").reshape(2, 3, 4))
+    assert float(np.sum(x).asnumpy()) == 276
+    onp.testing.assert_allclose(np.mean(x, axis=1).asnumpy(),
+                                x.asnumpy().mean(1), rtol=1e-6)
+    assert np.transpose(x).shape == (4, 3, 2)
+    assert x.reshape(6, 4).shape == (6, 4)
+    assert np.expand_dims(x, 0).shape == (1, 2, 3, 4)
+    assert np.concatenate([x, x], axis=0).shape == (4, 3, 4)
+    assert np.stack([x, x]).shape == (2, 2, 3, 4)
+    parts = np.split(x, 3, axis=1)
+    assert len(parts) == 3 and parts[0].shape == (2, 1, 4)
+
+
+def test_indexing():
+    x = np.array(onp.arange(12).reshape(3, 4))
+    onp.testing.assert_allclose(x[1].asnumpy(), onp.arange(4) + 4)
+    onp.testing.assert_allclose(x[:, 1].asnumpy(), [1, 5, 9])
+    onp.testing.assert_allclose(x[1:, 2:].asnumpy(), [[6, 7], [10, 11]])
+    idx = np.array([0, 2]).astype("int32")
+    onp.testing.assert_allclose(x[idx].asnumpy(),
+                                x.asnumpy()[[0, 2]])
+
+
+def test_autograd_through_np_ops():
+    x = np.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with ag.record():
+        y = np.sum(np.square(x) * 2)
+    y.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), 4 * x.asnumpy(),
+                                rtol=1e-6)
+
+
+def test_np_random():
+    mx.random.seed(42)
+    u = np.random.uniform(size=(100,))
+    assert u.shape == (100,)
+    assert 0 <= float(np.min(u).asnumpy()) and \
+        float(np.max(u).asnumpy()) <= 1
+    n = np.random.normal(loc=5.0, scale=0.1, size=(500,))
+    assert abs(float(np.mean(n).asnumpy()) - 5.0) < 0.1
+    r = np.random.randint(0, 10, size=(20,))
+    assert r.asnumpy().min() >= 0 and r.asnumpy().max() < 10
+
+
+def test_np_linalg():
+    a = onp.array([[2.0, 0], [0, 3.0]], dtype="float32")
+    x = np.array(a)
+    onp.testing.assert_allclose(np.linalg.inv(x).asnumpy(),
+                                onp.linalg.inv(a), rtol=1e-5)
+    assert abs(float(np.linalg.det(x).asnumpy()) - 6.0) < 1e-4
+    u, s, vt = np.linalg.svd(x)
+    onp.testing.assert_allclose(onp.sort(s.asnumpy()), [2, 3], rtol=1e-5)
+
+
+def test_npx_ops_and_np_mode():
+    x = np.array([[1.0, 2], [3, 4]])
+    s = npx.softmax(x)
+    assert isinstance(s, np.ndarray)
+    onp.testing.assert_allclose(s.asnumpy().sum(1), [1, 1], rtol=1e-6)
+    npx.set_np()
+    assert npx.is_np_array()
+    npx.reset_np()
+
+
+def test_nd_np_conversion():
+    a = mx.nd.array([1.0, 2.0])
+    b = a.as_np_ndarray()
+    assert isinstance(b, np.ndarray)
+    c = b.as_nd_ndarray()
+    assert type(c).__name__ == "NDArray"
+    onp.testing.assert_allclose(c.asnumpy(), a.asnumpy())
+
+
+def test_where_einsum():
+    a = np.array([1.0, -1.0, 2.0])
+    out = np.where(a > 0, a, np.zeros_like(a))
+    onp.testing.assert_allclose(out.asnumpy(), [1, 0, 2])
+    x = np.array(onp.random.rand(3, 4).astype("float32"))
+    y = np.array(onp.random.rand(4, 5).astype("float32"))
+    onp.testing.assert_allclose(
+        np.einsum("ij,jk->ik", x, y).asnumpy(),
+        x.asnumpy() @ y.asnumpy(), rtol=1e-5)
