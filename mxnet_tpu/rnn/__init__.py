@@ -1,0 +1,8 @@
+"""Legacy mx.rnn namespace (reference ``python/mxnet/rnn/``: BucketSentenceIter,
+legacy symbolic RNN cells). The cell classes alias the gluon implementations
+(the reference's legacy cells predate Gluon; one implementation serves both
+surfaces here)."""
+from .io import BucketSentenceIter, encode_sentences
+from ..gluon.rnn import (RNNCell, LSTMCell, GRUCell, SequentialRNNCell,
+                         BidirectionalCell, DropoutCell, ZoneoutCell,
+                         ResidualCell)
