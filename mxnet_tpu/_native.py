@@ -1,0 +1,188 @@
+"""ctypes bridge to the native runtime library (lib/libmxtpu.so).
+
+Role parity: reference ``python/mxnet/base.py`` `_load_lib` + `check_call`
+over the flat C ABI (`include/mxnet/c_api.h`). The library is optional:
+``available()`` gates use, and ``build()`` compiles it in-tree with the
+bundled Makefile (g++/OpenMP). Python fallbacks exist for every native
+path, matching the reference's principle that the C ABI is the only
+frontend/runtime crossing (SURVEY §1 L5).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+
+import numpy as np
+
+__all__ = ["available", "build", "lib", "check_call", "NativeError",
+           "recordio_scan", "assemble_batch", "Pump"]
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_LIB_PATH = os.path.join(_ROOT, "lib", "libmxtpu.so")
+_lib = None
+
+
+class NativeError(RuntimeError):
+    pass
+
+
+def _try_load():
+    global _lib
+    if _lib is not None:
+        return _lib
+    if not os.path.exists(_LIB_PATH):
+        return None
+    try:
+        lib = ctypes.CDLL(_LIB_PATH)
+    except OSError:
+        return None
+    lib.mxtpu_last_error.restype = ctypes.c_char_p
+    lib.mxtpu_recordio_scan.restype = ctypes.c_int64
+    lib.mxtpu_recordio_scan.argtypes = [
+        ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_int64]
+    lib.mxtpu_recordio_count.restype = ctypes.c_int64
+    lib.mxtpu_recordio_count.argtypes = [ctypes.c_char_p]
+    lib.mxtpu_assemble_batch.restype = ctypes.c_int
+    lib.mxtpu_assemble_batch.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, ctypes.c_int, ctypes.c_void_p, ctypes.c_void_p,
+        ctypes.c_int, ctypes.c_uint64, ctypes.c_void_p, ctypes.c_void_p]
+    lib.mxtpu_pump_create.restype = ctypes.c_void_p
+    lib.mxtpu_pump_create.argtypes = [
+        ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int,
+        ctypes.c_int, ctypes.c_uint64, ctypes.c_int]
+    lib.mxtpu_pump_next.restype = ctypes.c_int
+    lib.mxtpu_pump_next.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                    ctypes.c_void_p]
+    lib.mxtpu_pump_reset.argtypes = [ctypes.c_void_p]
+    lib.mxtpu_pump_batches_per_epoch.restype = ctypes.c_int
+    lib.mxtpu_pump_batches_per_epoch.argtypes = [ctypes.c_void_p]
+    lib.mxtpu_pump_destroy.argtypes = [ctypes.c_void_p]
+    _lib = lib
+    return lib
+
+
+def build(verbose=False):
+    """Compile lib/libmxtpu.so from src/ (in-tree Makefile)."""
+    src = os.path.join(_ROOT, "src")
+    res = subprocess.run(["make", "-C", src],
+                         capture_output=not verbose)
+    if res.returncode != 0:
+        raise NativeError("native build failed: %s"
+                          % (res.stderr or b"").decode()[-500:])
+    global _lib
+    _lib = None
+    return _try_load() is not None
+
+
+def available():
+    return _try_load() is not None
+
+
+def lib():
+    l = _try_load()
+    if l is None:
+        raise NativeError("libmxtpu.so not available; run "
+                          "mxnet_tpu._native.build()")
+    return l
+
+
+def check_call(ret):
+    if ret < 0:
+        raise NativeError(lib().mxtpu_last_error().decode())
+    return ret
+
+
+def recordio_scan(path):
+    """Native record framing scan → (offsets, lengths) int64 arrays."""
+    l = lib()
+    n = check_call(l.mxtpu_recordio_count(path.encode()))
+    offsets = np.zeros(n, np.int64)
+    lengths = np.zeros(n, np.int64)
+    check_call(l.mxtpu_recordio_scan(
+        path.encode(),
+        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        lengths.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), n))
+    return offsets, lengths
+
+
+def assemble_batch(blob, offsets, lengths, c, h, w, mean=None, std=None,
+                   aug_flags=0, seed=0):
+    """Parallel native decode of `len(offsets)` records into float32 NCHW."""
+    l = lib()
+    n = len(offsets)
+    out = np.empty((n, c, h, w), np.float32)
+    labels = np.empty(n, np.float32)
+    offsets = np.ascontiguousarray(offsets, np.int64)
+    lengths = np.ascontiguousarray(lengths, np.int64)
+    mean_p = None
+    std_p = None
+    if mean is not None:
+        mean = np.ascontiguousarray(mean, np.float32)
+        mean_p = mean.ctypes.data_as(ctypes.c_void_p)
+    if std is not None:
+        std = np.ascontiguousarray(std, np.float32)
+        std_p = std.ctypes.data_as(ctypes.c_void_p)
+    check_call(l.mxtpu_assemble_batch(
+        blob.ctypes.data_as(ctypes.c_void_p) if isinstance(blob, np.ndarray)
+        else ctypes.cast(ctypes.create_string_buffer(blob, len(blob)),
+                         ctypes.c_void_p),
+        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        lengths.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        n, c, h, w, mean_p, std_p, aug_flags, seed,
+        out.ctypes.data_as(ctypes.c_void_p),
+        labels.ctypes.data_as(ctypes.c_void_p)))
+    return out, labels
+
+
+class Pump:
+    """Native double-buffered batch producer (src/io/pump.cc)."""
+
+    def __init__(self, path, batch_size, data_shape, mean=None, std=None,
+                 rand_crop=False, rand_mirror=False, shuffle=False, seed=0,
+                 depth=2):
+        l = lib()
+        c, h, w = data_shape
+        self._shape = (batch_size, c, h, w)
+        aug = (1 if rand_mirror else 0) | (2 if rand_crop else 0)
+        mean_p = std_p = None
+        if mean is not None:
+            self._mean = np.ascontiguousarray(mean, np.float32)
+            mean_p = self._mean.ctypes.data_as(ctypes.c_void_p)
+        if std is not None:
+            self._std = np.ascontiguousarray(std, np.float32)
+            std_p = self._std.ctypes.data_as(ctypes.c_void_p)
+        self._h = l.mxtpu_pump_create(path.encode(), batch_size, c, h, w,
+                                      mean_p, std_p, aug, int(shuffle),
+                                      seed, depth)
+        if not self._h:
+            raise NativeError("pump creation failed for %s" % path)
+        self._lib = l
+
+    @property
+    def batches_per_epoch(self):
+        return self._lib.mxtpu_pump_batches_per_epoch(self._h)
+
+    def next(self):
+        """Returns (data, labels) or None at epoch end."""
+        out = np.empty(self._shape, np.float32)
+        labels = np.empty(self._shape[0], np.float32)
+        r = self._lib.mxtpu_pump_next(
+            self._h, out.ctypes.data_as(ctypes.c_void_p),
+            labels.ctypes.data_as(ctypes.c_void_p))
+        if r == 1:
+            return None
+        check_call(r)
+        return out, labels
+
+    def reset(self):
+        self._lib.mxtpu_pump_reset(self._h)
+
+    def __del__(self):
+        if getattr(self, "_h", None):
+            self._lib.mxtpu_pump_destroy(self._h)
+            self._h = None

@@ -491,6 +491,32 @@ class ImageRecordIter(DataIter):
                  rand_crop=False, rand_mirror=False, preprocess_threads=4,
                  prefetch_buffer=4, **kwargs):
         super().__init__(batch_size)
+        # native C++ pipeline (src/io/pump.cc): threaded decode+augment and
+        # double-buffered prefetch, GIL-free — used when the library is
+        # built and the records are in the raw container format
+        self._pump = None
+        try:
+            from .. import _native
+            if _native.available():
+                # probe: one-record native decode verifies the container
+                # format before committing to the native pipeline
+                offs, lens = _native.recordio_scan(path_imgrec)
+                blob = _np.fromfile(path_imgrec, _np.uint8)
+                _native.assemble_batch(blob, offs[:1], lens[:1],
+                                       *tuple(data_shape))
+                self._pump = _native.Pump(
+                    path_imgrec, batch_size, tuple(data_shape),
+                    mean=[mean_r, mean_g, mean_b],
+                    std=[std_r, std_g, std_b], rand_crop=rand_crop,
+                    rand_mirror=rand_mirror, shuffle=shuffle,
+                    depth=int(prefetch_buffer))
+        except Exception:
+            self._pump = None
+        if self._pump is not None:
+            self._data_shape = tuple(data_shape)
+            self._batch_size = batch_size
+            self._label_width = label_width
+            return
         from ..recordio import MXRecordIO, unpack_img
         self._rec = MXRecordIO(path_imgrec, "r")
         self._data_shape = tuple(data_shape)
@@ -519,11 +545,21 @@ class ImageRecordIter(DataIter):
         return [DataDesc("softmax_label", (self._batch_size,))]
 
     def reset(self):
+        if self._pump is not None:
+            self._pump.reset()
+            return
         if self._shuffle:
             _np.random.shuffle(self._order)
         self._cursor = 0
 
     def next(self):
+        if self._pump is not None:
+            item = self._pump.next()
+            if item is None:
+                raise StopIteration
+            data, label = item
+            return DataBatch(data=[_nd.array(data)],
+                             label=[_nd.array(label)], pad=0, index=None)
         from ..recordio import unpack_img
         if self._cursor + self._batch_size > len(self._items):
             raise StopIteration

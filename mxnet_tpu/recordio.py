@@ -210,11 +210,17 @@ def unpack(s):
 _RAW_MAGIC = b"MXTPURAW"
 
 
-def pack_img(header, img, quality=95, img_fmt=".jpg"):
-    """Pack an image array (reference recordio.py:344). Without OpenCV in
-    this image, encodes JPEG/PNG via PIL if available, else a raw numpy
-    container (shape+dtype header)."""
+def pack_img(header, img, quality=95, img_fmt=".raw"):
+    """Pack an image array (reference recordio.py:344). Default is the raw
+    numpy container (shape header + uint8 pixels) — losslessly decodable by
+    the native C++ pipeline (src/io/recordio.cc) without OpenCV/libjpeg;
+    pass ``.jpg``/``.png`` to encode via PIL instead."""
     img = np.asarray(img)
+    if img_fmt in (".raw", "raw", None):
+        shape = np.asarray(img.shape, dtype=np.int32)
+        payload = (_RAW_MAGIC + struct.pack("<B", len(shape)) +
+                   shape.tobytes() + img.astype(np.uint8).tobytes())
+        return pack(header, payload)
     try:
         from PIL import Image
         buf = _io.BytesIO()
