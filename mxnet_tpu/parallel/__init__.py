@@ -13,6 +13,7 @@ from .mesh import (MeshConfig, make_mesh, current_mesh, set_mesh,
 from .functional import functionalize, functional_optimizer, shard_params
 from .trainer import ShardedTrainer
 from .ring_attention import ring_attention, ring_attention_sharded
+from .pipeline import pipeline_apply, pipeline_spmd
 
 
 def initialize(coordinator_address=None, num_processes=None, process_id=None):
