@@ -537,8 +537,21 @@ def UpSampling(*data, scale=1, sample_type="nearest", num_args=1,
 def dot_product_attention(query, key, value, mask=None, dropout=0.0,
                           scaled=True, causal=False, rng_key=None, train=False):
     """TPU-native fused attention entry. Not in MXNet 1.6 (attention was
-    composed from ops there) — exposed as a contrib op; models use it and
-    the pallas flash-attention kernel can slot in under the same name."""
+    composed from ops there) — exposed as a contrib op. When the problem
+    aligns to the pallas tiling (seq % 128 == 0, no mask, no dropout) and a
+    TPU is present, lowers to the flash-attention pallas kernel
+    (ops/pallas_kernels.py); else the XLA softmax path below."""
+    if (mask is None and (dropout == 0.0 or not train)
+            and query.ndim == 4 and scaled):
+        from .pallas_kernels import flash_attention, flash_attention_usable
+        if flash_attention_usable(query.shape, causal):
+            try:
+                on_tpu = any(d.platform not in ("cpu",)
+                             for d in jax.devices())
+            except RuntimeError:
+                on_tpu = False
+            if on_tpu:
+                return flash_attention(query, key, value, causal)
     d = query.shape[-1]
     scores = jnp.einsum("...qd,...kd->...qk", query, key)
     if scaled:

@@ -1,0 +1,150 @@
+"""Pallas TPU kernels for the hot ops.
+
+Role parity: the reference hand-writes CUDA kernels for its hot paths
+(`src/operator/nn/` .cu files, fusion RTC `src/operator/fusion/`); here the
+few ops XLA doesn't already fuse optimally get Pallas kernels. First
+citizen: flash attention — O(S) memory blockwise attention with online
+softmax, the kernel that sets the ceiling for long-context transformer
+throughput. Forward is Pallas (MXU matmuls over VMEM-resident tiles,
+fp32 accumulators); backward uses XLA's autodiff over the reference
+formulation (recompute-based, still O(S^2/block) flops but memory-safe via
+jax.checkpoint).
+
+Layout: (batch, heads, seq, head_dim), blocks of 128 on seq to match the
+MXU/VPU tiling constraints (pallas_guide.md).
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+try:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    _HAS_PALLAS = True
+except ImportError:  # pragma: no cover
+    _HAS_PALLAS = False
+
+__all__ = ["flash_attention", "pallas_available", "flash_attention_usable"]
+
+BLOCK_Q = 128
+BLOCK_K = 128
+NEG_INF = -1e30
+
+
+def pallas_available():
+    return _HAS_PALLAS
+
+
+def flash_attention_usable(q_shape, causal=False):
+    """Whether the pallas path supports this problem size."""
+    if not _HAS_PALLAS:
+        return False
+    B, H, S, D = q_shape
+    return S % BLOCK_Q == 0 and S >= BLOCK_Q and D <= 256
+
+
+def _attn_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, blk_q, blk_k,
+                 seq_len):
+    """One (batch*head, q-block) program: stream K/V blocks with online
+    softmax accumulation in fp32."""
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale          # (blk_q, D)
+
+    n_kb = seq_len // blk_k
+
+    def body(kb, carry):
+        acc, m_i, l_i = carry
+        k = k_ref[0, pl.ds(kb * blk_k, blk_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(kb * blk_k, blk_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            q_pos = qi * blk_q + jax.lax.broadcasted_iota(
+                jnp.int32, (blk_q, blk_k), 0)
+            k_pos = kb * blk_k + jax.lax.broadcasted_iota(
+                jnp.int32, (blk_q, blk_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        m_new = jnp.maximum(m_i, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        corr = jnp.exp(m_i - m_new)
+        l_new = l_i * corr + jnp.sum(p, axis=-1)
+        pv = jax.lax.dot_general(p, v, (((1,), (0,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        acc = acc * corr[:, None] + pv
+        return acc, m_new, l_new
+
+    D = q.shape[-1]
+    acc = jnp.zeros((blk_q, D), jnp.float32)
+    m_i = jnp.full((blk_q,), NEG_INF, jnp.float32)
+    l_i = jnp.zeros((blk_q,), jnp.float32)
+    if causal:
+        # only blocks up to (and including) the diagonal contribute
+        n_iter = qi * (blk_q // blk_k) + (blk_q // blk_k)
+    else:
+        n_iter = n_kb
+    acc, m_i, l_i = jax.lax.fori_loop(0, n_iter, body, (acc, m_i, l_i))
+    o_ref[0] = (acc / jnp.maximum(l_i, 1e-20)[:, None]).astype(o_ref.dtype)
+
+
+def _flash_fwd(q, k, v, causal, interpret):
+    B, H, S, D = q.shape
+    scale = 1.0 / np.sqrt(D)
+    qr = q.reshape(B * H, S, D)
+    kr = k.reshape(B * H, S, D)
+    vr = v.reshape(B * H, S, D)
+    grid = (B * H, S // BLOCK_Q)
+    kernel = functools.partial(_attn_kernel, scale=scale, causal=causal,
+                               blk_q=BLOCK_Q, blk_k=BLOCK_K, seq_len=S)
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((B * H, S, D), q.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, BLOCK_Q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, BLOCK_Q, D), lambda b, i: (b, i, 0)),
+        interpret=interpret,
+    )(qr, kr, vr)
+    return out.reshape(B, H, S, D)
+
+
+def _reference_attention(q, k, v, causal):
+    D = q.shape[-1]
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) / np.sqrt(D)
+    if causal:
+        S = s.shape[-1]
+        mask = jnp.tril(jnp.ones((S, S), bool))
+        s = jnp.where(mask, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)
+                      ).astype(q.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def flash_attention(q, k, v, causal=False, interpret=False):
+    """Blockwise exact attention, (B, H, S, D) layout."""
+    return _flash_fwd(q, k, v, causal, interpret)
+
+
+def _fa_fwd(q, k, v, causal, interpret):
+    return _flash_fwd(q, k, v, causal, interpret), (q, k, v)
+
+
+def _fa_bwd(causal, interpret, res, g):
+    q, k, v = res
+    # backward via XLA autodiff of the reference formulation with remat —
+    # correct and memory-bounded; a hand-written pallas bwd is a further
+    # optimization hook
+    f = jax.checkpoint(lambda q, k, v: _reference_attention(q, k, v, causal))
+    _, vjp = jax.vjp(f, q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_fa_fwd, _fa_bwd)
