@@ -1,0 +1,85 @@
+"""LSTM word language model — reference
+`example/gluon/word_language_model/train.py` equivalent (Gluon LSTM over
+bucketed text; synthetic corpus when no data present)."""
+import argparse
+import logging
+import math
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.models import RNNModel
+
+
+def batchify(data, batch_size):
+    nbatch = len(data) // batch_size
+    data = np.asarray(data[:nbatch * batch_size]).reshape(
+        batch_size, nbatch).T
+    return data
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", type=str, default="lstm")
+    p.add_argument("--emsize", type=int, default=64)
+    p.add_argument("--nhid", type=int, default=64)
+    p.add_argument("--nlayers", type=int, default=2)
+    p.add_argument("--lr", type=float, default=1.0)
+    p.add_argument("--clip", type=float, default=0.25)
+    p.add_argument("--epochs", type=int, default=2)
+    p.add_argument("--batch_size", type=int, default=16)
+    p.add_argument("--bptt", type=int, default=16)
+    p.add_argument("--vocab", type=int, default=200)
+    args = p.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    # synthetic markov-ish corpus
+    rng = np.random.RandomState(0)
+    corpus = [0]
+    for _ in range(20000):
+        corpus.append((corpus[-1] * 31 + rng.randint(0, 7)) % args.vocab)
+    data = batchify(corpus, args.batch_size).astype("float32")
+
+    model = RNNModel(args.model, args.vocab, args.emsize, args.nhid,
+                     args.nlayers, dropout=0.2)
+    model.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(model.collect_params(), "sgd",
+                            {"learning_rate": args.lr, "momentum": 0,
+                             "wd": 0})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    for epoch in range(args.epochs):
+        total_L = 0.0
+        n = 0
+        hidden = model.begin_state(batch_size=args.batch_size)
+        tic = time.time()
+        for i in range(0, data.shape[0] - 1 - args.bptt, args.bptt):
+            x = mx.nd.array(data[i:i + args.bptt])
+            y = mx.nd.array(data[i + 1:i + 1 + args.bptt]).reshape((-1,))
+            hidden = [h.detach() for h in hidden]
+            with autograd.record():
+                output, hidden = model(x, hidden)
+                L = loss_fn(output.reshape((-1, args.vocab)), y)
+                L = L.mean()
+            L.backward()
+            grads = [p.grad() for p in model.collect_params().values()
+                     if p.grad_req != "null"]
+            gluon.utils.clip_global_norm(grads, args.clip * args.bptt *
+                                         args.batch_size)
+            trainer.step(1)
+            total_L += float(L.asnumpy())
+            n += 1
+        ppl = math.exp(total_L / n)
+        logging.info("Epoch %d: ppl %.2f (%.1fs)", epoch, ppl,
+                     time.time() - tic)
+    print("final perplexity: %.2f (vocab %d, random ~%d)"
+          % (ppl, args.vocab, args.vocab))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,113 @@
+"""ImageNet-style training — CLI parity with the reference
+`example/image-classification/train_imagenet.py` (`--kv-store
+dist_tpu_sync` is the BASELINE.json north-star config).
+
+TPU-native path: `--kv-store dist_tpu_sync` (or any multi-device run) uses
+mxnet_tpu.parallel.ShardedTrainer — one compiled SPMD step with in-graph
+allreduce over the ICI mesh (no PS processes; SURVEY §5.8). Data comes from
+a .rec file (native C++ pipeline) or synthetic tensors.
+"""
+import argparse
+import logging
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, parallel
+from mxnet_tpu.gluon.model_zoo import vision
+
+
+def parse_args():
+    p = argparse.ArgumentParser(description="train imagenet (TPU)")
+    p.add_argument("--network", type=str, default="resnet50_v1")
+    p.add_argument("--batch-size", type=int, default=256,
+                   help="global batch size")
+    p.add_argument("--num-epochs", type=int, default=1)
+    p.add_argument("--steps-per-epoch", type=int, default=50)
+    p.add_argument("--lr", type=float, default=0.1)
+    p.add_argument("--kv-store", type=str, default="dist_tpu_sync")
+    p.add_argument("--dtype", type=str, default="bfloat16")
+    p.add_argument("--image-shape", type=str, default="3,224,224")
+    p.add_argument("--num-classes", type=int, default=1000)
+    p.add_argument("--data-train", type=str, default=None,
+                   help=".rec file (raw container); synthetic if absent")
+    p.add_argument("--tp", type=int, default=1, help="tensor-parallel size")
+    p.add_argument("--log-interval", type=int, default=10)
+    return p.parse_args()
+
+
+def main():
+    args = parse_args()
+    logging.basicConfig(level=logging.INFO)
+    import jax
+    n_dev = len(jax.devices())
+    logging.info("devices: %d (%s), kv-store: %s", n_dev,
+                 jax.devices()[0].platform, args.kv_store)
+
+    shape = tuple(int(x) for x in args.image_shape.split(","))
+    net = vision.get_model(args.network, classes=args.num_classes)
+    net.initialize(mx.init.Xavier())
+    net(mx.nd.zeros((1,) + shape))  # resolve deferred shapes
+    if args.dtype == "bfloat16":
+        net.cast("bfloat16")
+
+    mesh = parallel.make_mesh(dp=n_dev // args.tp, tp=args.tp)
+    trainer = parallel.ShardedTrainer(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+        {"learning_rate": args.lr, "momentum": 0.9, "wd": 1e-4}, mesh=mesh)
+
+    bs = args.batch_size
+
+    if args.data_train and os.path.exists(args.data_train):
+        from mxnet_tpu.io import ImageRecordIter
+        it = ImageRecordIter(path_imgrec=args.data_train, data_shape=shape,
+                             batch_size=bs, shuffle=True, rand_crop=True,
+                             rand_mirror=True)
+
+        def batches():
+            it.reset()
+            while True:
+                try:
+                    b = it.next()
+                except StopIteration:
+                    return
+                yield b.data[0].astype(args.dtype), b.label[0]
+    else:
+        logging.info("using synthetic data")
+        rng = np.random.RandomState(0)
+        x = mx.nd.array(rng.rand(bs, *shape), dtype="float32").astype(
+            args.dtype)
+        y = mx.nd.array(rng.randint(0, args.num_classes, bs).astype(
+            "float32"))
+
+        def batches():
+            for _ in range(args.steps_per_epoch):
+                yield x, y
+
+    for epoch in range(args.num_epochs):
+        tic = time.time()
+        n_img = 0
+        last = tic
+        for i, (xb, yb) in enumerate(batches()):
+            loss = trainer.step(xb, yb)
+            n_img += bs
+            if (i + 1) % args.log_interval == 0:
+                loss.wait_to_read()
+                now = time.time()
+                speed = args.log_interval * bs / (now - last)
+                last = now
+                logging.info("Epoch[%d] Batch [%d] Speed: %.2f samples/sec "
+                             "loss=%.4f", epoch, i + 1, speed,
+                             float(loss.asnumpy()))
+        dt = time.time() - tic
+        logging.info("Epoch[%d] time %.1fs throughput %.1f img/s",
+                     epoch, dt, n_img / dt)
+    trainer.sync_back()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,92 @@
+"""Train MLP/LeNet on MNIST — CLI parity with the reference
+`example/image-classification/train_mnist.py` (Module.fit path, SURVEY §3.4).
+
+Runs on synthetic MNIST when the real idx files are absent (no egress).
+"""
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu.io import NDArrayIter
+
+
+def get_mnist(flat):
+    from mxnet_tpu.gluon.data.vision import MNIST
+    train = MNIST(train=True)
+    val = MNIST(train=False)
+
+    def to_arrays(ds):
+        X = ds._data.asnumpy().astype("float32") / 255.0
+        X = X.reshape(len(ds), -1) if flat else \
+            X.transpose(0, 3, 1, 2)
+        return X, np.asarray(ds._label, dtype="float32")
+
+    return to_arrays(train), to_arrays(val)
+
+
+def mlp_symbol():
+    data = mx.sym.var("data")
+    net = mx.sym.FullyConnected(data, num_hidden=128, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu", name="relu1")
+    net = mx.sym.FullyConnected(net, num_hidden=64, name="fc2")
+    net = mx.sym.Activation(net, act_type="relu", name="relu2")
+    net = mx.sym.FullyConnected(net, num_hidden=10, name="fc3")
+    return mx.sym.SoftmaxOutput(net, mx.sym.var("softmax_label"),
+                                name="softmax")
+
+
+def lenet_symbol():
+    data = mx.sym.var("data")
+    c1 = mx.sym.Convolution(data, kernel=(5, 5), num_filter=20, name="conv1")
+    a1 = mx.sym.Activation(c1, act_type="tanh", name="tanh1")
+    p1 = mx.sym.Pooling(a1, pool_type="max", kernel=(2, 2), stride=(2, 2),
+                        name="pool1")
+    c2 = mx.sym.Convolution(p1, kernel=(5, 5), num_filter=50, name="conv2")
+    a2 = mx.sym.Activation(c2, act_type="tanh", name="tanh2")
+    p2 = mx.sym.Pooling(a2, pool_type="max", kernel=(2, 2), stride=(2, 2),
+                        name="pool2")
+    f = mx.sym.Flatten(p2, name="flatten")
+    f1 = mx.sym.FullyConnected(f, num_hidden=500, name="fc1")
+    a3 = mx.sym.Activation(f1, act_type="tanh", name="tanh3")
+    f2 = mx.sym.FullyConnected(a3, num_hidden=10, name="fc2")
+    return mx.sym.SoftmaxOutput(f2, mx.sym.var("softmax_label"),
+                                name="softmax")
+
+
+def main():
+    parser = argparse.ArgumentParser(description="train mnist")
+    parser.add_argument("--network", type=str, default="mlp",
+                        choices=["mlp", "lenet"])
+    parser.add_argument("--num-epochs", type=int, default=3)
+    parser.add_argument("--batch-size", type=int, default=64)
+    parser.add_argument("--lr", type=float, default=0.05)
+    parser.add_argument("--optimizer", type=str, default="sgd")
+    parser.add_argument("--kv-store", type=str, default="local")
+    args = parser.parse_args()
+
+    logging.basicConfig(level=logging.INFO)
+    flat = args.network == "mlp"
+    (Xtr, Ytr), (Xva, Yva) = get_mnist(flat)
+    train = NDArrayIter(Xtr, Ytr, args.batch_size, shuffle=True)
+    val = NDArrayIter(Xva, Yva, args.batch_size)
+
+    sym = mlp_symbol() if flat else lenet_symbol()
+    mod = mx.mod.Module(sym, context=mx.cpu())
+    mod.fit(train, eval_data=val,
+            kvstore=args.kv_store,
+            optimizer=args.optimizer,
+            optimizer_params={"learning_rate": args.lr},
+            initializer=mx.init.Xavier(),
+            batch_end_callback=mx.callback.Speedometer(args.batch_size, 50),
+            num_epoch=args.num_epochs)
+    score = mod.score(val, "acc")
+    print("final validation accuracy: %.4f" % score[0][1])
+
+
+if __name__ == "__main__":
+    main()

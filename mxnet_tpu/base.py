@@ -45,7 +45,10 @@ def dtype_np(dtype):
         if dtype == "bfloat16":
             import ml_dtypes
             return _np.dtype(ml_dtypes.bfloat16)
-    if hasattr(dtype, "dtype"):
+    if not isinstance(dtype, type) and hasattr(dtype, "dtype"):
+        # array-like instance (NDArray, jax array): take its dtype; plain
+        # scalar types like np.uint8 carry a class-level descriptor and
+        # must go straight to np.dtype
         dtype = dtype.dtype
     return _np.dtype(dtype)
 

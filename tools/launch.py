@@ -1,0 +1,49 @@
+"""Multi-host launcher — reference `tools/launch.py` role (dmlc tracker
+spawning worker/server/scheduler processes over ssh/mpi/yarn, SURVEY §5.6).
+
+TPU-native: there are no server/scheduler roles. On a TPU pod each host
+runs the SAME program and `jax.distributed.initialize()` discovers peers
+from the TPU metadata; this launcher exists for CLI parity and for CPU
+multi-process simulation (--launcher local spawns N processes with
+coordinator env, the analogue of the reference's local tracker used by
+`tests/nightly/dist_sync_kvstore.py`)."""
+import argparse
+import os
+import subprocess
+import sys
+
+
+def main():
+    p = argparse.ArgumentParser(description="launch distributed training")
+    p.add_argument("-n", "--num-workers", type=int, required=True)
+    p.add_argument("--launcher", type=str, default="local",
+                   choices=["local", "tpu"])
+    p.add_argument("--coordinator", type=str, default="127.0.0.1:12346")
+    p.add_argument("command", nargs=argparse.REMAINDER)
+    args = p.parse_args()
+
+    if args.launcher == "tpu":
+        # On a pod slice every host runs the same binary; nothing to spawn.
+        os.execvp(args.command[0], args.command)
+
+    procs = []
+    for rank in range(args.num_workers):
+        env = dict(os.environ)
+        env.update({
+            "MXTPU_COORDINATOR": args.coordinator,
+            "MXTPU_NUM_PROCESSES": str(args.num_workers),
+            "MXTPU_PROCESS_ID": str(rank),
+            # jax distributed CPU backend envs
+            "JAX_COORDINATOR_ADDRESS": args.coordinator,
+            "JAX_NUM_PROCESSES": str(args.num_workers),
+            "JAX_PROCESS_ID": str(rank),
+        })
+        procs.append(subprocess.Popen(args.command, env=env))
+    code = 0
+    for pr in procs:
+        code = pr.wait() or code
+    sys.exit(code)
+
+
+if __name__ == "__main__":
+    main()
