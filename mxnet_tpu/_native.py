@@ -27,12 +27,30 @@ class NativeError(RuntimeError):
     pass
 
 
+_build_attempted = False
+
+
+def _run_make(verbose=False):
+    src = os.path.join(_ROOT, "src")
+    return subprocess.run(["make", "-C", src], capture_output=not verbose,
+                          timeout=300)
+
+
 def _try_load():
-    global _lib
+    global _lib, _build_attempted
     if _lib is not None:
         return _lib
     if not os.path.exists(_LIB_PATH):
-        return None
+        # binaries are not checked in; compile once on demand from src/
+        if _build_attempted:
+            return None
+        _build_attempted = True
+        try:
+            _run_make()
+        except Exception:
+            return None
+        if not os.path.exists(_LIB_PATH):
+            return None
     try:
         lib = ctypes.CDLL(_LIB_PATH)
     except OSError:
@@ -68,9 +86,7 @@ def _try_load():
 
 def build(verbose=False):
     """Compile lib/libmxtpu.so from src/ (in-tree Makefile)."""
-    src = os.path.join(_ROOT, "src")
-    res = subprocess.run(["make", "-C", src],
-                         capture_output=not verbose)
+    res = _run_make(verbose)
     if res.returncode != 0:
         raise NativeError("native build failed: %s"
                           % (res.stderr or b"").decode()[-500:])

@@ -160,14 +160,23 @@ class Parameter:
         self._init_impl(data, ctx)
 
     def _init_impl(self, data, ctx_list):
+        import jax
+        import jax.numpy as jnp
+
         self._ctx_list = list(ctx_list)
-        self._data = [data.as_in_context(c).astype(self.dtype)
-                      if (c != data.ctx or _np.dtype(dtype_np(self.dtype)) != data.dtype)
-                      else NDArray(data._data, ctx=c)
-                      for c in self._ctx_list]
-        # re-wrap so each context copy is its own mutable handle
-        self._data = [NDArray(d._data, ctx=c)
-                      for d, c in zip(self._data, self._ctx_list)]
+        dt = dtype_np(self.dtype)
+        # Each context copy must OWN its buffer: device_put between CPU
+        # devices (and onto the same TPU chip) is zero-copy, so without the
+        # explicit copy all ctx copies would alias one buffer — and the
+        # optimizer kernels donate parameter buffers, which would delete
+        # every sibling copy on the first update.
+        self._data = []
+        for c in self._ctx_list:
+            val = jnp.array(data._data, copy=True)
+            val = jax.device_put(val, c.jax_device)
+            if val.dtype != _np.dtype(dt):
+                val = val.astype(dt)
+            self._data.append(NDArray(val, ctx=c))
         if self._grad_req != "null":
             self._init_grad()
 

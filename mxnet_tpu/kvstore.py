@@ -63,7 +63,11 @@ class KVStore:
     def init(self, key, value):
         keys, values = _key_value(key, value)
         for k, v in zip(keys, values):
-            self._store[k] = NDArray(v._data, ctx=v._ctx)
+            # the store owns its buffer (reference: server/comm buffers are
+            # separate allocations) — aliasing the caller's weight would let
+            # a donated optimizer update delete the caller's array
+            self._store[k] = NDArray(jnp.array(v._data, copy=True),
+                                     ctx=v._ctx)
 
     def _reduce(self, values):
         """Sum gradients across device copies (reference CommDevice::Reduce
@@ -81,8 +85,7 @@ class KVStore:
                              else vv)
             out = acc
         if self._is_dist and jax.process_count() > 1:
-            from jax.experimental import multihost_utils
-            out = multihost_utils.process_allgather(out).sum(axis=0)
+            out = _cross_process_allreduce(out)
         return out
 
     def push(self, key, value, priority=0):
@@ -98,7 +101,9 @@ class KVStore:
                 gw = NDArray(reduced)
                 self._updater(_key_int(k), gw, self._store[k])
             else:
-                self._store[k]._data = self._store[k]._data + reduced
+                # replace, not accumulate (reference kvstore_local.h:
+                # `local = merged`)
+                self._store[k]._data = reduced
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         keys, outs = _key_value(key, out)
@@ -106,7 +111,11 @@ class KVStore:
             if k not in self._store:
                 raise MXNetError("key %s has not been initialized" % k)
             src = self._store[k]
-            val = src._data
+            # per-out copy: device_put is zero-copy between CPU devices and
+            # onto the same chip, and handing the same buffer to several
+            # outs (or leaving an out aliasing the store) breaks buffer
+            # donation downstream
+            val = jnp.array(src._data, copy=True)
             if o.ctx != src.ctx:
                 val = jax.device_put(val, o.ctx.jax_device)
             o._data = val.astype(o._data.dtype) if o._data.dtype != val.dtype else val
@@ -175,6 +184,47 @@ class KVStore:
     @property
     def num_dead_node(self):
         return 0
+
+
+_allreduce_cache = {}
+
+
+def _cross_process_allreduce(x):
+    """True allreduce across processes: each process contributes its local
+    value on one device of a global 1-D mesh and a jitted `psum` rides the
+    interconnect (ICI/DCN on TPU pods, gloo-style on the CPU backend) —
+    O(size) per link, unlike allgather-then-sum which moves O(N*size) to
+    every host. Replaces the reference PS push/aggregate round
+    (`src/kvstore/kvstore_dist_server.h:337`) with one collective."""
+    from jax.experimental import multihost_utils
+    from jax.sharding import Mesh, PartitionSpec as P
+    try:
+        from jax import shard_map  # jax >= 0.8
+    except ImportError:
+        from jax.experimental.shard_map import shard_map
+
+    nproc = jax.process_count()
+    key = ("mesh", nproc)
+    if key not in _allreduce_cache:
+        # one device per process so each host contributes exactly one shard
+        devs = [[d for d in jax.devices() if d.process_index == p][0]
+                for p in range(nproc)]
+        _allreduce_cache[key] = Mesh(_np.array(devs), ("p",))
+    mesh = _allreduce_cache[key]
+
+    fkey = ("fn", nproc)
+    if fkey not in _allreduce_cache:
+        def _psum(v):
+            return jax.lax.psum(v, "p")
+        _allreduce_cache[fkey] = jax.jit(
+            shard_map(_psum, mesh=mesh, in_specs=P("p"), out_specs=P()))
+    fn = _allreduce_cache[fkey]
+
+    local = _np.asarray(x)[None]  # leading axis = this process's shard
+    glob = multihost_utils.host_local_array_to_global_array(local, mesh, P("p"))
+    summed = fn(glob)  # (1, *x.shape), replicated
+    return jnp.asarray(_np.asarray(
+        multihost_utils.global_array_to_host_local_array(summed, mesh, P()))[0])
 
 
 def _key_int(k):

@@ -544,7 +544,10 @@ def dot_product_attention(query, key, value, mask=None, dropout=0.0,
     if (mask is None and (dropout == 0.0 or not train)
             and query.ndim == 4 and scaled):
         from .pallas_kernels import flash_attention, flash_attention_usable
-        if flash_attention_usable(query.shape, causal):
+        # kernel tiles assume self-attention layout; cross-attention with
+        # kv_len != q_len must take the XLA path
+        if (key.shape == query.shape and value.shape == query.shape
+                and flash_attention_usable(query.shape, causal)):
             try:
                 on_tpu = any(d.platform not in ("cpu",)
                              for d in jax.devices())

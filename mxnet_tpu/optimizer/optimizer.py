@@ -126,8 +126,9 @@ class Optimizer:
     def set_wd_mult(self, args_wd_mult):
         self.wd_mult = {}
         for n in self.idx2name.values():
-            is_weight = n.endswith("_weight")
-            if not is_weight:
+            # reference optimizer.py:389 exempts biases/norm params: only
+            # names ending _weight or _gamma keep weight decay
+            if not (n.endswith("_weight") or n.endswith("_gamma")):
                 self.wd_mult[n] = 0.0
         self.wd_mult.update(args_wd_mult)
 
@@ -270,16 +271,14 @@ def _adamax(w, m, u, g, lr, wd, b1, b2, rescale, clip, t):
 
 @partial(jax.jit, donate_argnums=(0, 1, 2))
 def _nadam(w, m, v, g, lr, wd, b1, b2, eps, schedule, m_schedule_next,
-           rescale, clip, t):
+           mu_t, mu_t1, rescale, clip, t):
     g = jnp.clip(g * rescale, -clip, clip) + wd * w
     grad_prime = g / (1 - schedule)
     m = b1 * m + (1 - b1) * g
     v = b2 * v + (1 - b2) * jnp.square(g)
     m_prime = m / (1 - m_schedule_next)
     v_prime = v / (1 - b2 ** t)
-    mu_t1 = b1 * (1 - 0.5 * 0.96 ** (0.004 * t))
-    m_bar = (1 - mu_t1) * grad_prime + \
-        (b1 * (1 - 0.5 * 0.96 ** (0.004 * (t + 1)))) * m_prime
+    m_bar = (1 - mu_t) * grad_prime + mu_t1 * m_prime
     return w - lr * m_bar / (jnp.sqrt(v_prime) + eps), m, v
 
 
@@ -730,7 +729,7 @@ class Nadam(Optimizer):
         weight._data, m._data, v._data = _nadam(
             weight._data, m._data, v._data, grad._data, lr, wd, self.beta1,
             self.beta2, self.epsilon, self.m_schedule, m_schedule_next,
-            self.rescale_grad, _c(self.clip_gradient), t)
+            mu_t, mu_t1, self.rescale_grad, _c(self.clip_gradient), t)
 
 
 @register

@@ -116,6 +116,45 @@ def test_all_optimizers_run_and_move_weights(name):
     assert not np.allclose(before, after)
 
 
+def test_nadam_nondefault_schedule_decay_matches_numpy():
+    """Nadam with schedule_decay != 0.004 (reference optimizer.py:1834
+    Nadam): the momentum schedule must use the configured decay everywhere,
+    including the m_bar recombination."""
+    sd, b1, b2, eps, lr = 0.01, 0.9, 0.999, 1e-8, 0.05
+    w0, grads, got = _run_steps(
+        "nadam", {"learning_rate": lr, "schedule_decay": sd, "wd": 0.0})
+    w = w0.copy()
+    m = np.zeros_like(w)
+    v = np.zeros_like(w)
+    m_schedule = 1.0
+    for t, g in enumerate(grads, start=1):
+        mu_t = b1 * (1.0 - 0.5 * 0.96 ** (t * sd))
+        mu_t1 = b1 * (1.0 - 0.5 * 0.96 ** ((t + 1) * sd))
+        m_schedule = m_schedule * mu_t
+        m_schedule_next = m_schedule * mu_t1
+        grad_prime = g / (1 - m_schedule)
+        m = b1 * m + (1 - b1) * g
+        v = b2 * v + (1 - b2) * g * g
+        m_prime = m / (1 - m_schedule_next)
+        v_prime = v / (1 - b2 ** t)
+        m_bar = (1 - mu_t) * grad_prime + mu_t1 * m_prime
+        w = w - lr * m_bar / (np.sqrt(v_prime) + eps)
+    np.testing.assert_allclose(got, w, rtol=1e-5, atol=1e-6)
+
+
+def test_wd_mult_exempts_gamma():
+    """set_wd_mult zeroes wd for everything except *_weight and *_gamma
+    (reference optimizer.py:389)."""
+    o = opt.create("sgd", learning_rate=0.1)
+    o.idx2name = {0: "fc1_weight", 1: "fc1_bias", 2: "bn0_gamma",
+                  3: "bn0_beta"}
+    o.set_wd_mult({})
+    assert "fc1_weight" not in o.wd_mult  # keeps decay (default mult 1)
+    assert "bn0_gamma" not in o.wd_mult   # keeps decay too
+    assert o.wd_mult["fc1_bias"] == 0.0
+    assert o.wd_mult["bn0_beta"] == 0.0
+
+
 def test_lr_mult_wd_mult():
     o = opt.create("sgd", learning_rate=1.0)
     o.idx2name = {0: "a_weight", 1: "b_weight"}

@@ -34,3 +34,29 @@ def test_flash_attention_grads_finite():
 def test_usability_gate():
     assert flash_attention_usable((1, 2, 256, 64))
     assert not flash_attention_usable((1, 2, 100, 64))  # unaligned seq
+
+
+def test_cross_attention_kv_len_mismatch_takes_xla_path(monkeypatch):
+    """Cross-attention (kv_len != q_len) must not reach the pallas kernel,
+    whose tiling assumes self-attention layout — the fused op falls back to
+    the XLA path and matches the dense reference."""
+    from mxnet_tpu.ops import pallas_kernels
+    from mxnet_tpu.ops.registry import get_op, invoke
+
+    # the pallas kernel must not be selected regardless of platform
+    def _boom(*a, **k):
+        raise AssertionError("pallas kernel selected for cross-attention")
+
+    monkeypatch.setattr(pallas_kernels, "flash_attention", _boom)
+    np.random.seed(2)
+    B, H, Sq, Skv, D = 1, 2, 128, 256, 32
+    q = np.random.randn(B, H, Sq, D).astype("float32")
+    k = np.random.randn(B, H, Skv, D).astype("float32")
+    v = np.random.randn(B, H, Skv, D).astype("float32")
+    out = invoke(get_op("_contrib_dot_product_attention"), jnp.asarray(q),
+                 jnp.asarray(k), jnp.asarray(v))
+    s = np.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(D)
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    ref = np.einsum("bhqk,bhkd->bhqd", p, v)
+    np.testing.assert_allclose(np.asarray(out), ref, atol=2e-4, rtol=2e-4)

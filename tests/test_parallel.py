@@ -166,7 +166,36 @@ def test_kvstore_local_pushpull():
     np.testing.assert_allclose(out.asnumpy(), np.ones((2, 3)))
     kv.push("3", mx.nd.ones((2, 3)) * 4)
     kv.pull("3", out=out)
-    np.testing.assert_allclose(out.asnumpy(), np.ones((2, 3)) * 5)
+    # no-updater push replaces the stored value with the reduced sum
+    # (reference kvstore_local.h `local = merged`), it does not accumulate
+    np.testing.assert_allclose(out.asnumpy(), np.ones((2, 3)) * 4)
+
+
+def test_trainer_multictx_eager_steps_no_buffer_donation_clash():
+    """Multi-context eager Trainer over a local kvstore: ctx copies and the
+    store must each own their buffers — zero-copy device_put between CPU
+    devices (or onto one TPU chip) plus donated optimizer updates otherwise
+    deletes sibling copies mid-step (regression: 'Array has been
+    deleted')."""
+    from mxnet_tpu import autograd as ag, gluon
+
+    net = gluon.nn.Dense(4)
+    net.initialize(ctx=[mx.cpu(0), mx.cpu(1)])
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.1, "momentum": 0.9},
+                       kvstore="local")
+    xs = [mx.nd.ones((2, 3), ctx=mx.cpu(i)) for i in range(2)]
+    for _ in range(3):
+        losses = []
+        with ag.record():
+            for x in xs:
+                losses.append((net(x) ** 2).mean())
+        for l in losses:
+            l.backward()
+        tr.step(4)
+    for p in net.collect_params().values():
+        datas = [d.asnumpy() for d in p.list_data()]  # raises if deleted
+        np.testing.assert_allclose(datas[0], datas[1], rtol=1e-6)
 
 
 def test_kvstore_aggregates_device_copies():
