@@ -2,12 +2,17 @@
 
 Matches the reference's headline benchmark (`BASELINE.md`: ResNet-50
 training, batch 32, 298.51 img/s on 1x V100 fp32,
-`docs/.../perf.md:252` in the reference tree). The training step is the
-fused SPMD program from mxnet_tpu.parallel (fwd+bwd+update, bf16 compute,
-fp32 BN stats + master-quality updates via XLA), on a dp=1 mesh.
+`docs/.../perf.md:252` in the reference tree). The training span is the
+fused SPMD program from mxnet_tpu.parallel (ShardedTrainer.step_many:
+`lax.scan` over fwd+bwd+update steps, bf16 compute, fp32 BN stats), on a
+dp=1 mesh — the TPU-idiomatic on-device training loop, which also
+amortizes host->device dispatch latency.
 
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": "img/s", "vs_baseline": N}
+
+Env knobs: BENCH_BATCH (32), BENCH_FUSED (steps per compiled span, 64),
+BENCH_REPEAT (timed spans, 3), BENCH_IMAGE (224).
 """
 import json
 import os
@@ -17,6 +22,8 @@ import time
 import numpy as np
 
 BASELINE_IMG_S = 298.51  # reference perf.md:252 (V100, fp32, batch 32)
+RESNET50_TRAIN_GFLOP_PER_IMG = 12.3  # ~3x fwd (4.1 GFLOP @ 224x224)
+V5E_PEAK_TFLOPS = 197.0  # bf16 dense
 
 
 def log(*a):
@@ -30,8 +37,8 @@ def main():
     from mxnet_tpu.gluon.model_zoo import vision
 
     batch = int(os.environ.get("BENCH_BATCH", "32"))
-    steps = int(os.environ.get("BENCH_STEPS", "20"))
-    warmup = int(os.environ.get("BENCH_WARMUP", "3"))
+    fused = int(os.environ.get("BENCH_FUSED", "64"))
+    repeat = int(os.environ.get("BENCH_REPEAT", "3"))
     image = int(os.environ.get("BENCH_IMAGE", "224"))
 
     mx.random.seed(0)
@@ -49,24 +56,29 @@ def main():
         net, loss_fn, "sgd", {"learning_rate": 0.1, "momentum": 0.9},
         mesh=mesh)
 
-    x = mx.nd.array(np.random.rand(batch, 3, image, image),
-                    dtype="float32").astype("bfloat16")
-    y = mx.nd.array(np.random.randint(0, 1000, batch).astype("float32"))
+    rng = np.random.default_rng(0)
+    xs = mx.nd.array(
+        rng.random((fused, batch, 3, image, image), dtype=np.float32),
+        dtype="float32").astype("bfloat16")
+    ys = mx.nd.array(
+        rng.integers(0, 1000, (fused, batch)).astype("float32"))
 
-    log("compiling + warmup (%d steps)..." % warmup)
+    log("compiling + warmup (1 span of %d steps)..." % fused)
     t0 = time.time()
-    for _ in range(warmup):
-        l = trainer.step(x, y)
-    l.wait_to_read()
-    log("warmup done in %.1fs, loss=%s" % (time.time() - t0,
-                                           float(l.asnumpy())))
+    l = trainer.step_many(xs, ys)
+    lv = l.asnumpy()  # full host sync
+    log("warmup done in %.1fs, last loss=%.4f" % (time.time() - t0, lv[-1]))
 
     t0 = time.time()
-    for _ in range(steps):
-        l = trainer.step(x, y)
-    l.wait_to_read()
+    for _ in range(repeat):
+        l = trainer.step_many(xs, ys)
+    _ = l.asnumpy()  # host sync bounds the measurement
     dt = time.time() - t0
-    img_s = batch * steps / dt
+    imgs = batch * fused * repeat
+    img_s = imgs / dt
+    tflops = img_s * RESNET50_TRAIN_GFLOP_PER_IMG / 1e3
+    log("%.2f img/s  |  est %.1f TFLOP/s  |  est MFU %.1f%% of v5e bf16 peak"
+        % (img_s, tflops, 100.0 * tflops / V5E_PEAK_TFLOPS))
 
     print(json.dumps({
         "metric": "resnet50_train_img_per_sec_per_chip_b%d" % batch,

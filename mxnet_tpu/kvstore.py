@@ -102,8 +102,10 @@ class KVStore:
                 self._updater(_key_int(k), gw, self._store[k])
             else:
                 # replace, not accumulate (reference kvstore_local.h:
-                # `local = merged`)
-                self._store[k]._data = reduced
+                # `local = merged`); owned copy — with one pushed value
+                # _reduce returns the caller's buffer, and a later donated
+                # update on the caller's array would delete the stored value
+                self._store[k]._data = jnp.array(reduced, copy=True)
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         keys, outs = _key_value(key, out)

@@ -383,8 +383,19 @@ def BatchNorm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
     if use_global_stats or not train:
         mean, var = moving_mean, moving_var
     else:
-        mean = jnp.mean(data, axis=reduce_axes)
-        var = jnp.var(data, axis=reduce_axes)
+        # one-pass stats (E[x^2] - E[x]^2, accumulated in fp32): both
+        # reductions fuse into a single sweep over the activations, unlike
+        # jnp.var which re-reads data after computing the mean. Same
+        # formulation and precision as cuDNN/TF fused batch norm (the
+        # reference's backend); fp32 accumulation bounds the cancellation
+        # error at ~mean^2 * 2^-24, which the max(.., 0) clamp backstops.
+        xf = data.astype(jnp.float32)
+        mean = jnp.mean(xf, axis=reduce_axes)
+        var = jnp.maximum(
+            jnp.mean(jnp.square(xf), axis=reduce_axes) - jnp.square(mean),
+            0.0)
+        mean = mean.astype(moving_mean.dtype)
+        var = var.astype(moving_var.dtype)
     inv = lax.rsqrt(var + eps).astype(data.dtype)
     out = (data - mean.reshape(bshape).astype(data.dtype)) * inv.reshape(bshape) \
         * g.reshape(bshape).astype(data.dtype) + beta.reshape(bshape).astype(data.dtype)

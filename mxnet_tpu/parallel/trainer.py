@@ -24,6 +24,13 @@ from .mesh import make_mesh, batch_sharding, replicated
 __all__ = ["ShardedTrainer"]
 
 
+def _owned_on(v, device):
+    """An owning single-device copy of ``v``: device_put alone is zero-copy
+    when source and target share a device, and handing out a buffer that the
+    trainer's donated step also holds would let the donation delete it."""
+    return jnp.array(jax.device_put(v, device), copy=True)
+
+
 class ShardedTrainer:
     """Data/tensor-parallel trainer over a jax.sharding.Mesh.
 
@@ -68,37 +75,79 @@ class ShardedTrainer:
                         for v, s in zip(self._values, self._shardings)]
         self._t = 0
         self._step_fn = None
+        self._step_many_fn = None
         self._aux_handles = []
 
     @property
     def mesh(self):
         return self._mesh
 
-    def _build_step(self):
+    def _trainable_indices(self):
+        return [i for i, p in enumerate(self._params)
+                if getattr(p, "grad_req", "write") != "null"]
+
+    def _one_step(self, key, param_vals, states, t, lr, x_args, y):
+        """Traced single step: fwd, bwd (trainable params only), optimizer
+        update, and aux (BatchNorm moving stats) folded back into the
+        carried parameter values so stats accumulate across steps."""
         pure = self._pure
         loss_block = self._loss
         update = self._update
+        trainable = self._trainable_indices()
 
+        def lfn(tv):
+            pv = list(param_vals)
+            for i, v in zip(trainable, tv):
+                pv[i] = v
+            outs, aux = pure(key, pv, *x_args)
+            out = outs[0]
+            l = loss_block(NDArray(out), NDArray(y))
+            lv = l._data if isinstance(l, NDArray) else l
+            return jnp.mean(lv), (outs, aux)
+
+        (loss_val, (_, aux)), grads = jax.value_and_grad(
+            lfn, has_aux=True)([param_vals[i] for i in trainable])
+        new_vals = list(param_vals)
+        new_states = list(states)
+        for i, g in zip(trainable, grads):
+            w = param_vals[i]
+            w2, s2 = update(w, g.astype(w.dtype), states[i], t, lr)
+            new_vals[i] = w2
+            new_states[i] = s2
+        # aux state (running mean/var) becomes the carried value of its
+        # parameter slot — grad_req='null' params are never touched by the
+        # optimizer (a wd>0 zero-grad "update" would decay running stats)
+        handle_to_idx = {}
+        for pi, p in enumerate(self._params):
+            for d in p._data:
+                handle_to_idx[id(d)] = pi
+        for h, v in zip(pure.aux_handles, aux):
+            pi = handle_to_idx.get(id(h))
+            if pi is not None:
+                new_vals[pi] = v.astype(new_vals[pi].dtype)
+        return loss_val, new_vals, new_states, aux
+
+    def _build_step(self):
         def step(key, param_vals, states, t, lr, *batch):
             x_args, y = batch[:-1], batch[-1]
-
-            def lfn(pv):
-                outs, aux = pure(key, list(pv), *x_args)
-                out = outs[0]
-                l = loss_block(NDArray(out), NDArray(y))
-                lv = l._data if isinstance(l, NDArray) else l
-                return jnp.mean(lv), (outs, aux)
-
-            (loss_val, (_, aux)), grads = jax.value_and_grad(
-                lfn, has_aux=True)(list(param_vals))
-            new_vals, new_states = [], []
-            for w, g, s in zip(param_vals, grads, states):
-                w2, s2 = update(w, g.astype(w.dtype), s, t, lr)
-                new_vals.append(w2)
-                new_states.append(s2)
-            return loss_val, new_vals, new_states, aux
+            return self._one_step(key, param_vals, states, t, lr, x_args, y)
 
         self._step_fn = jax.jit(step, donate_argnums=(1, 2))
+
+    def _build_step_many(self):
+        def many(key, param_vals, states, t0, lr, xs, ys):
+            def body(carry, xy):
+                key, pv, st, t = carry
+                key, sub = jax.random.split(key)
+                loss, pv2, st2, _aux = self._one_step(
+                    sub, pv, st, t, lr, (xy[0],), xy[1])
+                return (key, pv2, st2, t + 1), loss
+
+            (key, pv, st, t), losses = jax.lax.scan(
+                body, (key, list(param_vals), list(states), t0), (xs, ys))
+            return losses, pv, st
+
+        self._step_many_fn = jax.jit(many, donate_argnums=(1, 2))
 
     def step(self, data, label, lr=None):
         """One fused fwd+bwd+allreduce+update step. Returns the (replicated)
@@ -120,6 +169,44 @@ class ShardedTrainer:
             h._data = v
         return NDArray(loss_val)
 
+    def step_many(self, data, label, lr=None):
+        """Run ``data.shape[0]`` fused training steps in ONE compiled
+        program (`lax.scan` over the leading steps axis). This amortizes
+        per-dispatch host/runtime latency — the TPU-idiomatic training loop
+        shape — and keeps params, optimizer state, and BatchNorm running
+        stats on-device across the whole span. Returns the per-step losses
+        as an NDArray of shape (n_steps,).
+
+        data:  (n_steps, batch, ...), label: (n_steps, batch, ...).
+        """
+        if self._step_many_fn is None:
+            self._build_step_many()
+        xs = data._data if isinstance(data, NDArray) else jnp.asarray(data)
+        ys = label._data if isinstance(label, NDArray) else jnp.asarray(label)
+        n_steps = xs.shape[0]
+        # dim 0 = steps (unsharded), dim 1 = batch sharded over ALL batch
+        # axes jointly (matches batch_sharding used by step())
+        spec = PartitionSpec(None, self._batch_axes)
+        xs = jax.device_put(xs, NamedSharding(self._mesh, spec))
+        ys = jax.device_put(ys, NamedSharding(
+            self._mesh,
+            PartitionSpec(None, self._batch_axes) if ys.ndim >= 2
+            else PartitionSpec(None)))
+        key = _random.next_key()
+        # t is 1-based inside updates (matches step(): first call sees t=1)
+        losses, self._values, self._states = self._step_many_fn(
+            key, self._values, self._states, self._t + 1,
+            lr if lr is not None else self._lr, xs, ys)
+        self._t += n_steps
+        # write final aux values (folded into the carried params) back into
+        # the Block's handles so eval/export sees fresh running stats
+        trainable = set(self._trainable_indices())
+        for pi, p in enumerate(self._params):
+            if pi not in trainable:
+                for d in p._data:
+                    d._data = _owned_on(self._values[pi], d.ctx.jax_device)
+        return NDArray(losses)
+
     def forward(self, data):
         """Sharded inference forward (no grad, no update)."""
         x = data._data if isinstance(data, NDArray) else jnp.asarray(data)
@@ -130,11 +217,13 @@ class ShardedTrainer:
 
     def sync_back(self):
         """Write the trainer's (possibly sharded) values back into the
-        Block's Parameters — gathers shards to replicated layout first."""
+        Block's Parameters — gathers shards first, then lands each ctx copy
+        on its own device (owned, so the next donating step can't delete
+        what the Block now references) and eager forwards keep working."""
         for p, v in zip(self._params, self._values):
             full = jax.device_put(v, replicated(self._mesh))
             for d in p._data:
-                d._data = full
+                d._data = _owned_on(full, d.ctx.jax_device)
 
     @property
     def learning_rate(self):

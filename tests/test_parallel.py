@@ -95,6 +95,89 @@ def test_sharded_trainer_loss_decreases():
     assert losses[-1] < losses[0] * 0.5
 
 
+def test_step_many_matches_repeated_step_and_accumulates_bn_stats():
+    """step_many (fused lax.scan training span) must produce the same
+    params/losses as N separate step() calls, and BatchNorm running stats
+    must accumulate across steps (regression: aux values were written to
+    the Block but not carried in the trainer's param values, freezing the
+    stats at their init)."""
+    np.random.seed(3)
+    X = np.random.randn(4, 16, 3, 8, 8).astype("float32")  # 4 steps
+    Y = np.random.randint(0, 4, (4, 16))
+
+    def make_net():
+        net = nn.HybridSequential()
+        with net.name_scope():
+            net.add(nn.Conv2D(8, 3, padding=1, in_channels=3),
+                    nn.BatchNorm(in_channels=8),
+                    nn.Activation("relu"),
+                    nn.GlobalAvgPool2D(),
+                    nn.Dense(4, in_units=8))
+        net.initialize(mx.init.Xavier())
+        return net
+
+    net1 = make_net()
+    net2 = make_net()
+    for p1, p2 in zip(net1.collect_params().values(),
+                      net2.collect_params().values()):
+        p2.set_data(p1.data())
+
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    mesh = parallel.make_mesh(dp=8)
+    st1 = parallel.ShardedTrainer(net1, loss_fn, "sgd",
+                                  {"learning_rate": 0.05}, mesh=mesh)
+    losses1 = [float(st1.step(mx.nd.array(X[i]), mx.nd.array(Y[i])).asnumpy())
+               for i in range(4)]
+    st1.sync_back()
+
+    st2 = parallel.ShardedTrainer(net2, loss_fn, "sgd",
+                                  {"learning_rate": 0.05}, mesh=mesh)
+    losses2 = st2.step_many(mx.nd.array(X), mx.nd.array(Y)).asnumpy()
+    st2.sync_back()
+
+    np.testing.assert_allclose(losses1, losses2, rtol=1e-5, atol=1e-6)
+    for (n1, p1), (n2, p2) in zip(net1.collect_params().items(),
+                                  net2.collect_params().items()):
+        np.testing.assert_allclose(p1.data().asnumpy(), p2.data().asnumpy(),
+                                   rtol=1e-4, atol=1e-5, err_msg=n1)
+    # running stats moved off their init (mean init 0, var init 1)
+    for name, p in net2.collect_params().items():
+        if name.endswith("running_mean"):
+            assert np.abs(p.data().asnumpy()).max() > 1e-6, name
+        if name.endswith("running_var"):
+            assert np.abs(p.data().asnumpy() - 1.0).max() > 1e-6, name
+
+
+def test_step_many_twice_then_eval_and_sync_back():
+    """Back-to-back step_many spans, sync_back, eager eval, and another
+    span: no handle may alias the donated carry (regression: aux writeback
+    and sync_back handed out zero-copy buffers that the next donating call
+    deleted)."""
+    from mxnet_tpu import gluon as g
+
+    np.random.seed(4)
+    X = np.random.randn(2, 8, 3, 8, 8).astype("float32")
+    Y = np.random.randint(0, 4, (2, 8))
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Conv2D(4, 3, padding=1, in_channels=3),
+                nn.BatchNorm(in_channels=4),
+                nn.GlobalAvgPool2D(),
+                nn.Dense(4, in_units=4))
+    net.initialize(mx.init.Xavier())
+    st = parallel.ShardedTrainer(net, g.loss.SoftmaxCrossEntropyLoss(),
+                                 "sgd", {"learning_rate": 0.05},
+                                 mesh=parallel.make_mesh(dp=8))
+    st.step_many(mx.nd.array(X), mx.nd.array(Y))
+    st.step_many(mx.nd.array(X), mx.nd.array(Y))  # donates prior carry
+    st.sync_back()
+    out = net(mx.nd.array(X[0]))  # eager eval on synced params
+    assert np.isfinite(out.asnumpy()).all()
+    st.step_many(mx.nd.array(X), mx.nd.array(Y))  # donation after sync_back
+    for p in net.collect_params().values():
+        assert np.isfinite(p.data().asnumpy()).all()  # raises if deleted
+
+
 def test_tensor_parallel_transformer_step():
     """dp=2 x tp=2 x sp=2-capable mesh; Megatron-sharded params compile and
     run one step."""
