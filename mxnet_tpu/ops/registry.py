@@ -22,6 +22,7 @@ import functools
 from typing import Callable, Dict, Optional
 
 from .. import _tape
+from .. import engine as _engine
 
 __all__ = ["Op", "register", "get_op", "list_ops", "invoke", "wrap_out"]
 
@@ -120,6 +121,15 @@ def invoke(op: Op, *args, out=None, **kwargs):
     out_vals = op.fn(*vals, **kwargs)
     multi = isinstance(out_vals, tuple)
     outs = out_vals if multi else (out_vals,)
+
+    if _engine.is_naive():
+        # NaiveEngine semantics (reference src/engine/naive_engine.cc):
+        # serialize dispatch so device-side failures surface inside the
+        # calling statement instead of at the next sync point. Tracers have
+        # no block_until_ready, so tracing is unaffected.
+        for v in outs:
+            if hasattr(v, "block_until_ready"):
+                v.block_until_ready()
 
     recording = op.differentiable and _tape.is_recording()
 
