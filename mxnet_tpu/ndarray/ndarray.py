@@ -607,32 +607,37 @@ def waitall():
 # ---- serialization (reference NDArray::Save/Load, mx.nd.save/load) --------
 
 def save(fname, data):
-    """Save list or dict of NDArrays (reference `src/ndarray/ndarray.cc`
-    Save; we use the .npz container — see utils.serialization for the
-    MXNet-binary-compatible reader/writer)."""
-    import numpy as np
+    """Save list or dict of NDArrays in the reference's binary list container
+    (reference `src/ndarray/ndarray.cc:1826` NDArray::Save) — files written
+    here load in the reference and vice versa. See `serialization.py`."""
+    from . import serialization
     if isinstance(data, NDArray):
         data = [data]
     if isinstance(data, (list, tuple)):
-        np.savez(_ensure_ext(fname), __mx_list__=np.array(len(data)),
-                 **{"arr_%d" % i: d.asnumpy() for i, d in enumerate(data)})
+        serialization.save_ndarrays(fname, list(data))
     elif isinstance(data, dict):
-        np.savez(_ensure_ext(fname), **{k: v.asnumpy() for k, v in data.items()})
+        keys = list(data.keys())
+        serialization.save_ndarrays(fname, [data[k] for k in keys], keys)
     else:
         raise TypeError("save expects NDArray, list, or dict")
 
 
-def _ensure_ext(fname):
-    return fname
-
-
 def load(fname):
+    """Load NDArrays saved by `save` or by the reference (binary container);
+    .npz files from older checkpoints of this framework still load."""
     import numpy as np
     import os
+    from . import serialization
     path = fname if os.path.exists(fname) else fname + ".npz"
+    if serialization.is_mxnet_binary(path):
+        arrays, names = serialization.load_ndarrays(path)
+        if names:
+            return {k: array(a, dtype=a.dtype) for k, a in zip(names, arrays)}
+        return [array(a, dtype=a.dtype) for a in arrays]
     with np.load(path, allow_pickle=False) as z:
         keys = list(z.keys())
         if "__mx_list__" in keys:
             n = int(z["__mx_list__"])
-            return [array(z["arr_%d" % i]) for i in range(n)]
-        return {k: array(z[k]) for k in keys}
+            return [array(z["arr_%d" % i], dtype=z["arr_%d" % i].dtype)
+                    for i in range(n)]
+        return {k: array(z[k], dtype=z[k].dtype) for k in keys}

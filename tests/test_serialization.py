@@ -1,0 +1,171 @@
+"""MXNet-binary NDArray container round-trip + byte-format tests
+(reference src/ndarray/ndarray.cc:1591-1852)."""
+import struct
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.ndarray import serialization as ser
+
+
+def test_save_load_dict_roundtrip(tmp_path):
+    path = str(tmp_path / "weights.params")
+    data = {
+        "conv0_weight": mx.nd.array(np.random.randn(4, 3, 3, 3).astype(np.float32)),
+        "fc0_bias": mx.nd.array(np.arange(10, dtype=np.float32)),
+        "idx": mx.nd.array(np.array([1, 2, 3], dtype=np.int32)),
+    }
+    mx.nd.save(path, data)
+    out = mx.nd.load(path)
+    assert set(out.keys()) == set(data.keys())
+    for k in data:
+        np.testing.assert_array_equal(out[k].asnumpy(), data[k].asnumpy())
+        assert out[k].dtype == data[k].dtype
+
+
+def test_save_load_list_roundtrip(tmp_path):
+    path = str(tmp_path / "arrs.nd")
+    data = [mx.nd.array(np.random.randn(2, 3).astype(np.float32)),
+            mx.nd.array(np.array(7.0, dtype=np.float64))]
+    mx.nd.save(path, data)
+    out = mx.nd.load(path)
+    assert isinstance(out, list) and len(out) == 2
+    np.testing.assert_array_equal(out[0].asnumpy(), data[0].asnumpy())
+    np.testing.assert_array_equal(out[1].asnumpy(), data[1].asnumpy())
+
+
+def test_binary_layout_golden(tmp_path):
+    """Byte-for-byte check of the container framing against the reference
+    format spec (kMXAPINDArrayListMagic / NDARRAY_V2_MAGIC / TShape int64)."""
+    path = str(tmp_path / "g.params")
+    arr = np.array([[1.0, 2.0], [3.0, 4.0]], dtype=np.float32)
+    ser.save_ndarrays(path, [arr], ["w"])
+    raw = open(path, "rb").read()
+    expect = struct.pack("<QQ", 0x112, 0)          # header, reserved
+    expect += struct.pack("<Q", 1)                 # ndarray count
+    expect += struct.pack("<I", 0xF993FAC9)        # NDARRAY_V2_MAGIC
+    expect += struct.pack("<i", 0)                 # kDefaultStorage
+    expect += struct.pack("<i", 2) + struct.pack("<2q", 2, 2)  # TShape
+    expect += struct.pack("<ii", 1, 0)             # Context::CPU
+    expect += struct.pack("<i", 0)                 # kFloat32
+    expect += arr.tobytes()
+    expect += struct.pack("<Q", 1)                 # name count
+    expect += struct.pack("<Q", 1) + b"w"
+    assert raw == expect
+
+
+def test_load_reference_written_v1_and_legacy(tmp_path):
+    """Files using the older per-array magics still load (ndarray.cc:1683 LegacyLoad)."""
+    path = str(tmp_path / "old.nd")
+    a1 = np.arange(6, dtype=np.float32).reshape(2, 3)
+    a2 = np.arange(4, dtype=np.int64)
+    with open(path, "wb") as fo:
+        fo.write(struct.pack("<QQ", 0x112, 0))
+        fo.write(struct.pack("<Q", 2))
+        # V1 record: int64 dims, no stype field
+        fo.write(struct.pack("<I", 0xF993FAC8))
+        fo.write(struct.pack("<i", 2) + struct.pack("<2q", 2, 3))
+        fo.write(struct.pack("<ii", 1, 0) + struct.pack("<i", 0) + a1.tobytes())
+        # pre-V1 record: magic == ndim, uint32 dims
+        fo.write(struct.pack("<I", 1) + struct.pack("<I", 4))
+        fo.write(struct.pack("<ii", 1, 0) + struct.pack("<i", 6) + a2.tobytes())
+        fo.write(struct.pack("<Q", 0))
+    out = mx.nd.load(path)
+    assert isinstance(out, list)
+    np.testing.assert_array_equal(out[0].asnumpy(), a1)
+    np.testing.assert_array_equal(out[1].asnumpy(), a2)
+
+
+def test_v3_unknown_shape_none_sentinel(tmp_path):
+    """V3 np-shape record with ndim=-1 is the reference's none sentinel
+    (ndarray.cc:1751): loader must yield a placeholder, not crash, and the
+    record carries no ctx/dtype/data fields."""
+    path = str(tmp_path / "v3.nd")
+    a = np.float32([5.0])
+    with open(path, "wb") as fo:
+        fo.write(struct.pack("<QQ", 0x112, 0) + struct.pack("<Q", 2))
+        fo.write(struct.pack("<I", 0xF993FACA) + struct.pack("<i", 0))
+        fo.write(struct.pack("<i", -1))  # unknown shape, record ends here
+        fo.write(struct.pack("<I", 0xF993FACA) + struct.pack("<i", 0))
+        fo.write(struct.pack("<i", 1) + struct.pack("<q", 1))
+        fo.write(struct.pack("<ii", 1, 0) + struct.pack("<i", 0) + a.tobytes())
+        fo.write(struct.pack("<Q", 0))
+    out = mx.nd.load(path)
+    assert len(out) == 2
+    np.testing.assert_array_equal(out[1].asnumpy(), a)
+
+
+def test_corrupt_ndim_raises_format_error(tmp_path):
+    path = str(tmp_path / "bad.nd")
+    with open(path, "wb") as fo:
+        fo.write(struct.pack("<QQ", 0x112, 0) + struct.pack("<Q", 1))
+        fo.write(struct.pack("<I", 0xF993FAC9) + struct.pack("<i", 0))
+        fo.write(struct.pack("<i", -7))
+    with pytest.raises(ValueError, match="invalid NDArray file format"):
+        mx.nd.load(path)
+
+
+def test_gpu_context_loads_to_host(tmp_path):
+    """Reference files saved from GPU record ctx gpu(0); loader ignores ctx."""
+    path = str(tmp_path / "gpu.nd")
+    a = np.float32([1, 2])
+    with open(path, "wb") as fo:
+        fo.write(struct.pack("<QQ", 0x112, 0) + struct.pack("<Q", 1))
+        fo.write(struct.pack("<I", 0xF993FAC9) + struct.pack("<i", 0))
+        fo.write(struct.pack("<i", 1) + struct.pack("<q", 2))
+        fo.write(struct.pack("<ii", 2, 0))  # gpu(0)
+        fo.write(struct.pack("<i", 0) + a.tobytes())
+        fo.write(struct.pack("<Q", 0))
+    out = mx.nd.load(path)
+    np.testing.assert_array_equal(out[0].asnumpy(), a)
+
+
+def test_bfloat16_saved_as_float32(tmp_path):
+    path = str(tmp_path / "bf16.params")
+    x = mx.nd.array(np.float32([1.5, -2.25])).astype("bfloat16")
+    mx.nd.save(path, {"x": x})
+    out = mx.nd.load(path)
+    assert out["x"].dtype == np.float32
+    np.testing.assert_array_equal(out["x"].asnumpy(), np.float32([1.5, -2.25]))
+
+
+def test_float64_dtype_preserved(tmp_path):
+    path = str(tmp_path / "f64.nd")
+    a = mx.nd.array(np.array([1.0, 2.5], dtype=np.float64), dtype=np.float64)
+    mx.nd.save(path, [a])
+    out = mx.nd.load(path)
+    assert out[0].dtype == np.float64
+    np.testing.assert_array_equal(out[0].asnumpy(), np.float64([1.0, 2.5]))
+
+
+def test_zero_dim_shape_preserved(tmp_path):
+    """0-d arrays round-trip as 0-d (written as V3 records — a V2 ndim==0
+    record is the none-sentinel)."""
+    path = str(tmp_path / "s.nd")
+    mx.nd.save(path, [mx.nd.array(np.array(7.0)), mx.nd.ones((2,))])
+    out = mx.nd.load(path)
+    assert out[0].shape == ()
+    assert float(out[0].asnumpy()) == 7.0
+    np.testing.assert_array_equal(out[1].asnumpy(), np.ones(2, np.float32))
+
+
+def test_legacy_npz_still_loads(tmp_path):
+    path = str(tmp_path / "old.npz")
+    np.savez(path, w=np.float32([1, 2, 3]))
+    out = mx.nd.load(str(path))
+    np.testing.assert_array_equal(out["w"].asnumpy(), np.float32([1, 2, 3]))
+
+
+def test_gluon_save_load_parameters_binary(tmp_path):
+    path = str(tmp_path / "net.params")
+    net = mx.gluon.nn.Dense(4, in_units=3)
+    net.initialize()
+    net(mx.nd.ones((1, 3)))
+    net.save_parameters(path)
+    assert ser.is_mxnet_binary(path)
+    net2 = mx.gluon.nn.Dense(4, in_units=3)
+    net2.load_parameters(path)
+    np.testing.assert_allclose(
+        net2(mx.nd.ones((1, 3))).asnumpy(),
+        net(mx.nd.ones((1, 3))).asnumpy(), rtol=1e-6)
