@@ -264,13 +264,8 @@ class Block:
                     "Parameter %s loaded from file %s is not present in this " \
                     "block" % (name, filename)
                 continue
-            param = params[name]
-            param.shape = loaded[name].shape
-            if param._data is None and not param._deferred_init:
-                param.initialize(ctx=ctx or [current_context()])
-            param.set_data(loaded[name])
-            if param._deferred_init:
-                param._finish_deferred_init()
+            from .parameter import load_param_from_array
+            load_param_from_array(params[name], loaded[name], ctx)
 
     save_params = save_parameters
     load_params = load_parameters
@@ -390,7 +385,29 @@ class HybridBlock(Block):
             if p._deferred_init:
                 p._finish_deferred_init()
 
+    def _symbolic_forward(self, *args):
+        """Compose this block into a Symbol graph: parameters become named
+        variables, so nested blocks build one DAG (reference
+        `gluon/block.py:1128` HybridBlock.forward's symbol branch)."""
+        from .. import symbol as sym_ns
+        # aux-ness (BatchNorm moving stats etc.) is marked by the op the
+        # variable composes into (_sym_op aux slots), not by grad_req —
+        # a frozen weight is still an argument
+        params = {name: sym_ns.var(p.name)
+                  for name, p in self._reg_params.items()}
+        return self.hybrid_forward(sym_ns, *args, **params)
+
     def forward(self, *args):
+        from ..symbol.symbol import Symbol as _Sym
+        flat, fmt = _flatten(list(args))
+        self._in_fmt = fmt
+        if any(isinstance(a, _Sym) for a in flat):
+            return self._symbolic_forward(*args)
+        # remember which flat slots carried tensors (and the values of the
+        # ones that didn't) so export() can rebuild the exact call
+        self._in_tensor_mask = [isinstance(a, NDArray) for a in flat]
+        self._in_const_vals = [None if isinstance(a, NDArray) else a
+                               for a in flat]
         if self._active:
             return self._call_cached_op(*args)
         return self._eager_forward(*args)
@@ -453,17 +470,49 @@ class HybridBlock(Block):
         return out
 
     def export(self, path, epoch=0, remove_amp_cast=True):
-        """Serialize for deployment (reference `gluon/block.py:1077`): saves
-        ``path-symbol.json`` (graph metadata) + ``path-%04d.params``."""
-        import json
-        params = self._collect_params_with_prefix()
+        """Serialize for deployment (reference `gluon/block.py:1077`):
+        traces the block into a Symbol DAG, saving ``path-symbol.json``
+        (loadable via ``SymbolBlock.imports`` / ``mx.sym.load``) and
+        ``path-%04d.params`` (``arg:``/``aux:``-prefixed binary container,
+        the reference's export format). Returns (symbol_file, params_file).
+
+        Call the block on real data once first so the input structure is
+        known (same requirement as the reference)."""
+        from .. import symbol as sym_ns
+        fmt = getattr(self, "_in_fmt", None)
+        if fmt is None:
+            fmt = int(0)  # never called: assume a single input named 'data'
+        flat_n = 1 if not isinstance(fmt, list) else len(fmt)
+        mask = getattr(self, "_in_tensor_mask", None) or [True] * flat_n
+        consts = getattr(self, "_in_const_vals", None) or [None] * flat_n
+        n_tensors = sum(mask)
+        names = ["data"] if n_tensors == 1 else \
+            ["data%d" % i for i in range(n_tensors)]
+        # non-tensor slots (None masks, scalar flags) are replayed with the
+        # values from the last forward call, not turned into graph inputs
+        slots, it = [], iter(names)
+        for is_tensor, const in zip(mask, consts):
+            slots.append(sym_ns.var(next(it)) if is_tensor else const)
+        args_re, _ = _regroup(slots, fmt)
+        if not isinstance(args_re, list):
+            args_re = [args_re]
+        out = self(*args_re)
+        if isinstance(out, (list, tuple)):
+            out = sym_ns.Group(list(out))
+        symbol_file = "%s-symbol.json" % path
+        out.save(symbol_file)
+        graph_inputs = set(out.list_inputs())
+        aux_names = set(out.list_auxiliary_states())
         from ..ndarray import ndarray as _nd
-        arg_dict = {"arg:" + k: v._reduce() for k, v in params.items()}
-        _nd.save("%s-%04d.params" % (path, epoch), arg_dict)
-        meta = {"mxnet_tpu_export": type(self).__name__,
-                "nodes": sorted(params.keys())}
-        with open("%s-symbol.json" % path, "w") as f:
-            json.dump(meta, f)
+        arg_dict = {}
+        for name, p in self.collect_params().items():
+            if name not in graph_inputs:
+                continue  # params unused by forward aren't part of the graph
+            kind = "aux" if name in aux_names else "arg"
+            arg_dict["%s:%s" % (kind, name)] = p._reduce()
+        params_file = "%s-%04d.params" % (path, epoch)
+        _nd.save(params_file, arg_dict)
+        return symbol_file, params_file
 
     def hybrid_forward(self, F, x, *args, **kwargs):
         raise NotImplementedError
@@ -473,18 +522,102 @@ class HybridBlock(Block):
 
 
 class SymbolBlock(HybridBlock):
-    """Construct a block from a Symbol (reference `gluon/block.py:1190`)."""
+    """Run a Symbol graph as a Block (reference `gluon/block.py:1190`).
+
+    The graph's variables (minus the declared inputs) become Parameters, so
+    an imported model supports the full Block surface: forward on NDArrays
+    (with autograd — ops dispatch through the registry and record on the
+    tape), ``hybridize()`` (the evaluator is pure-JAX, so CachedOp jits the
+    whole graph to one XLA program), re-export, and fine-tuning."""
 
     @staticmethod
     def imports(symbol_file, input_names, param_file=None, ctx=None):
-        raise NotImplementedError(
-            "SymbolBlock.imports requires the symbol frontend; use "
-            "HybridBlock.export/load_parameters for deployment")
+        """Load an exported model: symbol JSON + optional binary params
+        (reference `gluon/block.py:1252`)."""
+        from .. import symbol as sym_ns
+        out = sym_ns.load(symbol_file)
+        if isinstance(input_names, str):
+            input_names = [input_names]
+        inputs = [sym_ns.var(n) for n in input_names]
+        ret = SymbolBlock(out, inputs)
+        if param_file is not None:
+            from ..ndarray import ndarray as _nd
+            from .parameter import load_param_from_array
+            loaded = _nd.load(param_file)
+            if isinstance(loaded, list):  # zero-name container == no params
+                if loaded:
+                    raise ValueError(
+                        "params file %s has unnamed arrays; SymbolBlock "
+                        "needs name->array entries" % param_file)
+                loaded = {}
+            params = ret.collect_params()
+            for key, v in loaded.items():
+                name = key.split(":", 1)[1] \
+                    if key.startswith(("arg:", "aux:")) else key
+                if name not in params._params:
+                    raise AssertionError(
+                        "Parameter %s in file %s is not a variable of the "
+                        "symbol graph" % (name, param_file))
+                load_param_from_array(params._params[name], v, ctx)
+        return ret
 
     def __init__(self, outputs, inputs, params=None):
         super().__init__(prefix="", params=params)
-        self._outputs = outputs
-        self._inputs = inputs
+        from ..symbol.symbol import Symbol as _Sym, Group as _Group
+        if isinstance(outputs, (list, tuple)):
+            outputs = _Group(list(outputs))
+        if isinstance(inputs, _Sym):
+            inputs = [inputs]
+        self._sb_outputs = outputs
+        self._input_names = [i.name for i in inputs]
+        for node in outputs._toposort():
+            if node._op is not None or node._name in self._input_names:
+                continue
+            is_aux = bool(node._attr.get("__aux__"))
+            p = self.params.get(node._name,
+                                grad_req="null" if is_aux else "write",
+                                allow_deferred_init=True)
+            self._reg_params[node._name] = p
 
-    def hybrid_forward(self, F, *args, **kwargs):
-        raise NotImplementedError
+    def infer_shape(self, *args):
+        """Resolve parameter shapes from input shapes via the symbol shape
+        pass — lets an imports() without a param file be initialized."""
+        known = {n: a.shape for n, a in zip(self._input_names, args)}
+        from ..symbol.symbol import _infer_shapes
+        shapes = _infer_shapes(self._sb_outputs, known)
+        for name, p in self._reg_params.items():
+            if shapes.get(name) is not None:
+                p.shape = tuple(shapes[name])
+
+    def hybrid_forward(self, F, *args, **params):
+        if len(args) != len(self._input_names):
+            raise ValueError("SymbolBlock expects %d inputs (%s), got %d"
+                             % (len(self._input_names), self._input_names,
+                                len(args)))
+        bindings = dict(zip(self._input_names, args))
+        bindings.update(params)
+        outs = _eval_symbol_graph(self._sb_outputs, bindings, F)
+        return outs if len(outs) > 1 else outs[0]
+
+
+def _eval_symbol_graph(root, bindings, F):
+    """Topologically evaluate a Symbol DAG by dispatching each node through
+    the F namespace (nd → registry invoke with tape recording; symbol →
+    graph re-composition). The graph-executor analogue for Block use."""
+    from ..symbol.symbol import _out_key, _node_arg_values
+    values = {}
+    for node in root._toposort():
+        if node._op is None:
+            if node._name not in bindings:
+                raise ValueError("unbound variable %r in SymbolBlock"
+                                 % node._name)
+            values[_out_key(node, 0)] = bindings[node._name]
+            continue
+        call_args = _node_arg_values(node, values)
+        out = getattr(F, node._op.name)(*call_args, **node._kwargs)
+        if isinstance(out, (tuple, list)):
+            for i, v in enumerate(out):
+                values[_out_key(node, i)] = v
+        else:
+            values[_out_key(node, 0)] = out
+    return [values[_out_key(s, i)] for s, i in root._outputs_list()]

@@ -466,11 +466,17 @@ class ParameterDict:
                         "Parameter %s in file %s is not in ParameterDict"
                         % (name, filename))
                 continue
-            param = self._params[name]
-            param.shape = v.shape
-            if param._data is None and not param._deferred_init:
-                param.initialize(ctx=ctx or [current_context()])
-            if param._data is not None or param._deferred_init:
-                param.set_data(v)
-                if param._deferred_init:
-                    param._finish_deferred_init()
+            load_param_from_array(self._params[name], v, ctx)
+
+
+def load_param_from_array(param, arr, ctx=None):
+    """Adopt a loaded array into a Parameter: take its shape, initialize if
+    needed, set the data (shared by ParameterDict.load, Block.load_parameters
+    and SymbolBlock.imports)."""
+    param.shape = arr.shape
+    if param._data is None and not param._deferred_init:
+        param.initialize(ctx=ctx or [current_context()])
+    if param._data is not None or param._deferred_init:
+        param.set_data(arr)
+        if param._deferred_init:
+            param._finish_deferred_init()

@@ -638,6 +638,10 @@ def load(fname):
         keys = list(z.keys())
         if "__mx_list__" in keys:
             n = int(z["__mx_list__"])
-            return [array(z["arr_%d" % i], dtype=z["arr_%d" % i].dtype)
-                    for i in range(n)]
-        return {k: array(z[k], dtype=z[k].dtype) for k in keys}
+            arrs = [z["arr_%d" % i] for i in range(n)]
+            return [array(a, dtype=a.dtype) for a in arrs]
+        out = {}
+        for k in keys:
+            a = z[k]
+            out[k] = array(a, dtype=a.dtype)
+        return out

@@ -251,6 +251,23 @@ class Symbol:
             entry = {"op": n._op.name if n._op else "null",
                      "name": n._name or ("node%d" % i),
                      "inputs": [[idx[id(p)], oi] for p, oi in n._inputs]}
+            # positional non-symbol inputs (None bias slots, scalars) are
+            # kept in the JSON so the loaded graph calls the op fn with the
+            # exact argument list it was traced with
+            raw = getattr(n, "_raw_inputs", None)
+            if raw is not None and any(isinstance(p, tuple) and p and
+                                       p[0] == "const" for p in raw):
+                consts = []
+                for pos, p in enumerate(raw):
+                    if isinstance(p, tuple) and p and p[0] == "const":
+                        try:
+                            json.dumps(p[1])
+                        except (TypeError, ValueError):
+                            raise MXNetError(
+                                "cannot serialize non-JSON const input %r of "
+                                "node %s" % (p[1], n._name))
+                        consts.append([pos, p[1]])
+                entry["const_inputs"] = consts
             if n._kwargs:
                 entry["attrs"] = {k: json.dumps(v) if not isinstance(v, str)
                                   else v for k, v in n._kwargs.items()}
@@ -381,6 +398,15 @@ def _sym_op(opname):
                 v = var("%s_%s" % (name, slot), attr={"__aux__": True})
                 v._attr["__aux__"] = True
                 tensor_args.append(v)
+        # explicit variable symbols composed into an op's aux slots (e.g.
+        # BatchNorm moving stats) are auxiliary states of the graph
+        if aux_slots:
+            for j in range(len(aux_slots)):
+                pos = 1 + len(slots) + j
+                if pos < len(tensor_args) and \
+                        isinstance(tensor_args[pos], Symbol) and \
+                        tensor_args[pos]._op is None:
+                    tensor_args[pos]._attr["__aux__"] = True
         node_inputs = []
         const_prefix = []
         for a in tensor_args:
@@ -590,6 +616,9 @@ def load_json(json_str):
             nodes.append(v)
         else:
             op = get_op(entry["op"])
+            if op is None:
+                raise MXNetError("cannot load symbol: unknown operator %r"
+                                 % entry["op"])
             kwargs = {}
             for k, sv in (entry.get("attrs") or {}).items():
                 try:
@@ -598,9 +627,17 @@ def load_json(json_str):
                     kwargs[k] = sv
             node = Symbol(op=op, inputs=[], kwargs=kwargs,
                           name=entry["name"])
-            raw = [(nodes[i], oi) for i, oi in entry["inputs"]]
+            sym_inputs = [(nodes[i], oi) for i, oi in entry["inputs"]]
+            consts = {pos: val for pos, val in entry.get("const_inputs", [])}
+            if consts:
+                raw, si = [], iter(sym_inputs)
+                for pos in range(len(sym_inputs) + len(consts)):
+                    raw.append(("const", consts[pos]) if pos in consts
+                               else next(si))
+            else:
+                raw = sym_inputs
             node._raw_inputs = raw
-            node._inputs = raw
+            node._inputs = sym_inputs
             nodes.append(node)
     heads = [(nodes[i], oi) for i, oi in data["heads"]]
     if len(heads) == 1 and heads[0][1] == 0:

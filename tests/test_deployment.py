@@ -1,0 +1,195 @@
+"""Deployment round-trip: HybridBlock.export -> SymbolBlock.imports
+(reference python/mxnet/gluon/block.py:1077 export, :1190 SymbolBlock)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, gluon, autograd as ag
+
+
+def _mlp():
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(16, activation="relu"), gluon.nn.Dense(4))
+    net.initialize()
+    return net
+
+
+def test_export_imports_mlp_exact(tmp_path):
+    net = _mlp()
+    x = nd.array(np.random.RandomState(0).randn(2, 8).astype(np.float32))
+    y0 = net(x).asnumpy()
+    sf, pf = net.export(str(tmp_path / "mlp"))
+    sb = gluon.SymbolBlock.imports(sf, ["data"], pf)
+    np.testing.assert_array_equal(sb(x).asnumpy(), y0)
+
+
+def test_export_imports_conv_bn_exact(tmp_path):
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Conv2D(8, 3, padding=1), gluon.nn.BatchNorm(),
+            gluon.nn.Activation("relu"), gluon.nn.MaxPool2D(2),
+            gluon.nn.Flatten(), gluon.nn.Dense(5))
+    net.initialize()
+    x = nd.array(np.random.RandomState(1).randn(2, 3, 8, 8).astype(np.float32))
+    y0 = net(x).asnumpy()
+    sf, pf = net.export(str(tmp_path / "conv"))
+    sb = gluon.SymbolBlock.imports(sf, ["data"], pf)
+    np.testing.assert_array_equal(sb(x).asnumpy(), y0)
+    # BatchNorm moving stats must travel as aux: entries (reference format)
+    loaded = nd.load(pf)
+    aux = [k for k in loaded if k.startswith("aux:")]
+    assert any("running_mean" in k for k in aux)
+    assert any("running_var" in k for k in aux)
+
+
+def test_export_imports_resnet18_exact(tmp_path):
+    from mxnet_tpu.gluon.model_zoo import vision
+    net = vision.resnet18_v1()
+    net.initialize()
+    x = nd.array(np.random.RandomState(2).randn(1, 3, 32, 32)
+                 .astype(np.float32))
+    y0 = net(x).asnumpy()
+    sf, pf = net.export(str(tmp_path / "r18"))
+    sb = gluon.SymbolBlock.imports(sf, ["data"], pf)
+    np.testing.assert_array_equal(sb(x).asnumpy(), y0)
+
+
+def test_imported_block_hybridize(tmp_path):
+    net = _mlp()
+    x = nd.array(np.random.RandomState(3).randn(4, 8).astype(np.float32))
+    y0 = net(x).asnumpy()
+    sf, pf = net.export(str(tmp_path / "m"))
+    sb = gluon.SymbolBlock.imports(sf, ["data"], pf)
+    sb.hybridize()
+    np.testing.assert_allclose(sb(x).asnumpy(), y0, rtol=1e-6)
+    np.testing.assert_allclose(sb(x).asnumpy(), y0, rtol=1e-6)  # cached
+
+
+def test_imported_block_finetune(tmp_path):
+    """Imported graphs support autograd: gradients flow to the imported
+    parameters so the model can be fine-tuned."""
+    net = _mlp()
+    x = nd.array(np.random.RandomState(4).randn(4, 8).astype(np.float32))
+    net(x)
+    sf, pf = net.export(str(tmp_path / "ft"))
+    sb = gluon.SymbolBlock.imports(sf, ["data"], pf)
+    trainer = gluon.Trainer(sb.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    before = sb(x).asnumpy()
+    with ag.record():
+        loss = (sb(x) ** 2).sum()
+    loss.backward()
+    grads = [p.grad().asnumpy() for p in sb.collect_params().values()
+             if p.grad_req != "null"]
+    assert any(np.abs(g).sum() > 0 for g in grads)
+    trainer.step(4)
+    after = sb(x).asnumpy()
+    assert np.abs(after - before).sum() > 0
+
+
+def test_reexport_imported_block(tmp_path):
+    net = _mlp()
+    x = nd.array(np.random.RandomState(5).randn(2, 8).astype(np.float32))
+    y0 = net(x).asnumpy()
+    sf, pf = net.export(str(tmp_path / "a"))
+    sb = gluon.SymbolBlock.imports(sf, ["data"], pf)
+    sb(x)
+    sf2, pf2 = sb.export(str(tmp_path / "b"))
+    sb2 = gluon.SymbolBlock.imports(sf2, ["data"], pf2)
+    np.testing.assert_array_equal(sb2(x).asnumpy(), y0)
+
+
+def test_symbolblock_from_symbol_and_infer_shape():
+    """SymbolBlock built directly from a composed Symbol, initialized via
+    shape inference without a params file."""
+    data = mx.sym.var("data")
+    out = mx.sym.FullyConnected(data, num_hidden=3, name="fc1")
+    sb = gluon.SymbolBlock(out, mx.sym.var("data"))
+    x = nd.ones((2, 5))
+    sb.infer_shape(x)
+    sb.collect_params().initialize()
+    y = sb(x)
+    assert y.shape == (2, 3)
+
+
+def test_export_load_checkpoint_module_flow(tmp_path):
+    """A gluon-exported model loads through the classic
+    mx.model.load_checkpoint -> Module flow (reference deployment path)."""
+    net = _mlp()
+    x = nd.array(np.random.RandomState(6).randn(2, 8).astype(np.float32))
+    y0 = net(x).asnumpy()
+    prefix = str(tmp_path / "ckpt")
+    net.export(prefix)
+    sym, arg_params, aux_params = mx.model.load_checkpoint(prefix, 0)
+    assert set(sym.list_arguments()) - {"data"} == set(arg_params.keys())
+    ex = sym.bind(mx.cpu(), dict(arg_params, data=x))
+    out = ex.forward()
+    np.testing.assert_allclose(out[0].asnumpy(), y0, rtol=1e-6)
+
+
+def test_frozen_params_export_as_arg_not_aux(tmp_path):
+    """grad_req='null' freezes training but a weight is still an argument
+    of the graph — only genuine op aux states (BN moving stats) are aux:."""
+    net = _mlp()
+    net.collect_params().setattr("grad_req", "null")
+    x = nd.ones((1, 8))
+    net(x)
+    sf, pf = net.export(str(tmp_path / "frz"))
+    loaded = nd.load(pf)
+    assert all(k.startswith("arg:") for k in loaded)
+    sym, arg_params, aux_params = mx.model.load_checkpoint(
+        str(tmp_path / "frz"), 0)
+    assert not aux_params
+    assert set(sym.list_arguments()) - {"data"} == set(arg_params.keys())
+
+
+def test_export_with_none_positional_arg(tmp_path):
+    """Non-tensor positional args (None mask etc.) replay their last value
+    at export instead of becoming phantom graph inputs."""
+    class Net(gluon.HybridBlock):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                self.fc = gluon.nn.Dense(4, in_units=3)
+
+        def hybrid_forward(self, F, a, mask):
+            out = self.fc(a)
+            if mask is not None:
+                out = out * mask
+            return out * 2
+
+    net = Net()
+    net.initialize()
+    x = nd.ones((2, 3))
+    y0 = net(x, None).asnumpy()
+    sf, pf = net.export(str(tmp_path / "nm"))
+    sb = gluon.SymbolBlock.imports(sf, ["data"], pf)
+    np.testing.assert_array_equal(sb(x).asnumpy(), y0)
+
+
+def test_export_paramless_block(tmp_path):
+    class Net(gluon.HybridBlock):
+        def hybrid_forward(self, F, x):
+            return F.relu(x - 1.0)
+
+    net = Net()
+    x = nd.array(np.float32([[0.0, 2.0]]))
+    y0 = net(x).asnumpy()
+    sf, pf = net.export(str(tmp_path / "pl"))
+    sb = gluon.SymbolBlock.imports(sf, ["data"], pf)
+    np.testing.assert_array_equal(sb(x).asnumpy(), y0)
+
+
+def test_imports_missing_param_raises(tmp_path):
+    net = _mlp()
+    x = nd.ones((1, 8))
+    net(x)
+    sf, pf = net.export(str(tmp_path / "m"))
+    loaded = nd.load(pf)
+    bad = {k: v for i, (k, v) in enumerate(sorted(loaded.items())) if i > 0}
+    bad["arg:not_in_graph"] = nd.ones((1,))
+    nd.save(str(tmp_path / "bad.params"), bad)
+    try:
+        gluon.SymbolBlock.imports(sf, ["data"], str(tmp_path / "bad.params"))
+    except AssertionError:
+        pass
+    else:
+        raise AssertionError("expected AssertionError for stray param")
