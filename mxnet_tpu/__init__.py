@@ -58,6 +58,7 @@ _LAZY = {
     "contrib": ".contrib",
     "amp": ".contrib.amp",
     "model": ".model",
+    "operator": ".operator",
     "rnn": ".rnn",
     "util": ".util",
 }

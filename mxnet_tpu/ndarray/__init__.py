@@ -30,3 +30,13 @@ def __getattr__(name):
 def imresize(*a, **k):
     from ..image import imresize as _f
     return _f(*a, **k)
+
+
+def Custom(*args, **kwargs):
+    """Invoke a registered Python CustomOp (reference
+    `python/mxnet/ndarray/ndarray.py` Custom → custom-inl.h). Accepts
+    mxnet-style keyword tensor inputs (``Custom(data=x, op_type='...')``)."""
+    from ..operator import normalize_custom_args
+    tensors, call_kwargs = normalize_custom_args(args, kwargs)
+    call_kwargs.pop("name", None)
+    return _get_op("Custom")(*tensors, **call_kwargs)

@@ -6,6 +6,16 @@ from .symbol import _populate_ops as _pop
 _pop(globals())
 
 
+def Custom(*args, **kwargs):
+    """Compose a registered Python CustomOp into the graph (reference
+    `python/mxnet/symbol/symbol.py` Custom). Keyword tensor inputs are
+    reordered by the prop's declared argument list."""
+    from ..operator import normalize_custom_args
+    from .symbol import _sym_op
+    tensors, call_kwargs = normalize_custom_args(args, kwargs)
+    return _sym_op("Custom")(*tensors, **call_kwargs)
+
+
 def __getattr__(name):
     from .symbol import _sym_op
     from ..ops.registry import get_op

@@ -269,8 +269,12 @@ class Symbol:
                         consts.append([pos, p[1]])
                 entry["const_inputs"] = consts
             if n._kwargs:
-                entry["attrs"] = {k: json.dumps(v) if not isinstance(v, str)
-                                  else v for k, v in n._kwargs.items()}
+                # every value is json-encoded (strings included) so the
+                # load side recovers the exact python type — '"4.0"' is a
+                # string kwarg, '4.0' a float (Custom op props rely on
+                # str-typed kwargs surviving the round trip)
+                entry["attrs"] = {k: json.dumps(v)
+                                  for k, v in n._kwargs.items()}
             if n._attr:
                 entry["node_attrs"] = {k: str(v) for k, v in n._attr.items()}
             out["nodes"].append(entry)

@@ -1,0 +1,294 @@
+"""Custom Python operators (reference python/mxnet/operator.py,
+example/numpy-ops/custom_softmax.py, tests/python/unittest/test_operator.py
+test_custom_op)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, gluon, autograd as ag
+
+
+@mx.operator.register("tsoftmax")
+class TSoftmaxProp(mx.operator.CustomOpProp):
+    """The reference custom_softmax example: softmax whose backward takes
+    the label directly (need_top_grad=False semantics)."""
+
+    def __init__(self):
+        super().__init__(need_top_grad=False)
+
+    def list_arguments(self):
+        return ["data", "label"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def infer_shape(self, in_shape):
+        return [in_shape[0], (in_shape[0][0],)], [in_shape[0]], []
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return TSoftmax()
+
+
+class TSoftmax(mx.operator.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        x = in_data[0].asnumpy()
+        y = np.exp(x - x.max(axis=1, keepdims=True))
+        y /= y.sum(axis=1, keepdims=True)
+        self.assign(out_data[0], req[0], mx.nd.array(y))
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        lab = in_data[1].asnumpy().ravel().astype(np.int64)
+        y = out_data[0].asnumpy().copy()
+        y[np.arange(lab.shape[0]), lab] -= 1.0
+        self.assign(in_grad[0], req[0], mx.nd.array(y))
+
+
+@mx.operator.register("scale2")
+class Scale2Prop(mx.operator.CustomOpProp):
+    """Simple op with a string-parsed kwarg, true-gradient backward."""
+
+    def __init__(self, factor="2.0"):
+        super().__init__(need_top_grad=True)
+        self.factor = float(factor)
+
+    def create_operator(self, ctx, shapes, dtypes):
+        factor = self.factor
+
+        class _Scale(mx.operator.CustomOp):
+            def forward(self, is_train, req, in_data, out_data, aux):
+                self.assign(out_data[0], req[0],
+                            mx.nd.array(in_data[0].asnumpy() * factor))
+
+            def backward(self, req, out_grad, in_data, out_data, in_grad,
+                         aux):
+                self.assign(in_grad[0], req[0],
+                            mx.nd.array(out_grad[0].asnumpy() * factor))
+        return _Scale()
+
+
+def _np_softmax(x):
+    y = np.exp(x - x.max(axis=1, keepdims=True))
+    return y / y.sum(axis=1, keepdims=True)
+
+
+def test_custom_eager_forward():
+    x = np.random.RandomState(0).randn(4, 5).astype(np.float32)
+    lab = np.zeros((4,), np.float32)
+    out = nd.Custom(nd.array(x), nd.array(lab), op_type="tsoftmax")
+    np.testing.assert_allclose(out.asnumpy(), _np_softmax(x), rtol=1e-5)
+
+
+def test_custom_kwarg_tensor_order():
+    x = np.random.RandomState(1).randn(3, 4).astype(np.float32)
+    lab = np.zeros((3,), np.float32)
+    out = nd.Custom(label=nd.array(lab), data=nd.array(x),
+                    op_type="tsoftmax")
+    np.testing.assert_allclose(out.asnumpy(), _np_softmax(x), rtol=1e-5)
+
+
+def test_custom_backward_autograd():
+    x = np.random.RandomState(2).randn(4, 5).astype(np.float32)
+    lab = np.array([1, 0, 3, 2], np.float32)
+    xa = nd.array(x)
+    xa.attach_grad()
+    with ag.record():
+        out = nd.Custom(xa, nd.array(lab), op_type="tsoftmax")
+    out.backward()
+    expect = _np_softmax(x)
+    expect[np.arange(4), lab.astype(np.int64)] -= 1.0
+    np.testing.assert_allclose(xa.grad.asnumpy(), expect, rtol=1e-5)
+
+
+def test_custom_true_gradient_chain():
+    """Custom grad composes with surrounding autograd ops."""
+    x = nd.array(np.float32([[1.0, -2.0, 3.0]]))
+    x.attach_grad()
+    with ag.record():
+        y = nd.Custom(x, op_type="scale2", factor="3.0")
+        z = (y * y).sum()
+    z.backward()
+    # z = 9 x^2 -> dz/dx = 18 x
+    np.testing.assert_allclose(x.grad.asnumpy(), 18.0 * x.asnumpy(),
+                               rtol=1e-5)
+
+
+def test_custom_in_hybridized_block():
+    """pure_callback keeps Custom working inside one jitted program."""
+    class Net(gluon.HybridBlock):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                self.fc = gluon.nn.Dense(4, in_units=3)
+
+        def hybrid_forward(self, F, x):
+            return F.Custom(self.fc(x), op_type="scale2", factor="2.0")
+
+    net = Net()
+    net.initialize()
+    x = nd.array(np.random.RandomState(3).randn(2, 3).astype(np.float32))
+    y0 = net(x).asnumpy()
+    net.hybridize()
+    np.testing.assert_allclose(net(x).asnumpy(), y0, rtol=1e-5)
+    np.testing.assert_allclose(net(x).asnumpy(), y0, rtol=1e-5)
+
+
+def test_custom_symbol_compose_and_bind():
+    data = mx.sym.var("data")
+    out = mx.sym.Custom(data=data, op_type="scale2", factor="5.0",
+                        name="sc")
+    x = nd.array(np.float32([[1.0, 2.0]]))
+    ex = out.bind(mx.cpu(), {"data": x})
+    (y,) = ex.forward()
+    np.testing.assert_allclose(y.asnumpy(), 5.0 * x.asnumpy(), rtol=1e-6)
+
+
+def test_custom_export_roundtrip(tmp_path):
+    class Net(gluon.HybridBlock):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                self.fc = gluon.nn.Dense(3, in_units=2)
+
+        def hybrid_forward(self, F, x):
+            return F.Custom(self.fc(x), op_type="scale2", factor="4.0")
+
+    net = Net()
+    net.initialize()
+    x = nd.array(np.random.RandomState(4).randn(2, 2).astype(np.float32))
+    y0 = net(x).asnumpy()
+    sf, pf = net.export(str(tmp_path / "cnet"))
+    sb = gluon.SymbolBlock.imports(sf, ["data"], pf)
+    np.testing.assert_allclose(sb(x).asnumpy(), y0, rtol=1e-6)
+
+
+def test_register_op_jax_kernel():
+    """Device-speed path: a pure JAX function registered as a first-class
+    op appears in nd/sym namespaces and differentiates via jax.vjp."""
+    import jax.numpy as jnp
+
+    @mx.operator.register_op(name="_test_squareplus")
+    def _squareplus(x, beta=1.0):
+        return (x + jnp.sqrt(x * x + beta)) / 2.0
+
+    x = nd.array(np.float32([-1.0, 0.0, 2.0]))
+    y = nd._test_squareplus(x)
+    np.testing.assert_allclose(
+        y.asnumpy(), (x.asnumpy() + np.sqrt(x.asnumpy() ** 2 + 1)) / 2,
+        rtol=1e-6)
+    x.attach_grad()
+    with ag.record():
+        z = nd._test_squareplus(x).sum()
+    z.backward()
+    g = 0.5 * (1 + x.asnumpy() / np.sqrt(x.asnumpy() ** 2 + 1))
+    np.testing.assert_allclose(x.grad.asnumpy(), g, rtol=1e-5)
+
+
+def test_custom_state_forward_to_backward():
+    """State stashed on self in forward() is visible in the matching
+    backward() (reference keeps one operator instance per invoke)."""
+    @mx.operator.register("statemask")
+    class StateMaskProp(mx.operator.CustomOpProp):
+        def create_operator(self, ctx, shapes, dtypes):
+            class _Op(mx.operator.CustomOp):
+                def forward(self, is_train, req, in_data, out_data, aux):
+                    x = in_data[0].asnumpy()
+                    self.saved_mask = (x > 0).astype(x.dtype)
+                    self.assign(out_data[0], req[0],
+                                mx.nd.array(x * self.saved_mask))
+
+                def backward(self, req, out_grad, in_data, out_data,
+                             in_grad, aux):
+                    g = out_grad[0].asnumpy() * self.saved_mask
+                    self.assign(in_grad[0], req[0], mx.nd.array(g))
+            return _Op()
+
+    x = nd.array(np.float32([-1.0, 2.0, -3.0, 4.0]))
+    x.attach_grad()
+    with ag.record():
+        y = nd.Custom(x, op_type="statemask").sum()
+    y.backward()
+    np.testing.assert_array_equal(x.grad.asnumpy(),
+                                  np.float32([0, 1, 0, 1]))
+
+
+def test_custom_is_train_via_executor():
+    """is_train reaches CustomOp.forward through the symbol executor."""
+    seen = []
+
+    @mx.operator.register("trainprobe")
+    class ProbeProp(mx.operator.CustomOpProp):
+        def create_operator(self, ctx, shapes, dtypes):
+            class _Op(mx.operator.CustomOp):
+                def forward(self, is_train, req, in_data, out_data, aux):
+                    seen.append(bool(is_train))
+                    self.assign(out_data[0], req[0], in_data[0])
+            return _Op()
+
+    data = mx.sym.var("data")
+    out = mx.sym.Custom(data=data, op_type="trainprobe")
+    ex = out.bind(mx.cpu(), {"data": nd.ones((2,))})
+    ex.forward(is_train=True)
+    ex.forward(is_train=False)
+    assert seen == [True, False]
+
+
+def test_custom_str_kwarg_survives_export(tmp_path):
+    """String-typed prop kwargs (reference semantics: all kwargs arrive as
+    str) survive the symbol JSON round trip."""
+    @mx.operator.register("axsplit")
+    class AxProp(mx.operator.CustomOpProp):
+        def __init__(self, axes="0,1"):
+            super().__init__()
+            self.axes = [int(a) for a in axes.split(",")]
+
+        def create_operator(self, ctx, shapes, dtypes):
+            axes = self.axes
+
+            class _Op(mx.operator.CustomOp):
+                def forward(self, is_train, req, in_data, out_data, aux):
+                    self.assign(out_data[0], req[0],
+                                mx.nd.array(in_data[0].asnumpy()
+                                            + float(len(axes))))
+            return _Op()
+
+    data = mx.sym.var("data")
+    out = mx.sym.Custom(data=data, op_type="axsplit", axes="0,1,2")
+    out2 = mx.sym.load_json(out.tojson())
+    x = nd.zeros((2,))
+    (y,) = out2.bind(mx.cpu(), {"data": x}).forward()
+    np.testing.assert_array_equal(y.asnumpy(), np.float32([3.0, 3.0]))
+
+
+def test_custom_reregistration_takes_effect():
+    @mx.operator.register("revop")
+    class Rev1(mx.operator.CustomOpProp):
+        def create_operator(self, ctx, shapes, dtypes):
+            class _Op(mx.operator.CustomOp):
+                def forward(self, is_train, req, in_data, out_data, aux):
+                    self.assign(out_data[0], req[0],
+                                mx.nd.array(in_data[0].asnumpy() * 2))
+            return _Op()
+
+    x = nd.ones((2,))
+    np.testing.assert_array_equal(
+        nd.Custom(x, op_type="revop").asnumpy(), np.float32([2, 2]))
+
+    @mx.operator.register("revop")
+    class Rev2(mx.operator.CustomOpProp):
+        def create_operator(self, ctx, shapes, dtypes):
+            class _Op(mx.operator.CustomOp):
+                def forward(self, is_train, req, in_data, out_data, aux):
+                    self.assign(out_data[0], req[0],
+                                mx.nd.array(in_data[0].asnumpy() * 10))
+            return _Op()
+
+    np.testing.assert_array_equal(
+        nd.Custom(x, op_type="revop").asnumpy(), np.float32([10, 10]))
+
+
+def test_unregistered_op_type_raises():
+    try:
+        nd.Custom(nd.ones((1,)), op_type="definitely_not_registered")
+    except ValueError as e:
+        assert "not registered" in str(e)
+    else:
+        raise AssertionError("expected ValueError")
