@@ -99,8 +99,9 @@ def register(reg_name):
     previous prop (notebook iteration)."""
     def do_register(prop_cls):
         _REGISTRY[reg_name] = prop_cls
-        for key in [k for k in _CALLABLE_CACHE if k[0] == reg_name]:
-            del _CALLABLE_CACHE[key]
+        for cache in (_CALLABLE_CACHE, _ARG_NAMES_CACHE):
+            for key in [k for k in cache if k[0] == reg_name]:
+                del cache[key]
         return prop_cls
     return do_register
 
@@ -151,7 +152,10 @@ _OP_STATES = OrderedDict()
 _OP_STATE_CAP = 4096
 _op_state_counter = [0]
 
-_CALLABLE_CACHE = {}
+# bounded FIFO: per-step-varying prop kwargs (e.g. a stringified lr) must
+# not grow memory without bound over a long training run
+_CALLABLE_CACHE = OrderedDict()
+_CALLABLE_CACHE_CAP = 512
 
 
 def _kwargs_key(prop_kwargs):
@@ -244,6 +248,8 @@ def _custom_callable(op_type, prop_kwargs, is_train):
 
     run.defvjp(run_fwd, run_bwd)
     _CALLABLE_CACHE[key] = (run, n_out, prop)
+    while len(_CALLABLE_CACHE) > _CALLABLE_CACHE_CAP:
+        _CALLABLE_CACHE.popitem(last=False)
     return run, n_out, prop
 
 
@@ -258,8 +264,25 @@ def _custom_fn(*tensor_vals, op_type, __is_train__=None, **prop_kwargs):
     return out if n_out > 1 else out[0]
 
 
-register_op(name="Custom", state_binders={"__is_train__": _tape.is_training})(
-    _custom_fn)
+register_op(name="Custom", aliases=("_npi_Custom",),
+            state_binders={"__is_train__": _tape.is_training})(_custom_fn)
+
+
+_ARG_NAMES_CACHE = OrderedDict()
+
+
+def _arg_names(op_type, prop_kwargs):
+    """Declared tensor-input order for one (op_type, kwargs) config —
+    cached so eager calls don't rebuild the prop every invoke."""
+    key = (op_type, _kwargs_key(prop_kwargs))
+    names = _ARG_NAMES_CACHE.get(key)
+    if names is None:
+        prop = _make_prop(op_type, prop_kwargs)
+        names = prop.list_arguments() + prop.list_auxiliary_states()
+        _ARG_NAMES_CACHE[key] = names
+        while len(_ARG_NAMES_CACHE) > _CALLABLE_CACHE_CAP:
+            _ARG_NAMES_CACHE.popitem(last=False)
+    return names
 
 
 def normalize_custom_args(args, kwargs):
@@ -280,8 +303,7 @@ def normalize_custom_args(args, kwargs):
     # through the C boundary as strings, so props parse str values
     prop_kwargs = {k: v if isinstance(v, str) else str(v)
                    for k, v in kwargs.items() if k not in tensor_kwargs}
-    _, _, prop = _custom_callable(op_type, prop_kwargs, False)
-    names = prop.list_arguments() + prop.list_auxiliary_states()
+    names = _arg_names(op_type, prop_kwargs)
     tensors = list(args)
     for n in names[len(tensors):]:
         if n in tensor_kwargs:

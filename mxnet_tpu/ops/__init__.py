@@ -12,6 +12,13 @@ from . import nn        # noqa: F401  (registers NN ops)
 from . import contrib_ops  # noqa: F401
 from . import ctc       # noqa: F401  (CTC loss dynamic program)
 from . import rnn       # noqa: F401  (fused RNN scan layers)
+from . import tensor_extra  # noqa: F401  (scalar/creation/indexing breadth)
+from . import optim_ops  # noqa: F401  (optimizer update kernels)
+from . import random_ops  # noqa: F401  (sampling ops)
+from . import linalg_extra  # noqa: F401
+from . import loss_ops  # noqa: F401  (regression outputs, ROI)
+from . import image_ops  # noqa: F401
+from . import numpy_ops  # noqa: F401  (_npi_/_np_/_npx_ registrations)
 
 
 def populate_namespace(target, names=None):

@@ -1,0 +1,27 @@
+"""Shared helpers for op modules: RNG-key state binder and dtype default.
+
+One definition so every stochastic op family binds keys the same way
+(deterministic tape replay — see registry.Op.state_binders docstring).
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from ..base import dtype_np
+
+
+def _bind_key():
+    from .. import random as _rnd
+    return _rnd.next_key()
+
+
+def _bind_train():
+    from .. import _tape
+    return _tape.is_training()
+
+
+_RNG = {"key": _bind_key}
+
+
+def _dt(dtype, default=_np.float32):
+    return default if dtype is None else dtype_np(dtype)

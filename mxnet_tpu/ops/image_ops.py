@@ -1,0 +1,199 @@
+"""Image operators.
+
+Role parity: reference ``src/operator/image/image_random.cc`` and
+``resize.cc`` / ``crop.cc`` (_image_* registrations behind
+mx.nd.image.* / npx.image.*). HWC layout (trailing channel), batched
+leading dims allowed — same contract as the reference. Random-augment ops
+bind RNG keys at invoke (state_binders) like every stochastic op here.
+"""
+from __future__ import annotations
+
+import numpy as _np
+import jax
+import jax.numpy as jnp
+
+from ._common import _bind_key, _RNG, _dt  # noqa: F401
+from .registry import register
+
+_NPX = "_npx__image_"
+
+
+
+
+
+
+@register("_image_to_tensor", aliases=(_NPX + "to_tensor", "image_to_tensor"))
+def _image_to_tensor(data):
+    """HWC uint8 [0,255] -> CHW float32 [0,1] (reference image_random.cc
+    ToTensor); batched NHWC -> NCHW."""
+    x = data.astype(jnp.float32) / 255.0
+    if data.ndim == 3:
+        return jnp.transpose(x, (2, 0, 1))
+    return jnp.transpose(x, (0, 3, 1, 2))
+
+
+@register("_image_normalize", aliases=(_NPX + "normalize", "image_normalize"))
+def _image_normalize(data, mean=0.0, std=1.0):
+    """Channel-wise (x - mean) / std on CHW/NCHW tensors (reference
+    NormalizeOpForward)."""
+    c_axis = 0 if data.ndim == 3 else 1
+    shape = [1] * data.ndim
+    shape[c_axis] = -1
+    mean = jnp.reshape(jnp.atleast_1d(jnp.asarray(mean, data.dtype)), shape) \
+        if _np.ndim(mean) or isinstance(mean, (tuple, list)) else mean
+    std = jnp.reshape(jnp.atleast_1d(jnp.asarray(std, data.dtype)), shape) \
+        if _np.ndim(std) or isinstance(std, (tuple, list)) else std
+    return (data - mean) / std
+
+
+@register("_image_crop", aliases=(_NPX + "crop", "image_crop"))
+def _image_crop(data, x=0, y=0, width=1, height=1):
+    """Fixed crop of HWC/NHWC images (reference crop.cc)."""
+    sl = (slice(int(y), int(y) + int(height)),
+          slice(int(x), int(x) + int(width)), slice(None))
+    return data[(Ellipsis,) + sl]  # trailing HWC, any number of batch dims
+
+
+@register("_image_resize", aliases=(_NPX + "resize", "image_resize"))
+def _image_resize(data, size=None, keep_ratio=False, interp=1):
+    """Bilinear/nearest resize of HWC/NHWC (reference resize.cc)."""
+    if isinstance(size, int):
+        size = (size, size)
+    w, h = int(size[0]), int(size[1])
+    method = "nearest" if int(interp) == 0 else "linear"
+    if data.ndim == 3:
+        out_shape = (h, w, data.shape[2])
+    else:
+        out_shape = (data.shape[0], h, w, data.shape[3])
+    return jax.image.resize(data.astype(jnp.float32), out_shape,
+                            method=method).astype(data.dtype)
+
+
+@register("_image_flip_left_right",
+          aliases=(_NPX + "flip_left_right", "image_flip_left_right"))
+def _image_flip_left_right(data):
+    return jnp.flip(data, axis=-2)
+
+
+@register("_image_flip_top_bottom",
+          aliases=(_NPX + "flip_top_bottom", "image_flip_top_bottom"))
+def _image_flip_top_bottom(data):
+    return jnp.flip(data, axis=-3)
+
+
+@register("_image_random_flip_left_right",
+          aliases=(_NPX + "random_flip_left_right",),
+          differentiable=False, state_binders=_RNG)
+def _image_random_flip_left_right(data, key=None):
+    flip = jax.random.bernoulli(key)
+    return jnp.where(flip, jnp.flip(data, axis=-2), data)
+
+
+@register("_image_random_flip_top_bottom",
+          aliases=(_NPX + "random_flip_top_bottom",),
+          differentiable=False, state_binders=_RNG)
+def _image_random_flip_top_bottom(data, key=None):
+    flip = jax.random.bernoulli(key)
+    return jnp.where(flip, jnp.flip(data, axis=-3), data)
+
+
+def _blend(a, b, w):
+    return a * w + b * (1.0 - w)
+
+
+def _to_gray(x):
+    # ITU-R BT.601 luma weights, HWC trailing channel
+    wts = jnp.asarray([0.299, 0.587, 0.114], x.dtype)
+    gray = jnp.sum(x * wts, axis=-1, keepdims=True)
+    return jnp.broadcast_to(gray, x.shape)
+
+
+@register("_image_random_brightness",
+          aliases=(_NPX + "random_brightness",),
+          differentiable=False, state_binders=_RNG)
+def _image_random_brightness(data, min_factor=0.0, max_factor=1.0, key=None):
+    f = jax.random.uniform(key, (), minval=min_factor, maxval=max_factor)
+    return data * f
+
+
+@register("_image_random_contrast",
+          aliases=(_NPX + "random_contrast",),
+          differentiable=False, state_binders=_RNG)
+def _image_random_contrast(data, min_factor=0.0, max_factor=1.0, key=None):
+    f = jax.random.uniform(key, (), minval=min_factor, maxval=max_factor)
+    x = data.astype(jnp.float32)
+    mean = jnp.mean(_to_gray(x)[..., :1])
+    return _blend(x, jnp.full_like(x, mean), f).astype(data.dtype)
+
+
+@register("_image_random_saturation",
+          aliases=(_NPX + "random_saturation",),
+          differentiable=False, state_binders=_RNG)
+def _image_random_saturation(data, min_factor=0.0, max_factor=1.0, key=None):
+    f = jax.random.uniform(key, (), minval=min_factor, maxval=max_factor)
+    x = data.astype(jnp.float32)
+    return _blend(x, _to_gray(x), f).astype(data.dtype)
+
+
+@register("_image_random_hue", aliases=(_NPX + "random_hue",),
+          differentiable=False, state_binders=_RNG)
+def _image_random_hue(data, min_factor=0.0, max_factor=1.0, key=None):
+    """Hue rotation via the YIQ linear approximation (reference
+    RandomHue uses the same linearized transform)."""
+    f = jax.random.uniform(key, (), minval=min_factor, maxval=max_factor)
+    theta = f * jnp.pi
+    x = data.astype(jnp.float32)
+    c, s = jnp.cos(theta), jnp.sin(theta)
+    t_yiq = jnp.asarray([[0.299, 0.587, 0.114],
+                         [0.596, -0.274, -0.321],
+                         [0.211, -0.523, 0.311]], jnp.float32)
+    t_rgb = jnp.linalg.inv(t_yiq)
+    rot = jnp.asarray([[1.0, 0.0, 0.0],
+                       [0.0, c, -s],
+                       [0.0, s, c]], jnp.float32)
+    m = t_rgb @ rot @ t_yiq
+    return jnp.einsum("...c,dc->...d", x, m).astype(data.dtype)
+
+
+@register("_image_random_lighting", aliases=(_NPX + "random_lighting",),
+          differentiable=False, state_binders=_RNG)
+def _image_random_lighting(data, alpha_std=0.05, key=None):
+    """AlexNet-style PCA lighting noise (reference RandomLighting, fixed
+    ImageNet eigen-basis)."""
+    eigval = jnp.asarray([55.46, 4.794, 1.148], jnp.float32)
+    eigvec = jnp.asarray([[-0.5675, 0.7192, 0.4009],
+                          [-0.5808, -0.0045, -0.8140],
+                          [-0.5836, -0.6948, 0.4203]], jnp.float32)
+    alpha = jax.random.normal(key, (3,)) * alpha_std
+    delta = eigvec @ (alpha * eigval)
+    return (data.astype(jnp.float32) + delta).astype(data.dtype)
+
+
+@register("_image_adjust_lighting", aliases=(_NPX + "adjust_lighting",))
+def _image_adjust_lighting(data, alpha=None):
+    eigval = jnp.asarray([55.46, 4.794, 1.148], jnp.float32)
+    eigvec = jnp.asarray([[-0.5675, 0.7192, 0.4009],
+                          [-0.5808, -0.0045, -0.8140],
+                          [-0.5836, -0.6948, 0.4203]], jnp.float32)
+    delta = eigvec @ (jnp.asarray(alpha, jnp.float32) * eigval)
+    return (data.astype(jnp.float32) + delta).astype(data.dtype)
+
+
+@register("_image_random_color_jitter",
+          aliases=(_NPX + "random_color_jitter",),
+          differentiable=False, state_binders=_RNG)
+def _image_random_color_jitter(data, brightness=0.0, contrast=0.0,
+                               saturation=0.0, hue=0.0, key=None):
+    kb, kc, ks, kh = jax.random.split(key, 4)
+    x = data
+    if brightness > 0:
+        x = _image_random_brightness.fn(x, 1 - brightness, 1 + brightness,
+                                        key=kb)
+    if contrast > 0:
+        x = _image_random_contrast.fn(x, 1 - contrast, 1 + contrast, key=kc)
+    if saturation > 0:
+        x = _image_random_saturation.fn(x, 1 - saturation, 1 + saturation,
+                                        key=ks)
+    if hue > 0:
+        x = _image_random_hue.fn(x, -hue, hue, key=kh)
+    return x

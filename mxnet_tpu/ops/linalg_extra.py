@@ -1,0 +1,114 @@
+"""Linear-algebra operator breadth.
+
+Role parity: the remaining registrations of reference
+``src/operator/tensor/la_op.cc`` (det/slogdet/inverse/potri/trmm/gelqf/
+syevd/makediag/maketrian/extracttrian) — lowered to jax.numpy.linalg /
+lax.linalg where XLA provides blocked TPU kernels.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register, register_alias
+
+
+@register("linalg_det", aliases=("_linalg_det", "det"))
+def linalg_det(A):
+    return jnp.linalg.det(A)
+
+
+@register("linalg_slogdet", aliases=("_linalg_slogdet",), n_out=2)
+def linalg_slogdet(A):
+    sign, logabsdet = jnp.linalg.slogdet(A)
+    return sign, logabsdet
+
+
+@register("linalg_inverse", aliases=("_linalg_inverse", "inverse"))
+def linalg_inverse(A):
+    return jnp.linalg.inv(A)
+
+
+@register("linalg_potri", aliases=("_linalg_potri",))
+def linalg_potri(A):
+    """Inverse from a Cholesky factor: (L L^T)^-1 (reference la_op.cc
+    potri)."""
+    eye = jnp.broadcast_to(jnp.eye(A.shape[-1], dtype=A.dtype), A.shape)
+    Linv = lax.linalg.triangular_solve(A, eye, left_side=True, lower=True)
+    return jnp.matmul(jnp.swapaxes(Linv, -1, -2), Linv)
+
+
+@register("linalg_trmm", aliases=("_linalg_trmm",))
+def linalg_trmm(A, B, transpose=False, rightside=False, lower=True,
+                alpha=1.0):
+    """Triangular matrix multiply B = alpha * op(A) B (reference trmm)."""
+    tri = jnp.tril(A) if lower else jnp.triu(A)
+    if transpose:
+        tri = jnp.swapaxes(tri, -1, -2)
+    out = jnp.matmul(B, tri) if rightside else jnp.matmul(tri, B)
+    return alpha * out
+
+
+@register("linalg_gelqf", aliases=("_linalg_gelqf",), n_out=2)
+def linalg_gelqf(A):
+    """LQ factorization A = L Q with Q orthonormal rows (reference gelqf;
+    computed via QR of A^T on TPU)."""
+    q, r = jnp.linalg.qr(jnp.swapaxes(A, -1, -2), mode="reduced")
+    return jnp.swapaxes(r, -1, -2), jnp.swapaxes(q, -1, -2)
+
+
+@register("linalg_syevd", aliases=("_linalg_syevd",), n_out=2)
+def linalg_syevd(A):
+    """Symmetric eigendecomposition A = U^T diag(L) U (reference syevd:
+    rows of the returned U are the eigenvectors)."""
+    w, v = jnp.linalg.eigh(A)
+    return jnp.swapaxes(v, -1, -2), w
+
+
+@register("linalg_makediag", aliases=("_linalg_makediag",))
+def linalg_makediag(A, offset=0):
+    return jnp.apply_along_axis(
+        lambda d: jnp.diag(d, k=int(offset)), -1, A) \
+        if A.ndim > 1 else jnp.diag(A, k=int(offset))
+
+
+@register("linalg_maketrian", aliases=("_linalg_maketrian",))
+def linalg_maketrian(A, offset=0, lower=True):
+    """Pack a vector of triangle entries into a triangular matrix
+    (reference maketrian, inverse of extracttrian): recover the matrix
+    size n from the entry count, then scatter."""
+    k = int(offset)
+    n_entries = A.shape[-1]
+    n = 1
+    while len(_tri_indices(n, k, lower)[0]) < n_entries:
+        n += 1
+    rows, cols = _tri_indices(n, k, lower)
+    out = jnp.zeros(A.shape[:-1] + (n, n), A.dtype)
+    return out.at[..., rows, cols].set(A)
+
+
+def _tri_indices(n, k, lower):
+    """offset 0: triangle chosen by `lower`; offset>0: triangle above the
+    k-th superdiagonal; offset<0: below the k-th subdiagonal (reference
+    la_op.h ExtractTrianParam semantics)."""
+    import numpy as np
+    if k > 0:
+        return np.triu_indices(n, k)
+    if k < 0:
+        return np.tril_indices(n, k)
+    return np.tril_indices(n) if lower else np.triu_indices(n)
+
+
+@register("linalg_extracttrian", aliases=("_linalg_extracttrian",))
+def linalg_extracttrian(A, offset=0, lower=True):
+    rows, cols = _tri_indices(A.shape[-1], int(offset), lower)
+    return A[..., rows, cols]
+
+
+register_alias("linalg_gemm", "_linalg_gemm")
+register_alias("linalg_gemm2", "_linalg_gemm2")
+register_alias("linalg_potrf", "_linalg_potrf")
+register_alias("linalg_syrk", "_linalg_syrk")
+register_alias("linalg_trsm", "_linalg_trsm")
+register_alias("linalg_sumlogdiag", "_linalg_sumlogdiag")
+register_alias("linalg_extractdiag", "_linalg_extractdiag")

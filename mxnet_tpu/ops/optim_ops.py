@@ -1,0 +1,358 @@
+"""Optimizer update kernels as framework ops.
+
+Role parity: reference ``src/operator/optimizer_op.cc`` (sgd/adam/ftrl/...
+update ops invoked by python optimizers) and ``contrib/adamw.cc``. Each op
+is a pure functional update returning the new weight (and new state tensors
+where the reference writes them in-place) — callers rebind, and under jit
+XLA turns the rebind into an in-place donated-buffer update (the same
+mechanism `optimizer/optimizer.py` uses for its fused trainer kernels).
+
+Gradient clipping/rescale semantics follow the reference: grad is first
+scaled by rescale_grad, then clipped, then weight decay applied.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import register
+
+__all__ = []
+
+
+def _prep(grad, rescale_grad, clip_gradient):
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient >= 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    return g
+
+
+@register("sgd_update")
+def sgd_update(weight, grad, lr=0.01, wd=0.0, rescale_grad=1.0,
+               clip_gradient=-1.0, lazy_update=True):
+    g = _prep(grad, rescale_grad, clip_gradient)
+    return weight - lr * (g + wd * weight)
+
+
+@register("sgd_mom_update", n_out=2)
+def sgd_mom_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0, lazy_update=True):
+    g = _prep(grad, rescale_grad, clip_gradient)
+    new_mom = momentum * mom - lr * (g + wd * weight)
+    return weight + new_mom, new_mom
+
+
+@register("mp_sgd_update", n_out=2)
+def mp_sgd_update(weight, grad, weight32, lr=0.01, wd=0.0, rescale_grad=1.0,
+                  clip_gradient=-1.0, lazy_update=True):
+    """Mixed-precision sgd: fp32 master weight, low-precision model weight
+    (reference optimizer_op.cc MP_SGD)."""
+    g = _prep(grad.astype(jnp.float32), rescale_grad, clip_gradient)
+    new_w32 = weight32 - lr * (g + wd * weight32)
+    return new_w32.astype(weight.dtype), new_w32
+
+
+@register("mp_sgd_mom_update", n_out=3)
+def mp_sgd_mom_update(weight, grad, mom, weight32, lr=0.01, momentum=0.0,
+                      wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                      lazy_update=True):
+    g = _prep(grad.astype(jnp.float32), rescale_grad, clip_gradient)
+    new_mom = momentum * mom - lr * (g + wd * weight32)
+    new_w32 = weight32 + new_mom
+    return new_w32.astype(weight.dtype), new_mom, new_w32
+
+
+@register("nag_mom_update", n_out=2)
+def nag_mom_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0):
+    """Nesterov momentum (reference optimizer_op.cc NAG)."""
+    g = _prep(grad, rescale_grad, clip_gradient) + wd * weight
+    new_mom = momentum * mom + g
+    return weight - lr * (g + momentum * new_mom), new_mom
+
+
+@register("mp_nag_mom_update", n_out=3)
+def mp_nag_mom_update(weight, grad, mom, weight32, lr=0.01, momentum=0.0,
+                      wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
+    g = _prep(grad.astype(jnp.float32), rescale_grad, clip_gradient) \
+        + wd * weight32
+    new_mom = momentum * mom + g
+    new_w32 = weight32 - lr * (g + momentum * new_mom)
+    return new_w32.astype(weight.dtype), new_mom, new_w32
+
+
+@register("adam_update", n_out=3)
+def adam_update(weight, grad, mean, var, lr=0.01, beta1=0.9, beta2=0.999,
+                epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                lazy_update=True):
+    g = _prep(grad, rescale_grad, clip_gradient) + wd * weight
+    new_mean = beta1 * mean + (1 - beta1) * g
+    new_var = beta2 * var + (1 - beta2) * jnp.square(g)
+    new_w = weight - lr * new_mean / (jnp.sqrt(new_var) + epsilon)
+    return new_w, new_mean, new_var
+
+
+@register("_adamw_update", aliases=("adamw_update",), n_out=3)
+def _adamw_update(weight, grad, mean, var, rescale_grad, lr=0.01, beta1=0.9,
+                  beta2=0.999, epsilon=1e-8, wd=0.0, eta=1.0,
+                  clip_gradient=-1.0):
+    """AdamW: decoupled weight decay (reference contrib/adamw.cc; tensor
+    rescale_grad input carries the dynamic loss scale)."""
+    g = _prep(grad, rescale_grad, clip_gradient)
+    new_mean = beta1 * mean + (1 - beta1) * g
+    new_var = beta2 * var + (1 - beta2) * jnp.square(g)
+    new_w = weight - eta * (lr * new_mean / (jnp.sqrt(new_var) + epsilon)
+                            + wd * weight)
+    return new_w, new_mean, new_var
+
+
+@register("_mp_adamw_update", aliases=("mp_adamw_update",), n_out=4)
+def _mp_adamw_update(weight, grad, mean, var, weight32, rescale_grad,
+                     lr=0.01, beta1=0.9, beta2=0.999, epsilon=1e-8, wd=0.0,
+                     eta=1.0, clip_gradient=-1.0):
+    g = _prep(grad.astype(jnp.float32), rescale_grad, clip_gradient)
+    new_mean = beta1 * mean + (1 - beta1) * g
+    new_var = beta2 * var + (1 - beta2) * jnp.square(g)
+    new_w32 = weight32 - eta * (lr * new_mean / (jnp.sqrt(new_var) + epsilon)
+                                + wd * weight32)
+    return new_w32.astype(weight.dtype), new_mean, new_var, new_w32
+
+
+@register("ftml_update", n_out=4)
+def ftml_update(weight, grad, d, v, z, lr=0.01, beta1=0.6, beta2=0.999,
+                epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_grad=-1.0,
+                clip_gradient=-1.0, t=1):
+    """FTML (reference optimizer_op.cc FTMLUpdate)."""
+    clip = clip_gradient if clip_gradient is not None and clip_gradient >= 0 \
+        else clip_grad
+    g = _prep(grad, rescale_grad, clip) + wd * weight
+    new_v = beta2 * v + (1 - beta2) * jnp.square(g)
+    t = float(t)
+    denom = 1 - beta1 ** t
+    d_t = denom / lr * (jnp.sqrt(new_v / (1 - beta2 ** t)) + epsilon)
+    sigma = d_t - beta1 * d
+    new_z = beta1 * z + (1 - beta1) * g - sigma * weight
+    new_w = -new_z / d_t
+    return new_w, d_t, new_v, new_z
+
+
+@register("ftrl_update", n_out=3)
+def ftrl_update(weight, grad, z, n, lr=0.1, lamda1=0.01, beta=1.0, wd=0.0,
+                rescale_grad=1.0, clip_gradient=-1.0):
+    """FTRL-proximal (reference optimizer_op.cc FtrlUpdate)."""
+    g = _prep(grad, rescale_grad, clip_gradient)
+    new_n = n + jnp.square(g)
+    sigma = (jnp.sqrt(new_n) - jnp.sqrt(n)) / lr
+    new_z = z + g - sigma * weight
+    new_w = jnp.where(
+        jnp.abs(new_z) <= lamda1, jnp.zeros_like(weight),
+        (jnp.sign(new_z) * lamda1 - new_z)
+        / ((beta + jnp.sqrt(new_n)) / lr + wd))
+    return new_w, new_z, new_n
+
+
+@register("rmsprop_update", n_out=2)
+def rmsprop_update(weight, grad, n, lr=0.01, gamma1=0.95, epsilon=1e-8,
+                   wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                   clip_weights=-1.0):
+    g = _prep(grad, rescale_grad, clip_gradient) + wd * weight
+    new_n = (1 - gamma1) * jnp.square(g) + gamma1 * n
+    new_w = weight - lr * g / jnp.sqrt(new_n + epsilon)
+    if clip_weights is not None and clip_weights > 0:
+        new_w = jnp.clip(new_w, -clip_weights, clip_weights)
+    return new_w, new_n
+
+
+@register("rmspropalex_update", n_out=4)
+def rmspropalex_update(weight, grad, n, g, delta, lr=0.01, gamma1=0.95,
+                       gamma2=0.9, epsilon=1e-8, wd=0.0, rescale_grad=1.0,
+                       clip_gradient=-1.0, clip_weights=-1.0):
+    """RMSProp with Alex Graves' centering (reference rmspropalex)."""
+    gr = _prep(grad, rescale_grad, clip_gradient) + wd * weight
+    new_n = (1 - gamma1) * jnp.square(gr) + gamma1 * n
+    new_g = (1 - gamma1) * gr + gamma1 * g
+    new_delta = gamma2 * delta - lr * gr / jnp.sqrt(
+        new_n - jnp.square(new_g) + epsilon)
+    new_w = weight + new_delta
+    if clip_weights is not None and clip_weights > 0:
+        new_w = jnp.clip(new_w, -clip_weights, clip_weights)
+    return new_w, new_n, new_g, new_delta
+
+
+@register("signsgd_update")
+def signsgd_update(weight, grad, lr=0.01, wd=0.0, rescale_grad=1.0,
+                   clip_gradient=-1.0):
+    g = _prep(grad, rescale_grad, clip_gradient)
+    return weight - lr * (jnp.sign(g) + wd * weight)
+
+
+@register("signum_update", n_out=2)
+def signum_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
+                  rescale_grad=1.0, clip_gradient=-1.0, wd_lh=0.0):
+    g = _prep(grad, rescale_grad, clip_gradient) + wd * weight
+    new_mom = momentum * mom - (1 - momentum) * g
+    new_w = (1 - lr * wd_lh) * weight + lr * jnp.sign(new_mom)
+    return new_w, new_mom
+
+
+@register("_sparse_adagrad_update", aliases=("adagrad_update",), n_out=2)
+def _sparse_adagrad_update(weight, grad, history, lr=0.01, epsilon=1e-7,
+                           wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
+    g = _prep(grad, rescale_grad, clip_gradient)
+    new_hist = history + jnp.square(g)
+    new_w = weight - lr * (g / (jnp.sqrt(new_hist) + epsilon) + wd * weight)
+    return new_w, new_hist
+
+
+@register("multi_lars")
+def multi_lars(lrs, weights_sum_sq, grads_sum_sq, wds, eta=0.001,
+               eps=1e-8, rescale_grad=1.0):
+    """Per-layer LARS coefficients (reference optimizer_op.cc MultiLARS):
+    lr_i * ratio where ratio = eta*||w|| / (||g||*rescale + wd*||w|| + eps)."""
+    w_norm = jnp.sqrt(weights_sum_sq)
+    g_norm = jnp.sqrt(grads_sum_sq) * rescale_grad
+    ratio = jnp.where(
+        (w_norm > 0) & (g_norm > 0),
+        eta * w_norm / (g_norm + wds * w_norm + eps),
+        jnp.ones_like(w_norm))
+    return lrs * ratio
+
+
+def _seq(v, i, default):
+    if v is None:
+        return default
+    try:
+        return float(v[i])
+    except (TypeError, IndexError):
+        return float(v)
+
+
+@register("multi_sgd_update", n_out=-1)
+def multi_sgd_update(*arrays, lrs=None, wds=None, rescale_grad=1.0,
+                     clip_gradient=-1.0, num_weights=1):
+    outs = []
+    for i in range(int(num_weights)):
+        w, g = arrays[2 * i], arrays[2 * i + 1]
+        outs.append(sgd_update.fn(w, g, lr=_seq(lrs, i, 0.01),
+                                  wd=_seq(wds, i, 0.0),
+                                  rescale_grad=rescale_grad,
+                                  clip_gradient=clip_gradient))
+    return tuple(outs)
+
+
+@register("multi_sgd_mom_update", n_out=-1)
+def multi_sgd_mom_update(*arrays, lrs=None, wds=None, momentum=0.0,
+                         rescale_grad=1.0, clip_gradient=-1.0,
+                         num_weights=1):
+    outs = []
+    for i in range(int(num_weights)):
+        w, g, m = arrays[3 * i], arrays[3 * i + 1], arrays[3 * i + 2]
+        new_w, new_m = sgd_mom_update.fn(
+            w, g, m, lr=_seq(lrs, i, 0.01), momentum=momentum,
+            wd=_seq(wds, i, 0.0), rescale_grad=rescale_grad,
+            clip_gradient=clip_gradient)
+        outs.extend([new_w, new_m])
+    return tuple(outs)
+
+
+@register("multi_mp_sgd_update", n_out=-1)
+def multi_mp_sgd_update(*arrays, lrs=None, wds=None, rescale_grad=1.0,
+                        clip_gradient=-1.0, num_weights=1):
+    outs = []
+    for i in range(int(num_weights)):
+        w, g, w32 = arrays[3 * i], arrays[3 * i + 1], arrays[3 * i + 2]
+        new_w, new_w32 = mp_sgd_update.fn(
+            w, g, w32, lr=_seq(lrs, i, 0.01), wd=_seq(wds, i, 0.0),
+            rescale_grad=rescale_grad, clip_gradient=clip_gradient)
+        outs.extend([new_w, new_w32])
+    return tuple(outs)
+
+
+@register("multi_mp_sgd_mom_update", n_out=-1)
+def multi_mp_sgd_mom_update(*arrays, lrs=None, wds=None, momentum=0.0,
+                            rescale_grad=1.0, clip_gradient=-1.0,
+                            num_weights=1):
+    outs = []
+    for i in range(int(num_weights)):
+        w, g, m, w32 = arrays[4 * i:4 * i + 4]
+        new_w, new_m, new_w32 = mp_sgd_mom_update.fn(
+            w, g, m, w32, lr=_seq(lrs, i, 0.01), momentum=momentum,
+            wd=_seq(wds, i, 0.0), rescale_grad=rescale_grad,
+            clip_gradient=clip_gradient)
+        outs.extend([new_w, new_m, new_w32])
+    return tuple(outs)
+
+
+@register("_multi_adamw_update", aliases=("multi_adamw_update",), n_out=-1)
+def _multi_adamw_update(*arrays, lrs=None, wds=None, etas=None, beta1=0.9,
+                        beta2=0.999, epsilon=1e-8, clip_gradient=-1.0,
+                        num_weights=1):
+    rescale = arrays[-1]
+    outs = []
+    for i in range(int(num_weights)):
+        w, g, m, v = arrays[4 * i:4 * i + 4]
+        new_w, new_m, new_v = _adamw_update.fn(
+            w, g, m, v, rescale, lr=_seq(lrs, i, 0.01),
+            beta1=beta1, beta2=beta2, epsilon=epsilon,
+            wd=_seq(wds, i, 0.0), eta=_seq(etas, i, 1.0),
+            clip_gradient=clip_gradient)
+        outs.extend([new_w, new_m, new_v])
+    return tuple(outs)
+
+
+@register("_multi_mp_adamw_update", aliases=("multi_mp_adamw_update",),
+          n_out=-1)
+def _multi_mp_adamw_update(*arrays, lrs=None, wds=None, etas=None, beta1=0.9,
+                           beta2=0.999, epsilon=1e-8, clip_gradient=-1.0,
+                           num_weights=1):
+    rescale = arrays[-1]
+    outs = []
+    for i in range(int(num_weights)):
+        w, g, m, v, w32 = arrays[5 * i:5 * i + 5]
+        new_w, new_m, new_v, new_w32 = _mp_adamw_update.fn(
+            w, g, m, v, w32, rescale, lr=_seq(lrs, i, 0.01),
+            beta1=beta1, beta2=beta2, epsilon=epsilon,
+            wd=_seq(wds, i, 0.0), eta=_seq(etas, i, 1.0),
+            clip_gradient=clip_gradient)
+        outs.extend([new_w, new_m, new_v, new_w32])
+    return tuple(outs)
+
+
+# preloaded_* variants: lrs/wds arrive as tensors instead of attrs
+# (reference contrib/preloaded_multi_sgd.cc) — tensor layout:
+# [w0, g0, (m0,) (w32_0,) ..., lrs, wds]
+def _preloaded(step, mom, mp):
+    def run(*arrays, rescale_grad=1.0, clip_gradient=-1.0, num_weights=1):
+        num_weights = int(num_weights)
+        lrs, wds = arrays[-2], arrays[-1]
+        body = arrays[:-2]
+        outs = []
+        for i in range(num_weights):
+            group = body[step * i:step * (i + 1)]
+            lr, wd = lrs[i], wds[i]
+            if not mom and not mp:
+                outs.append(sgd_update.fn(
+                    group[0], group[1], lr=lr, wd=wd,
+                    rescale_grad=rescale_grad, clip_gradient=clip_gradient))
+            elif mom and not mp:
+                outs.extend(sgd_mom_update.fn(
+                    group[0], group[1], group[2], lr=lr, wd=wd,
+                    rescale_grad=rescale_grad, clip_gradient=clip_gradient))
+            elif not mom and mp:
+                outs.extend(mp_sgd_update.fn(
+                    group[0], group[1], group[2], lr=lr, wd=wd,
+                    rescale_grad=rescale_grad, clip_gradient=clip_gradient))
+            else:
+                outs.extend(mp_sgd_mom_update.fn(
+                    group[0], group[1], group[2], group[3], lr=lr, wd=wd,
+                    rescale_grad=rescale_grad, clip_gradient=clip_gradient))
+        return tuple(outs)
+    return run
+
+
+register("preloaded_multi_sgd_update", n_out=-1)(_preloaded(2, False, False))
+register("preloaded_multi_sgd_mom_update", n_out=-1)(
+    _preloaded(3, True, False))
+register("preloaded_multi_mp_sgd_update", n_out=-1)(
+    _preloaded(3, False, True))
+register("preloaded_multi_mp_sgd_mom_update", n_out=-1)(
+    _preloaded(4, True, True))

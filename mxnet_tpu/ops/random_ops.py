@@ -1,0 +1,217 @@
+"""Random sampling operators.
+
+Role parity: reference ``src/operator/random/sample_op.cc`` (_random_*
+fixed-parameter samplers + *_like variants) and
+``src/operator/random/multisample_op.cc`` (_sample_*: per-row distribution
+parameters). TPU-native: jax.random with keys bound at invoke time
+(state_binders), so replay under the tape and tracing under jit are
+deterministic — the role of the reference's per-op ResourceRequest
+kRandom generator state.
+"""
+from __future__ import annotations
+
+import numpy as _np
+import jax
+import jax.numpy as jnp
+
+from ..base import dtype_np
+from ._common import _bind_key, _RNG, _dt  # noqa: F401
+from .registry import register, register_alias
+
+
+
+
+
+
+
+
+@register("_random_exponential", aliases=("random_exponential",),
+          differentiable=False, state_binders=_RNG)
+def _random_exponential(lam=1.0, shape=None, ctx=None, dtype=None, key=None):
+    return jax.random.exponential(
+        key, tuple(shape or ()), _dt(dtype)) / lam
+
+
+@register("_random_exponential_like", differentiable=False,
+          state_binders=_RNG)
+def _random_exponential_like(data, lam=1.0, key=None):
+    return jax.random.exponential(key, data.shape, data.dtype) / lam
+
+
+@register("_random_gamma", aliases=("random_gamma",), differentiable=False,
+          state_binders=_RNG)
+def _random_gamma(alpha=1.0, beta=1.0, shape=None, ctx=None, dtype=None,
+                  key=None):
+    return jax.random.gamma(key, alpha, tuple(shape or ()), _dt(dtype)) * beta
+
+
+@register("_random_gamma_like", differentiable=False, state_binders=_RNG)
+def _random_gamma_like(data, alpha=1.0, beta=1.0, key=None):
+    return jax.random.gamma(key, alpha, data.shape, data.dtype) * beta
+
+
+@register("_random_poisson", aliases=("random_poisson",),
+          differentiable=False, state_binders=_RNG)
+def _random_poisson(lam=1.0, shape=None, ctx=None, dtype=None, key=None):
+    return jax.random.poisson(key, lam, tuple(shape or ())).astype(_dt(dtype))
+
+
+@register("_random_poisson_like", differentiable=False, state_binders=_RNG)
+def _random_poisson_like(data, lam=1.0, key=None):
+    return jax.random.poisson(key, lam, data.shape).astype(data.dtype)
+
+
+@register("_random_negative_binomial", aliases=("random_negative_binomial",),
+          differentiable=False, state_binders=_RNG)
+def _random_negative_binomial(k=1, p=1.0, shape=None, ctx=None, dtype=None,
+                              key=None):
+    """NB(k, p) = Poisson(Gamma(k, (1-p)/p)) (reference sampler.h)."""
+    kg, kp = jax.random.split(key)
+    lam = jax.random.gamma(kg, float(k), tuple(shape or ())) * ((1 - p) / p)
+    return jax.random.poisson(kp, lam).astype(_dt(dtype))
+
+
+@register("_random_negative_binomial_like", differentiable=False,
+          state_binders=_RNG)
+def _random_negative_binomial_like(data, k=1, p=1.0, key=None):
+    kg, kp = jax.random.split(key)
+    lam = jax.random.gamma(kg, float(k), data.shape) * ((1 - p) / p)
+    return jax.random.poisson(kp, lam).astype(data.dtype)
+
+
+@register("_random_generalized_negative_binomial",
+          aliases=("random_generalized_negative_binomial",),
+          differentiable=False, state_binders=_RNG)
+def _random_gnb(mu=1.0, alpha=1.0, shape=None, ctx=None, dtype=None,
+                key=None):
+    """GNB(mu, alpha): Poisson with Gamma(1/alpha, mu*alpha) rate."""
+    kg, kp = jax.random.split(key)
+    lam = jax.random.gamma(kg, 1.0 / alpha, tuple(shape or ())) * (mu * alpha)
+    return jax.random.poisson(kp, lam).astype(_dt(dtype))
+
+
+@register("_random_generalized_negative_binomial_like",
+          differentiable=False, state_binders=_RNG)
+def _random_gnb_like(data, mu=1.0, alpha=1.0, key=None):
+    kg, kp = jax.random.split(key)
+    lam = jax.random.gamma(kg, 1.0 / alpha, data.shape) * (mu * alpha)
+    return jax.random.poisson(kp, lam).astype(data.dtype)
+
+
+@register("_random_randint", aliases=("random_randint",),
+          differentiable=False, state_binders=_RNG)
+def _random_randint(low=0, high=1, shape=None, ctx=None, dtype=None,
+                    key=None):
+    return jax.random.randint(key, tuple(shape or ()), int(low), int(high),
+                              _dt(dtype, _np.int32))
+
+
+@register("_random_uniform_like", differentiable=False, state_binders=_RNG)
+def _random_uniform_like(data, low=0.0, high=1.0, key=None):
+    return jax.random.uniform(key, data.shape, data.dtype, low, high)
+
+
+@register("_random_normal_like", differentiable=False, state_binders=_RNG)
+def _random_normal_like(data, loc=0.0, scale=1.0, key=None):
+    return loc + scale * jax.random.normal(key, data.shape, data.dtype)
+
+
+register_alias("_random_uniform", "random_uniform", "uniform")
+register_alias("_random_normal", "random_normal", "normal")
+
+
+# ---- _sample_*: per-row distribution parameters (multisample_op.cc) ----
+
+def _row_shape(param, shape):
+    shape = tuple(shape or ())
+    return param.shape + shape
+
+
+@register("_sample_exponential", differentiable=False, state_binders=_RNG)
+def _sample_exponential(lam, shape=None, dtype=None, key=None):
+    out = jax.random.exponential(key, _row_shape(lam, shape), _dt(dtype))
+    return out / lam.reshape(lam.shape + (1,) * (out.ndim - lam.ndim))
+
+
+@register("_sample_gamma", differentiable=False, state_binders=_RNG)
+def _sample_gamma(alpha, beta, shape=None, dtype=None, key=None):
+    a = alpha.reshape(alpha.shape + (1,) * len(tuple(shape or ())))
+    out = jax.random.gamma(key, a, _row_shape(alpha, shape), _dt(dtype))
+    return out * beta.reshape(beta.shape + (1,) * (out.ndim - beta.ndim))
+
+
+@register("_sample_poisson", differentiable=False, state_binders=_RNG)
+def _sample_poisson(lam, shape=None, dtype=None, key=None):
+    l = lam.reshape(lam.shape + (1,) * len(tuple(shape or ())))
+    return jax.random.poisson(key, l, _row_shape(lam, shape)).astype(
+        _dt(dtype))
+
+
+@register("_sample_negative_binomial", differentiable=False,
+          state_binders=_RNG)
+def _sample_negative_binomial(k, p, shape=None, dtype=None, key=None):
+    kg, kp = jax.random.split(key)
+    ext = (1,) * len(tuple(shape or ()))
+    kk = k.reshape(k.shape + ext).astype(jnp.float32)
+    pp = p.reshape(p.shape + ext)
+    lam = jax.random.gamma(kg, kk, _row_shape(k, shape)) * ((1 - pp) / pp)
+    return jax.random.poisson(kp, lam).astype(_dt(dtype))
+
+
+@register("_sample_generalized_negative_binomial", differentiable=False,
+          state_binders=_RNG)
+def _sample_gnb(mu, alpha, shape=None, dtype=None, key=None):
+    kg, kp = jax.random.split(key)
+    ext = (1,) * len(tuple(shape or ()))
+    m = mu.reshape(mu.shape + ext)
+    a = alpha.reshape(alpha.shape + ext)
+    lam = jax.random.gamma(kg, 1.0 / a, _row_shape(mu, shape)) * (m * a)
+    return jax.random.poisson(kp, lam).astype(_dt(dtype))
+
+
+@register("_sample_multinomial", aliases=("sample_multinomial",),
+          differentiable=False, state_binders=_RNG)
+def _sample_multinomial(data, shape=None, get_prob=False, dtype=None,
+                        key=None):
+    """Categorical sampling from probability rows (reference
+    sample_multinomial_op.cc). shape = number of draws per row."""
+    n = 1
+    if shape:
+        n = int(shape[0] if isinstance(shape, (list, tuple)) else shape)
+    logits = jnp.log(jnp.maximum(data, 1e-30))
+    batch = data.shape[:-1]
+    # jax.random.categorical wants the batch shape trailing in `shape`
+    out = jax.random.categorical(key, logits, axis=-1, shape=(n,) + batch)
+    out = jnp.moveaxis(out, 0, -1)          # -> batch + (n,)
+    if not shape:
+        out = out.reshape(batch)
+    out = out.astype(_dt(dtype, _np.int32))
+    if get_prob:
+        idx = out.reshape(batch + (n,)).astype(jnp.int64)
+        p = jnp.take_along_axis(logits, idx, axis=-1)
+        if not shape:
+            p = p.reshape(batch)
+        return out, p
+    return out
+
+
+@register("_sample_unique_zipfian", differentiable=False, n_out=2,
+          state_binders=_RNG)
+def _sample_unique_zipfian(range_max=1, shape=None, key=None):
+    """Approximate unique zipfian sampling (reference
+    sample_op.cc SampleUniqueZipfian — used by contrib sparse embedding
+    negative sampling). Draws with log-uniform (zipf-like) distribution,
+    deduplicates per row."""
+    shape = tuple(shape or (1,))
+    u = jax.random.uniform(key, shape)
+    draws = jnp.exp(u * jnp.log(float(range_max))).astype(jnp.int64) % \
+        int(range_max)
+    # count of unique draws per row (trials actually used)
+    def row_unique(row):
+        srt = jnp.sort(row)
+        uniq = jnp.concatenate([jnp.array([1], srt.dtype),
+                                (srt[1:] != srt[:-1]).astype(srt.dtype)])
+        return uniq.sum()
+    counts = jax.vmap(row_unique)(draws.reshape(-1, shape[-1]))
+    return draws, counts.reshape(shape[:-1] + (1,) if len(shape) > 1
+                                 else (1,)).astype(jnp.int64)

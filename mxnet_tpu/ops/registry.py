@@ -78,6 +78,15 @@ def get_op(name: str) -> Optional[Op]:
     return _OP_REGISTRY.get(name)
 
 
+def register_alias(existing: str, *names: str):
+    """Expose an already-registered op under additional names (the
+    reference's .add_alias, e.g. `_npi_add` -> add)."""
+    op = _OP_REGISTRY[existing]
+    for n in names:
+        _OP_REGISTRY[n] = op
+    return op
+
+
 def list_ops():
     """Parity with MXListAllOpNames (reference `src/c_api/c_api.cc`)."""
     return sorted(_OP_REGISTRY.keys())

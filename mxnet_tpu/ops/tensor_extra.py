@@ -1,0 +1,361 @@
+"""Tensor-op breadth: scalar/logical variants, creation, indexing/assign,
+misc shape ops.
+
+Role parity: the remaining registrations of reference
+``src/operator/tensor/`` (elemwise_binary_scalar_op_*.cc, init_op.cc,
+matrix_op.cc slice-assign family, ravel.cc, histogram.cc, shuffle_op.cc,
+square_sum.cc, elemwise_sum.cc) — each a one-liner onto jax.numpy/lax with
+XLA supplying kernels and fusion.
+"""
+from __future__ import annotations
+
+import numpy as _np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import dtype_np
+from ._common import _bind_key, _RNG, _dt  # noqa: F401
+from .registry import register, register_alias, get_op
+
+# ----------------------------------------------------- scalar comparisons
+# (reference elemwise_binary_scalar_op_logic.cc — result keeps input dtype)
+
+
+@register("_equal_scalar", aliases=("_EqualScalar",))
+def _equal_scalar(data, scalar=0.0):
+    return (data == scalar).astype(data.dtype)
+
+
+@register("_not_equal_scalar", aliases=("_NotEqualScalar",))
+def _not_equal_scalar(data, scalar=0.0):
+    return (data != scalar).astype(data.dtype)
+
+
+@register("_greater_scalar", aliases=("_GreaterScalar",))
+def _greater_scalar(data, scalar=0.0):
+    return (data > scalar).astype(data.dtype)
+
+
+@register("_greater_equal_scalar", aliases=("_GreaterEqualScalar",))
+def _greater_equal_scalar(data, scalar=0.0):
+    return (data >= scalar).astype(data.dtype)
+
+
+@register("_lesser_scalar", aliases=("_LesserScalar",))
+def _lesser_scalar(data, scalar=0.0):
+    return (data < scalar).astype(data.dtype)
+
+
+@register("_lesser_equal_scalar", aliases=("_LesserEqualScalar",))
+def _lesser_equal_scalar(data, scalar=0.0):
+    return (data <= scalar).astype(data.dtype)
+
+
+@register("_maximum_scalar", aliases=("_MaximumScalar",))
+def _maximum_scalar(data, scalar=0.0):
+    return jnp.maximum(data, scalar)
+
+
+@register("_minimum_scalar", aliases=("_MinimumScalar",))
+def _minimum_scalar(data, scalar=0.0):
+    return jnp.minimum(data, scalar)
+
+
+@register("_mod_scalar", aliases=("_ModScalar",))
+def _mod_scalar(data, scalar=1.0):
+    return jnp.mod(data, jnp.asarray(scalar, data.dtype))
+
+
+@register("_rmod_scalar", aliases=("_RModScalar",))
+def _rmod_scalar(data, scalar=1.0):
+    return jnp.mod(jnp.asarray(scalar, data.dtype), data)
+
+
+@register("_hypot_scalar", aliases=("_HypotScalar",))
+def _hypot_scalar(data, scalar=0.0):
+    return jnp.hypot(data, jnp.asarray(scalar, data.dtype))
+
+
+@register("_logical_and_scalar", aliases=("_LogicalAndScalar",))
+def _logical_and_scalar(data, scalar=1.0):
+    return jnp.logical_and(data, scalar).astype(data.dtype)
+
+
+@register("_logical_or_scalar", aliases=("_LogicalOrScalar",))
+def _logical_or_scalar(data, scalar=1.0):
+    return jnp.logical_or(data, scalar).astype(data.dtype)
+
+
+@register("_logical_xor_scalar", aliases=("_LogicalXorScalar",))
+def _logical_xor_scalar(data, scalar=1.0):
+    return jnp.logical_xor(data, scalar).astype(data.dtype)
+
+
+@register("_logical_and", aliases=("_Logical_And",))
+def _logical_and(lhs, rhs):
+    return jnp.logical_and(lhs, rhs).astype(lhs.dtype)
+
+
+@register("_logical_or", aliases=("_Logical_Or",))
+def _logical_or(lhs, rhs):
+    return jnp.logical_or(lhs, rhs).astype(lhs.dtype)
+
+
+@register("_logical_xor", aliases=("_Logical_Xor",))
+def _logical_xor(lhs, rhs):
+    return jnp.logical_xor(lhs, rhs).astype(lhs.dtype)
+
+
+# CamelCase legacy registrations of existing scalar/binary ops
+# (reference registers both spellings, e.g. _PlusScalar/_plus_scalar)
+register_alias("_plus_scalar", "_PlusScalar")
+register_alias("_minus_scalar", "_MinusScalar")
+register_alias("_rminus_scalar", "_RMinusScalar")
+register_alias("_mul_scalar", "_MulScalar")
+register_alias("_div_scalar", "_DivScalar")
+register_alias("_rdiv_scalar", "_RDivScalar")
+register_alias("_power_scalar", "_PowerScalar")
+register_alias("_rpower_scalar", "_RPowerScalar")
+register_alias("hypot", "_hypot", "_Hypot")
+register_alias("mod", "_mod", "_Mod")
+register_alias("lesser", "less")
+register_alias("lesser_equal", "less_equal")
+register_alias("add", "_grad_add")
+register_alias("pick", "choose_element_0index")
+
+# ------------------------------------------------------------- creation
+# (reference src/operator/tensor/init_op.cc)
+
+
+
+
+@register("_arange", aliases=("_contrib_arange",))
+def _arange(start=0.0, stop=None, step=1.0, repeat=1, infer_range=False,
+            ctx=None, dtype=None):
+    out = jnp.arange(start, stop, step, dtype=_dt(dtype))
+    if repeat != 1:
+        out = jnp.repeat(out, repeat)
+    return out
+
+
+@register("_linspace")
+def _linspace(start=0.0, stop=1.0, num=50, endpoint=True, ctx=None,
+              dtype=None):
+    return jnp.linspace(start, stop, int(num), endpoint=endpoint,
+                        dtype=_dt(dtype))
+
+
+@register("_eye")
+def _eye(N=0, M=0, k=0, ctx=None, dtype=None):
+    return jnp.eye(int(N), int(M) if M else None, k=int(k), dtype=_dt(dtype))
+
+
+@register("_full")
+def _full(shape=None, value=0.0, ctx=None, dtype=None):
+    return jnp.full(tuple(shape), value, dtype=_dt(dtype))
+
+
+@register("_ones")
+def _ones(shape=None, ctx=None, dtype=None):
+    return jnp.ones(tuple(shape), dtype=_dt(dtype))
+
+
+@register("_zeros", aliases=("_zeros_without_dtype",))
+def _zeros(shape=None, ctx=None, dtype=None):
+    return jnp.zeros(tuple(shape), dtype=_dt(dtype))
+
+
+@register("_histogram", n_out=2, differentiable=False)
+def _histogram(data, bins=10, range=None, bin_cnt=None):
+    if hasattr(bins, "shape") and getattr(bins, "ndim", 0) >= 1:
+        hist, edges = jnp.histogram(data, bins=bins)
+    else:
+        hist, edges = jnp.histogram(
+            data, bins=int(bin_cnt or bins),
+            range=tuple(range) if range is not None else None)
+    return hist, edges
+
+
+
+
+@register("_shuffle", aliases=("shuffle",), differentiable=False,
+          state_binders={"key": _bind_key})
+def _shuffle(data, key=None):
+    """Random first-axis permutation (reference shuffle_op.cc)."""
+    return jax.random.permutation(key, data, axis=0)
+
+
+# ------------------------------------------------- indexing / assignment
+# (reference matrix_op.cc slice-assign family, ravel.cc)
+
+
+@register("_ravel_multi_index", aliases=("ravel_multi_index",))
+def _ravel_multi_index(data, shape=None):
+    """data: (ndim, N) multi-indices -> (N,) flat indices."""
+    idx = tuple(data[i].astype(jnp.int64) for i in range(len(shape)))
+    return jnp.ravel_multi_index(idx, tuple(int(s) for s in shape),
+                                 mode="clip").astype(data.dtype)
+
+
+@register("_unravel_index", aliases=("unravel_index",))
+def _unravel_index(data, shape=None):
+    """data: (N,) flat indices -> (ndim, N) multi-indices."""
+    parts = jnp.unravel_index(data.astype(jnp.int64),
+                              tuple(int(s) for s in shape))
+    return jnp.stack([p.astype(data.dtype) for p in parts], axis=0)
+
+
+def _slice_tuple(shape, begin, end, step=None):
+    ndim = len(shape)
+    step = step or [None] * ndim
+    sl = []
+    for i in range(ndim):
+        b = begin[i] if i < len(begin) else None
+        e = end[i] if i < len(end) else None
+        s = step[i] if i < len(step) else None
+        sl.append(slice(b, e, s))
+    return tuple(sl)
+
+
+@register("_slice_assign", aliases=("_crop_assign",))
+def _slice_assign(lhs, rhs, begin=(), end=(), step=None):
+    return lhs.at[_slice_tuple(lhs.shape, begin, end, step)].set(rhs)
+
+
+@register("_slice_assign_scalar", aliases=("_crop_assign_scalar",))
+def _slice_assign_scalar(data, scalar=0.0, begin=(), end=(), step=None):
+    return data.at[_slice_tuple(data.shape, begin, end, step)].set(
+        jnp.asarray(scalar, data.dtype))
+
+
+@register("_scatter_set_nd")
+def _scatter_set_nd(lhs, rhs, indices, shape=None):
+    """Write rhs into a copy of lhs at gather_nd-style indices
+    (reference indexing_op.cc _scatter_set_nd)."""
+    idx = tuple(indices[i].astype(jnp.int64) for i in range(indices.shape[0]))
+    return lhs.at[idx].set(rhs)
+
+
+@register("_identity_with_attr_like_rhs")
+def _identity_with_attr_like_rhs(lhs, rhs):
+    return lhs
+
+
+@register("broadcast_like")
+def broadcast_like(lhs, rhs, lhs_axes=None, rhs_axes=None):
+    if lhs_axes is None:
+        return jnp.broadcast_to(lhs, rhs.shape)
+    out_shape = list(lhs.shape)
+    for la, ra in zip(lhs_axes, rhs_axes):
+        out_shape[int(la)] = rhs.shape[int(ra)]
+    return jnp.broadcast_to(lhs, tuple(out_shape))
+
+
+@register("reshape_like")
+def reshape_like(lhs, rhs, lhs_begin=None, lhs_end=None, rhs_begin=None,
+                 rhs_end=None):
+    if lhs_begin is None and rhs_begin is None:
+        return jnp.reshape(lhs, rhs.shape)
+
+    def _ax(v, ndim, default):
+        if v is None:
+            return default
+        v = int(v)
+        return v + ndim if v < 0 else v  # MXNet adds ndim: -1 == last axis
+
+    lb = _ax(lhs_begin, lhs.ndim, 0)
+    le = _ax(lhs_end, lhs.ndim, lhs.ndim)
+    rb = _ax(rhs_begin, rhs.ndim, 0)
+    re = _ax(rhs_end, rhs.ndim, rhs.ndim)
+    new_shape = lhs.shape[:lb] + rhs.shape[rb:re] + lhs.shape[le:]
+    return jnp.reshape(lhs, new_shape)
+
+
+@register("_split_v2", n_out=-1)
+def _split_v2(data, indices=(), axis=1, squeeze_axis=False, sections=0):
+    """split_v2 (reference matrix_op.cc:1061): by section count or split
+    indices."""
+    if sections and sections > 0:
+        parts = jnp.split(data, int(sections), axis=int(axis))
+    else:
+        parts = jnp.split(data, [int(i) for i in indices], axis=int(axis))
+    if squeeze_axis:
+        parts = [jnp.squeeze(p, axis=int(axis)) for p in parts]
+    return tuple(parts)
+
+
+@register("argmax_channel")
+def argmax_channel(data):
+    """argmax along the trailing axis, one index per leading row
+    (reference broadcast_reduce_op_index.cc:82)."""
+    return jnp.argmax(data, axis=-1).astype(data.dtype)
+
+
+@register("add_n", aliases=("ElementWiseSum", "_element_wise_sum"))
+def add_n(*args):
+    out = args[0]
+    for a in args[1:]:
+        out = out + a
+    return out
+
+
+@register("moments", n_out=2)
+def moments(data, axes=None, keepdims=False):
+    axes = tuple(axes) if axes is not None else None
+    mean = jnp.mean(data, axis=axes, keepdims=True)
+    var = jnp.mean(jnp.square(data - mean), axis=axes, keepdims=keepdims)
+    if not keepdims:
+        mean = jnp.reshape(mean, var.shape)
+    return mean, var
+
+
+@register("_square_sum", aliases=("square_sum",))
+def _square_sum(data, axis=None, keepdims=False):
+    return jnp.sum(jnp.square(data),
+                   axis=tuple(axis) if isinstance(axis, (list, tuple))
+                   else axis, keepdims=keepdims)
+
+
+@register("cast_storage")
+def cast_storage(data, stype=None):
+    """Storage casts are identity on TPU: XLA has one dense layout engine
+    (reference cast_storage-inl.h; sparse API docs in ndarray/sparse.py)."""
+    return data
+
+
+@register("_sparse_retain", aliases=("sparse_retain",))
+def _sparse_retain(data, indices):
+    """Keep only the given rows, zeroing the rest (row_sparse retain,
+    reference sparse_retain-inl.h, dense result)."""
+    mask = jnp.zeros((data.shape[0],), dtype=bool)
+    mask = mask.at[indices.astype(jnp.int64)].set(True)
+    return jnp.where(mask.reshape((-1,) + (1,) * (data.ndim - 1)), data, 0)
+
+
+@register("all_finite")
+def all_finite(data, init_output=True):
+    return jnp.all(jnp.isfinite(data)).reshape((1,))
+
+
+@register("multi_all_finite")
+def multi_all_finite(*arrays, num_arrays=1, init_output=True):
+    ok = jnp.array(True)
+    for a in arrays:
+        ok = jnp.logical_and(ok, jnp.all(jnp.isfinite(a)))
+    return ok.reshape((1,))
+
+
+@register("multi_sum_sq", n_out=-1)
+def multi_sum_sq(*arrays, num_arrays=1):
+    return tuple(jnp.sum(jnp.square(a)).reshape(()) for a in arrays)
+
+
+@register("reset_arrays", n_out=-1)
+def reset_arrays(*arrays, num_arrays=1):
+    return tuple(jnp.zeros_like(a) for a in arrays)
+
+
+@register("_rnn_param_concat")
+def _rnn_param_concat(*args, dim=0, num_args=None):
+    return jnp.concatenate(args, axis=int(dim))

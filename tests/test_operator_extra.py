@@ -1,0 +1,541 @@
+"""Oracle + gradient tests for the expanded op corpus (reference
+src/operator/tensor, optimizer_op.cc, random/, la_op.cc, image/,
+numpy/ registrations; test strategy mirrors
+tests/python/unittest/test_operator.py table-driven oracle checks)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd as ag
+
+R = np.random.RandomState(7)
+
+
+def A(*shape, dtype=np.float32, scale=1.0, pos=False):
+    x = R.randn(*shape).astype(dtype) * scale
+    return np.abs(x) + 0.5 if pos else x
+
+
+def check(op_name, np_fn, arrays, rtol=1e-5, atol=1e-6, **kwargs):
+    op = getattr(nd, op_name)
+    out = op(*[nd.array(a) for a in arrays], **kwargs)
+    expect = np_fn(*arrays)
+    np.testing.assert_allclose(out.asnumpy(), expect, rtol=rtol, atol=atol,
+                               err_msg=op_name)
+
+
+# ---------------------------------------------------------------- scalars
+
+SCALAR_CASES = [
+    ("_equal_scalar", lambda x: (x == 0.5).astype(x.dtype), {"scalar": 0.5}),
+    ("_not_equal_scalar", lambda x: (x != 0.5).astype(x.dtype),
+     {"scalar": 0.5}),
+    ("_greater_scalar", lambda x: (x > 0.1).astype(x.dtype),
+     {"scalar": 0.1}),
+    ("_greater_equal_scalar", lambda x: (x >= 0.1).astype(x.dtype),
+     {"scalar": 0.1}),
+    ("_lesser_scalar", lambda x: (x < 0.1).astype(x.dtype), {"scalar": 0.1}),
+    ("_lesser_equal_scalar", lambda x: (x <= 0.1).astype(x.dtype),
+     {"scalar": 0.1}),
+    ("_maximum_scalar", lambda x: np.maximum(x, 0.2), {"scalar": 0.2}),
+    ("_minimum_scalar", lambda x: np.minimum(x, 0.2), {"scalar": 0.2}),
+    ("_mod_scalar", lambda x: np.mod(x, 1.5), {"scalar": 1.5}),
+    ("_rmod_scalar", lambda x: np.mod(np.float32(1.5), x), {"scalar": 1.5}),
+    ("_hypot_scalar", lambda x: np.hypot(x, 2.0), {"scalar": 2.0}),
+]
+
+
+@pytest.mark.parametrize("name,fn,kw", SCALAR_CASES,
+                         ids=[c[0] for c in SCALAR_CASES])
+def test_scalar_ops(name, fn, kw):
+    check(name, fn, [A(3, 4)], **kw)
+
+
+def test_logical_binary():
+    x, y = A(4), A(4)
+    check("_logical_and",
+          lambda a, b: np.logical_and(a, b).astype(a.dtype), [x, y])
+    check("_logical_or",
+          lambda a, b: np.logical_or(a, b).astype(a.dtype), [x, y])
+    check("_logical_xor",
+          lambda a, b: np.logical_xor(a, b).astype(a.dtype), [x, y])
+
+
+def test_camelcase_aliases_resolve():
+    for name in ["_PlusScalar", "_MulScalar", "_DivScalar", "_PowerScalar",
+                 "_MaximumScalar", "_EqualScalar", "_Hypot", "_Mod",
+                 "less", "less_equal"]:
+        assert mx.ops.get_op(name) is not None, name
+
+
+# --------------------------------------------------------------- creation
+
+def test_creation_ops():
+    np.testing.assert_allclose(
+        nd._arange(stop=10.0, step=2.0).asnumpy(), np.arange(0, 10, 2,
+                                                             np.float32))
+    np.testing.assert_allclose(
+        nd._linspace(0.0, 1.0, num=5).asnumpy(),
+        np.linspace(0, 1, 5, dtype=np.float32))
+    np.testing.assert_allclose(nd._eye(N=3, k=1).asnumpy(),
+                               np.eye(3, k=1, dtype=np.float32))
+    np.testing.assert_allclose(nd._full(shape=(2, 2), value=7).asnumpy(),
+                               np.full((2, 2), 7, np.float32))
+    assert nd._zeros(shape=(3,)).asnumpy().sum() == 0
+    assert nd._ones(shape=(3,)).asnumpy().sum() == 3
+
+
+def test_histogram():
+    x = A(100)
+    counts, edges = nd._histogram(nd.array(x), bin_cnt=10, range=(-3, 3))
+    ec, ee = np.histogram(x, bins=10, range=(-3, 3))
+    np.testing.assert_array_equal(counts.asnumpy(), ec)
+    np.testing.assert_allclose(edges.asnumpy(), ee, rtol=1e-6, atol=1e-6)
+
+
+def test_shuffle_is_permutation():
+    x = np.arange(32, dtype=np.float32)
+    out = nd._shuffle(nd.array(x)).asnumpy()
+    np.testing.assert_array_equal(np.sort(out), x)
+
+
+# --------------------------------------------------------------- indexing
+
+def test_ravel_unravel():
+    shape = (4, 5, 6)
+    multi = np.stack([R.randint(0, s, 10) for s in shape]).astype(np.float32)
+    flat = nd._ravel_multi_index(nd.array(multi), shape=shape)
+    expect = np.ravel_multi_index(multi.astype(np.int64), shape)
+    np.testing.assert_array_equal(flat.asnumpy().astype(np.int64), expect)
+    back = nd._unravel_index(flat, shape=shape)
+    np.testing.assert_array_equal(back.asnumpy(), multi)
+
+
+def test_slice_assign():
+    x = np.zeros((4, 4), np.float32)
+    rhs = np.ones((2, 2), np.float32)
+    out = nd._slice_assign(nd.array(x), nd.array(rhs), begin=(1, 1),
+                           end=(3, 3))
+    expect = x.copy()
+    expect[1:3, 1:3] = rhs
+    np.testing.assert_array_equal(out.asnumpy(), expect)
+    out2 = nd._slice_assign_scalar(nd.array(x), scalar=5.0, begin=(0, 2),
+                                   end=(2, 4))
+    expect2 = x.copy()
+    expect2[0:2, 2:4] = 5.0
+    np.testing.assert_array_equal(out2.asnumpy(), expect2)
+
+
+def test_scatter_set_nd():
+    x = np.zeros((3, 3), np.float32)
+    indices = np.array([[0, 2], [1, 0]], np.float32)  # rows: dim coords
+    rhs = np.array([9.0, 8.0], np.float32)
+    out = nd._scatter_set_nd(nd.array(x), nd.array(rhs), nd.array(indices),
+                             shape=(3, 3))
+    expect = x.copy()
+    expect[0, 1] = 9.0
+    expect[2, 0] = 8.0
+    np.testing.assert_array_equal(out.asnumpy(), expect)
+
+
+def test_broadcast_reshape_like():
+    x = A(1, 4)
+    y = A(3, 4)
+    np.testing.assert_array_equal(
+        nd.broadcast_like(nd.array(x), nd.array(y)).asnumpy(),
+        np.broadcast_to(x, y.shape))
+    z = A(12)
+    np.testing.assert_array_equal(
+        nd.reshape_like(nd.array(z), nd.array(y)).asnumpy(),
+        z.reshape(3, 4))
+
+
+def test_reshape_like_negative_axes():
+    """MXNet adds ndim to negative begin/end: -1 is the LAST axis."""
+    x = A(2, 3, 4)
+    y = A(2, 3, 2, 2)
+    out = nd.reshape_like(nd.array(x), nd.array(y), lhs_begin=-1,
+                          rhs_begin=-2)
+    assert out.shape == (2, 3, 2, 2)
+    np.testing.assert_array_equal(out.asnumpy(), x.reshape(2, 3, 2, 2))
+
+
+def test_image_crop_batched_ranks():
+    img5 = A(2, 2, 8, 8, 3)  # (T, N, H, W, C)
+    out = nd._image_crop(nd.array(img5), x=1, y=2, width=3, height=4)
+    np.testing.assert_array_equal(out.asnumpy(), img5[:, :, 2:6, 1:4, :])
+
+
+def test_split_v2():
+    x = A(4, 6)
+    parts = nd._split_v2(nd.array(x), sections=3, axis=1)
+    expect = np.split(x, 3, axis=1)
+    for p, e in zip(parts, expect):
+        np.testing.assert_array_equal(p.asnumpy(), e)
+    parts2 = nd._split_v2(nd.array(x), indices=(1, 3), axis=1)
+    expect2 = np.split(x, [1, 3], axis=1)
+    for p, e in zip(parts2, expect2):
+        np.testing.assert_array_equal(p.asnumpy(), e)
+
+
+def test_add_n_moments_square_sum():
+    xs = [A(3, 3) for _ in range(4)]
+    np.testing.assert_allclose(
+        nd.add_n(*[nd.array(x) for x in xs]).asnumpy(), sum(xs), rtol=1e-6)
+    x = A(2, 5)
+    m, v = nd.moments(nd.array(x), axes=(1,))
+    np.testing.assert_allclose(m.asnumpy(), x.mean(axis=1), rtol=1e-5)
+    np.testing.assert_allclose(v.asnumpy(), x.var(axis=1), rtol=1e-5)
+    np.testing.assert_allclose(nd._square_sum(nd.array(x)).asnumpy(),
+                               (x ** 2).sum(), rtol=1e-5)
+
+
+def test_sparse_retain_dense():
+    x = A(5, 3)
+    idx = np.array([0, 3], np.float32)
+    out = nd._sparse_retain(nd.array(x), nd.array(idx)).asnumpy()
+    expect = np.zeros_like(x)
+    expect[[0, 3]] = x[[0, 3]]
+    np.testing.assert_array_equal(out, expect)
+
+
+def test_all_finite():
+    assert nd.all_finite(nd.ones((3,))).asnumpy()[0] == 1
+    bad = nd.array(np.array([1.0, np.inf], np.float32))
+    assert nd.all_finite(bad).asnumpy()[0] == 0
+    assert nd.multi_all_finite(nd.ones((2,)), bad,
+                               num_arrays=2).asnumpy()[0] == 0
+
+
+# ----------------------------------------------------------- optimizer ops
+
+def test_sgd_update_matches_formula():
+    w, g = A(5), A(5)
+    out = nd.sgd_update(nd.array(w), nd.array(g), lr=0.1, wd=0.01,
+                        rescale_grad=0.5)
+    expect = w - 0.1 * (0.5 * g + 0.01 * w)
+    np.testing.assert_allclose(out.asnumpy(), expect, rtol=1e-5)
+
+
+def test_sgd_mom_update():
+    w, g, m = A(5), A(5), A(5)
+    new_w, new_m = nd.sgd_mom_update(nd.array(w), nd.array(g), nd.array(m),
+                                     lr=0.1, momentum=0.9)
+    em = 0.9 * m - 0.1 * g
+    np.testing.assert_allclose(new_m.asnumpy(), em, rtol=1e-5)
+    np.testing.assert_allclose(new_w.asnumpy(), w + em, rtol=1e-5)
+
+
+def test_adam_update():
+    w, g = A(6), A(6)
+    m, v = np.zeros(6, np.float32), np.zeros(6, np.float32)
+    new_w, new_m, new_v = nd.adam_update(
+        nd.array(w), nd.array(g), nd.array(m), nd.array(v), lr=0.01)
+    em = 0.1 * g
+    ev = 0.001 * g * g
+    np.testing.assert_allclose(new_m.asnumpy(), em, rtol=1e-5)
+    np.testing.assert_allclose(new_v.asnumpy(), ev, rtol=1e-4)
+    np.testing.assert_allclose(
+        new_w.asnumpy(), w - 0.01 * em / (np.sqrt(ev) + 1e-8), rtol=1e-5)
+
+
+def test_ftrl_update():
+    w, g = A(4), A(4)
+    z, n = np.zeros(4, np.float32), np.zeros(4, np.float32)
+    new_w, new_z, new_n = nd.ftrl_update(
+        nd.array(w), nd.array(g), nd.array(z), nd.array(n),
+        lr=0.1, lamda1=0.01, beta=1.0)
+    en = g * g
+    sigma = np.sqrt(en) / 0.1
+    ez = g - sigma * w
+    np.testing.assert_allclose(new_n.asnumpy(), en, rtol=1e-5)
+    np.testing.assert_allclose(new_z.asnumpy(), ez, rtol=1e-4, atol=1e-6)
+    expect_w = np.where(np.abs(ez) <= 0.01, 0.0,
+                        (np.sign(ez) * 0.01 - ez)
+                        / ((1.0 + np.sqrt(en)) / 0.1))
+    np.testing.assert_allclose(new_w.asnumpy(), expect_w, rtol=1e-4,
+                               atol=1e-6)
+
+
+def test_mp_sgd_keeps_fp32_master():
+    w = A(5).astype(np.float16)
+    w32 = w.astype(np.float32)
+    g = A(5).astype(np.float16)
+    new_w, new_w32 = nd.mp_sgd_update(
+        nd.array(w, dtype=np.float16), nd.array(g, dtype=np.float16),
+        nd.array(w32), lr=0.1)
+    assert new_w.dtype == np.float16
+    assert new_w32.dtype == np.float32
+    np.testing.assert_allclose(new_w32.asnumpy(),
+                               w32 - 0.1 * g.astype(np.float32), rtol=1e-3)
+
+
+def test_multi_sgd_update():
+    ws = [A(3), A(4)]
+    gs = [A(3), A(4)]
+    outs = nd.multi_sgd_update(nd.array(ws[0]), nd.array(gs[0]),
+                               nd.array(ws[1]), nd.array(gs[1]),
+                               lrs=(0.1, 0.2), wds=(0.0, 0.0),
+                               num_weights=2)
+    np.testing.assert_allclose(outs[0].asnumpy(), ws[0] - 0.1 * gs[0],
+                               rtol=1e-5)
+    np.testing.assert_allclose(outs[1].asnumpy(), ws[1] - 0.2 * gs[1],
+                               rtol=1e-5)
+
+
+def test_signsgd_rmsprop_signum():
+    w, g = A(5), A(5)
+    out = nd.signsgd_update(nd.array(w), nd.array(g), lr=0.1)
+    np.testing.assert_allclose(out.asnumpy(), w - 0.1 * np.sign(g),
+                               rtol=1e-6)
+    n = np.zeros(5, np.float32)
+    new_w, new_n = nd.rmsprop_update(nd.array(w), nd.array(g), nd.array(n),
+                                     lr=0.1, gamma1=0.9, epsilon=1e-8)
+    en = 0.1 * g * g
+    np.testing.assert_allclose(new_n.asnumpy(), en, rtol=1e-4)
+    np.testing.assert_allclose(new_w.asnumpy(),
+                               w - 0.1 * g / np.sqrt(en + 1e-8), rtol=1e-4)
+
+
+def test_multi_lars():
+    lrs = np.array([0.1, 0.2], np.float32)
+    wss = np.array([4.0, 0.0], np.float32)
+    gss = np.array([1.0, 1.0], np.float32)
+    wds = np.array([0.0, 0.0], np.float32)
+    out = nd.multi_lars(nd.array(lrs), nd.array(wss), nd.array(gss),
+                        nd.array(wds), eta=0.01, eps=0.0)
+    np.testing.assert_allclose(out.asnumpy()[0], 0.1 * 0.01 * 2.0 / 1.0,
+                               rtol=1e-5)
+    np.testing.assert_allclose(out.asnumpy()[1], 0.2, rtol=1e-5)
+
+
+# --------------------------------------------------------------- random ops
+
+def test_random_samplers_shapes_and_stats():
+    out = nd._random_exponential(lam=2.0, shape=(2000,))
+    assert out.shape == (2000,)
+    assert abs(float(out.asnumpy().mean()) - 0.5) < 0.1
+    out = nd._random_gamma(alpha=3.0, beta=2.0, shape=(2000,))
+    assert abs(float(out.asnumpy().mean()) - 6.0) < 0.5
+    out = nd._random_poisson(lam=4.0, shape=(2000,))
+    assert abs(float(out.asnumpy().mean()) - 4.0) < 0.3
+    out = nd._random_randint(low=0, high=10, shape=(500,))
+    a = out.asnumpy()
+    assert a.min() >= 0 and a.max() < 10
+    x = nd.ones((100,))
+    like = nd._random_normal_like(x, loc=1.0, scale=0.1)
+    assert like.shape == (100,)
+    assert abs(float(like.asnumpy().mean()) - 1.0) < 0.1
+
+
+def test_sample_per_row_params():
+    lam = nd.array(np.array([1.0, 10.0], np.float32))
+    out = nd._sample_poisson(lam, shape=(1000,))
+    assert out.shape == (2, 1000)
+    m = out.asnumpy().mean(axis=1)
+    assert abs(m[0] - 1.0) < 0.3 and abs(m[1] - 10.0) < 1.0
+
+
+def test_sample_multinomial():
+    p = nd.array(np.array([[0.0, 0.0, 1.0], [1.0, 0.0, 0.0]], np.float32))
+    out = nd._sample_multinomial(p, shape=(7,))
+    a = out.asnumpy()
+    assert a.shape == (2, 7)
+    assert (a[0] == 2).all() and (a[1] == 0).all()
+
+
+# ---------------------------------------------------------------- linalg
+
+def test_linalg_det_inverse_slogdet():
+    a = A(3, 3) + 3 * np.eye(3, dtype=np.float32)
+    np.testing.assert_allclose(nd.linalg_det(nd.array(a)).asnumpy(),
+                               np.linalg.det(a), rtol=1e-4)
+    np.testing.assert_allclose(nd.linalg_inverse(nd.array(a)).asnumpy(),
+                               np.linalg.inv(a), rtol=1e-4, atol=1e-5)
+    sign, logdet = nd.linalg_slogdet(nd.array(a))
+    es, el = np.linalg.slogdet(a)
+    np.testing.assert_allclose(sign.asnumpy(), es, rtol=1e-5)
+    np.testing.assert_allclose(logdet.asnumpy(), el, rtol=1e-4)
+
+
+def test_linalg_potri_gelqf_syevd():
+    a = A(4, 4)
+    spd = a @ a.T + 4 * np.eye(4, dtype=np.float32)
+    L = np.linalg.cholesky(spd)
+    np.testing.assert_allclose(nd.linalg_potri(nd.array(L)).asnumpy(),
+                               np.linalg.inv(spd), rtol=1e-3, atol=1e-4)
+    b = A(3, 5)
+    Lq, Q = nd.linalg_gelqf(nd.array(b))
+    np.testing.assert_allclose((Lq.asnumpy() @ Q.asnumpy()), b, rtol=1e-4,
+                               atol=1e-5)
+    np.testing.assert_allclose(Q.asnumpy() @ Q.asnumpy().T, np.eye(3),
+                               rtol=1e-4, atol=1e-5)
+    sym = (a + a.T) / 2
+    U, lam = nd.linalg_syevd(nd.array(sym))
+    recon = U.asnumpy().T @ np.diag(lam.asnumpy()) @ U.asnumpy()
+    np.testing.assert_allclose(recon, sym, rtol=1e-3, atol=1e-4)
+
+
+def test_linalg_trmm_maketrian():
+    a = A(3, 3)
+    b = A(3, 3)
+    out = nd.linalg_trmm(nd.array(a), nd.array(b), alpha=2.0)
+    np.testing.assert_allclose(out.asnumpy(), 2.0 * np.tril(a) @ b,
+                               rtol=1e-5)
+    tri = A(6)
+    m = nd.linalg_maketrian(nd.array(tri))
+    back = nd.linalg_extracttrian(m)
+    np.testing.assert_allclose(back.asnumpy(), tri, rtol=1e-6)
+
+
+# ------------------------------------------------------------- loss layers
+
+def test_linear_regression_output_grad():
+    x = nd.array(A(4, 3))
+    label = nd.array(A(4, 3))
+    x.attach_grad()
+    with ag.record():
+        out = nd.LinearRegressionOutput(x, label)
+    out.backward()
+    np.testing.assert_allclose(
+        x.grad.asnumpy(), (x.asnumpy() - label.asnumpy()) / 3, rtol=1e-5)
+    np.testing.assert_array_equal(out.asnumpy(), x.asnumpy())
+
+
+def test_logistic_regression_output():
+    x = nd.array(A(4, 2))
+    label = nd.array((A(4, 2) > 0).astype(np.float32))
+    x.attach_grad()
+    with ag.record():
+        out = nd.LogisticRegressionOutput(x, label)
+    out.backward()
+    sig = 1 / (1 + np.exp(-x.asnumpy()))
+    np.testing.assert_allclose(out.asnumpy(), sig, rtol=1e-5)
+    np.testing.assert_allclose(x.grad.asnumpy(),
+                               (sig - label.asnumpy()) / 2, rtol=1e-4)
+
+
+def test_roi_pooling():
+    data = np.arange(1 * 1 * 4 * 4, dtype=np.float32).reshape(1, 1, 4, 4)
+    rois = np.array([[0, 0, 0, 3, 3]], np.float32)
+    out = nd.ROIPooling(nd.array(data), nd.array(rois), pooled_size=(2, 2),
+                        spatial_scale=1.0)
+    np.testing.assert_array_equal(
+        out.asnumpy()[0, 0], np.array([[5, 7], [13, 15]], np.float32))
+
+
+# ---------------------------------------------------------------- image ops
+
+def test_image_to_tensor_normalize():
+    img = R.randint(0, 255, (4, 5, 3)).astype(np.uint8)
+    t = nd._image_to_tensor(nd.array(img, dtype=np.uint8))
+    assert t.shape == (3, 4, 5)
+    np.testing.assert_allclose(t.asnumpy(),
+                               img.transpose(2, 0, 1) / 255.0, rtol=1e-6)
+    norm = nd._image_normalize(t, mean=(0.5, 0.5, 0.5), std=(0.2, 0.2, 0.2))
+    np.testing.assert_allclose(norm.asnumpy(),
+                               (img.transpose(2, 0, 1) / 255.0 - 0.5) / 0.2,
+                               rtol=1e-5)
+
+
+def test_image_crop_resize_flip():
+    img = A(8, 8, 3)
+    c = nd._image_crop(nd.array(img), x=2, y=1, width=4, height=3)
+    np.testing.assert_array_equal(c.asnumpy(), img[1:4, 2:6, :])
+    r = nd._image_resize(nd.array(img), size=(4, 4))
+    assert r.shape == (4, 4, 3)
+    f = nd._image_flip_left_right(nd.array(img))
+    np.testing.assert_array_equal(f.asnumpy(), img[:, ::-1, :])
+    f2 = nd._image_flip_top_bottom(nd.array(img))
+    np.testing.assert_array_equal(f2.asnumpy(), img[::-1, :, :])
+
+
+# ---------------------------------------------------------------- numpy ops
+
+def test_npi_aliases_resolve():
+    for name in ["_npi_add", "_npi_mean", "_npi_concatenate", "_npi_einsum",
+                 "_npi_svd", "_npi_normal", "_npi_uniform", "_np_sum",
+                 "_np_transpose", "_npx_relu", "_npx_softmax", "_npx_topk",
+                 "_npx_fully_connected", "_npi_cholesky", "_npi_unique"]:
+        assert mx.ops.get_op(name) is not None, name
+
+
+def test_einsum_tensordot():
+    a, b = A(3, 4), A(4, 5)
+    check("einsum", lambda x, y: np.einsum("ij,jk->ik", x, y), [a, b],
+          subscripts="ij,jk->ik")
+    check("tensordot", lambda x, y: np.tensordot(x, y, axes=([1], [0])),
+          [a, b], a_axes_summed=(1,), b_axes_summed=(0,))
+
+
+def test_numpy_misc_oracle():
+    x = A(3, 4)
+    check("around", lambda v: np.round(v, 1), [x], decimals=1)
+    check("std", lambda v: v.std(), [x], rtol=1e-4)
+    check("var", lambda v: v.var(), [x], rtol=1e-4)
+    check("diff", lambda v: np.diff(v, axis=-1), [x])
+    check("trace", lambda v: np.trace(v), [x])
+    check("tril", lambda v: np.tril(v), [x])
+    check("moveaxis", lambda v: np.moveaxis(v, 0, 1), [x],
+          source=0, destination=1)
+    check("rot90", lambda v: np.rot90(v), [x])
+    check("copysign", lambda a, b: np.copysign(a, b), [x, A(3, 4)])
+    check("arctan2", lambda a, b: np.arctan2(a, b), [x, A(3, 4)])
+    check("nan_to_num", np.nan_to_num,
+          [np.array([np.nan, np.inf, 1.0], np.float32)])
+    check("vstack", lambda a, b: np.vstack([a, b]), [x, A(3, 4)])
+    check("column_stack", lambda a, b: np.column_stack([a, b]), [x, A(3, 4)])
+
+
+def test_unique_nonzero_eager():
+    x = np.array([1, 2, 2, 3, 3, 3], np.float32)
+    np.testing.assert_array_equal(nd.unique(nd.array(x)).asnumpy(),
+                                  [1, 2, 3])
+    nz = nd.nonzero(nd.array(np.array([[1, 0], [0, 2]], np.float32)))
+    np.testing.assert_array_equal(nz.asnumpy(), [[0, 0], [1, 1]])
+
+
+def test_svd_reconstruction():
+    a = A(4, 3)
+    u, s, vh = nd._npi_svd(nd.array(a))
+    recon = u.asnumpy() @ np.diag(s.asnumpy()) @ vh.asnumpy()
+    np.testing.assert_allclose(recon, a, rtol=1e-4, atol=1e-5)
+
+
+def test_multinomial_counts():
+    p = np.array([0.5, 0.5], np.float32)
+    out = nd._npi_multinomial(n=100, pvals=nd.array(p))
+    a = out.asnumpy()
+    assert a.sum() == 100
+    assert a.shape == (2,)
+
+
+def test_gradient_checks_sample():
+    """Finite-difference gradient checks on a sample of new differentiable
+    ops (reference test strategy: check_numeric_gradient)."""
+    cases = [
+        ("around", {"decimals": 0}, False),     # zero-grad a.e.
+        ("tril", {}, True),
+        ("trace", {}, True),
+        ("copysign", None, None),  # handled below
+    ]
+    x = A(3, 3, scale=0.7)
+    for name, kw, _ in cases[:3]:
+        xa = nd.array(x)
+        xa.attach_grad()
+        with ag.record():
+            out = getattr(nd, name)(xa, **kw).sum()
+        out.backward()
+        g = xa.grad.asnumpy()
+        eps = 1e-3
+        num = np.zeros_like(x)
+        for i in range(x.shape[0]):
+            for j in range(x.shape[1]):
+                xp, xm = x.copy(), x.copy()
+                xp[i, j] += eps
+                xm[i, j] -= eps
+                fp = getattr(nd, name)(nd.array(xp), **kw).asnumpy().sum()
+                fm = getattr(nd, name)(nd.array(xm), **kw).asnumpy().sum()
+                num[i, j] = (fp - fm) / (2 * eps)
+        np.testing.assert_allclose(g, num, rtol=1e-2, atol=1e-2,
+                                   err_msg=name)
