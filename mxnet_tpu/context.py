@@ -38,20 +38,26 @@ class Context:
 
     def _accelerators(self):
         try:
-            accel = [d for d in jax.devices() if d.platform != "cpu"]
+            accel = [d for d in jax.local_devices() if d.platform != "cpu"]
         except RuntimeError:
             accel = []
         return accel
 
     @property
     def jax_device(self):
-        """Resolve to a concrete jax.Device."""
+        """Resolve to a concrete jax.Device. Device ids index this
+        process's ADDRESSABLE devices (reference semantics: gpu(0) on each
+        worker is that worker's own device) — under jax.distributed the
+        global list contains peers' devices, which cannot back an eager
+        array here."""
         if self.device_type in ("cpu", "cpu_pinned", "cpu_shared"):
-            cpus = [d for d in jax.devices("cpu")] if _has_cpu() else jax.devices()
+            cpus = [d for d in jax.local_devices(backend="cpu")] \
+                if _has_cpu() else jax.local_devices()
             return cpus[min(self.device_id, len(cpus) - 1)]
         accel = self._accelerators()
         if not accel:  # CPU-only process (tests): accelerator ctx falls back
-            return jax.devices()[min(self.device_id, len(jax.devices()) - 1)]
+            local = jax.local_devices()
+            return local[min(self.device_id, len(local) - 1)]
         return accel[min(self.device_id, len(accel) - 1)]
 
     def __hash__(self):
@@ -106,8 +112,10 @@ def gpu(device_id=0):
 
 
 def num_tpus():
+    """Count of THIS process's accelerator devices — the ids mx.tpu(i)
+    can address (local semantics, consistent with Context.jax_device)."""
     try:
-        return len([d for d in jax.devices() if d.platform != "cpu"])
+        return len([d for d in jax.local_devices() if d.platform != "cpu"])
     except RuntimeError:
         return 0
 

@@ -264,7 +264,7 @@ def _custom_fn(*tensor_vals, op_type, __is_train__=None, **prop_kwargs):
     return out if n_out > 1 else out[0]
 
 
-register_op(name="Custom", aliases=("_npi_Custom",),
+register_op(name="Custom", aliases=("_npx_Custom", "_npi_Custom"),
             state_binders={"__is_train__": _tape.is_training})(_custom_fn)
 
 

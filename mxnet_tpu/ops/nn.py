@@ -18,17 +18,9 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..base import dtype_np
+from ._common import _bind_key, _bind_train
 from .registry import register
 from .. import _tape
-
-
-def _bind_key():
-    from .. import random as _rnd
-    return _rnd.next_key()
-
-
-def _bind_train():
-    return _tape.is_training()
 
 
 # ------------------------------------------------------------ dense / conv

@@ -269,9 +269,11 @@ def _npi_boolean_mask_assign_tensor(data, mask, value):
 
 
 @register("_npi_bernoulli", differentiable=False, state_binders=_RNG)
-def _npi_bernoulli(prob=0.5, logit=None, size=None, ctx=None, dtype=None,
+def _npi_bernoulli(prob=None, logit=None, size=None, ctx=None, dtype=None,
                    key=None):
-    if prob is None:
+    if prob is None and logit is None:
+        prob = 0.5
+    elif prob is None:
         prob = jax.nn.sigmoid(jnp.asarray(logit))
     out = jax.random.bernoulli(key, prob, tuple(size or ()))
     return out.astype(_dt(dtype))

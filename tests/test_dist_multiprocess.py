@@ -1,0 +1,40 @@
+"""Multi-process distributed test: launches 2 real processes through
+tools/launch.py (local tracker role) running the dist_sync_kvstore
+invariants over the jax.distributed CPU backend (reference
+tests/nightly/dist_sync_kvstore.py)."""
+import os
+import socket
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_dist_sync_kvstore_two_processes():
+    port = _free_port()
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)  # workers use 1 CPU device per process
+    env.update({"JAX_PLATFORMS": "cpu",
+                "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", "")})
+    cmd = [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+           "-n", "2", "--launcher", "local",
+           "--coordinator", "127.0.0.1:%d" % port,
+           sys.executable,
+           os.path.join(REPO, "tests", "dist",
+                        "dist_sync_kvstore_worker.py")]
+    proc = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                          timeout=300)
+    sys.stderr.write(proc.stdout[-2000:] + proc.stderr[-2000:])
+    assert proc.returncode == 0, \
+        "distributed workers failed:\n%s\n%s" % (proc.stdout[-3000:],
+                                                 proc.stderr[-3000:])
+    assert "rank 0 OK" in proc.stdout
+    assert "rank 1 OK" in proc.stdout
