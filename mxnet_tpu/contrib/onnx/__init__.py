@@ -1,0 +1,6 @@
+"""ONNX interop (reference ``python/mxnet/contrib/onnx/__init__.py``):
+``export_model`` (mx2onnx) and ``import_model`` (onnx2mx)."""
+from .mx2onnx import export_model
+from .onnx2mx import import_model
+
+__all__ = ["export_model", "import_model"]

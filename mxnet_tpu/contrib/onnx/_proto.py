@@ -1,0 +1,311 @@
+"""Minimal ONNX protobuf wire-format codec.
+
+The image ships no ``onnx`` package (and no protoc schema for it), so this
+module encodes/decodes the subset of the ONNX ModelProto schema the
+mx2onnx/onnx2mx converters need, straight in the protobuf wire format
+(varint/length-delimited — https://protobuf.dev/programming-guides/encoding
+semantics; field numbers from the public onnx.proto3 schema). Files
+written here load in onnxruntime/netron; files produced by standard onnx
+tooling parse back as long as they stay within the supported field set.
+
+Role parity: reference ``python/mxnet/contrib/onnx`` builds the same
+messages via the installed onnx package.
+"""
+from __future__ import annotations
+
+import struct
+
+import numpy as _np
+
+# TensorProto.DataType
+FLOAT, UINT8, INT8, INT32, INT64, BOOL, FLOAT16, DOUBLE = \
+    1, 2, 3, 6, 7, 9, 10, 11
+
+NP_TO_ONNX = {
+    _np.dtype(_np.float32): FLOAT,
+    _np.dtype(_np.uint8): UINT8,
+    _np.dtype(_np.int8): INT8,
+    _np.dtype(_np.int32): INT32,
+    _np.dtype(_np.int64): INT64,
+    _np.dtype(_np.bool_): BOOL,
+    _np.dtype(_np.float16): FLOAT16,
+    _np.dtype(_np.float64): DOUBLE,
+}
+ONNX_TO_NP = {v: k for k, v in NP_TO_ONNX.items()}
+
+# AttributeProto.AttributeType
+A_FLOAT, A_INT, A_STRING, A_TENSOR, A_GRAPH = 1, 2, 3, 4, 5
+A_FLOATS, A_INTS, A_STRINGS = 6, 7, 8
+
+
+# ---------------------------------------------------------------- writer
+
+def _varint(n):
+    out = b""
+    n &= (1 << 64) - 1
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out += bytes([b | 0x80])
+        else:
+            out += bytes([b])
+            return out
+
+
+def _tag(field, wire):
+    return _varint((field << 3) | wire)
+
+
+def w_varint(field, value):
+    return _tag(field, 0) + _varint(int(value))
+
+
+def w_bytes(field, data):
+    if isinstance(data, str):
+        data = data.encode("utf-8")
+    return _tag(field, 2) + _varint(len(data)) + data
+
+
+w_msg = w_bytes  # nested messages are length-delimited too
+
+
+def w_packed_int64(field, values):
+    body = b"".join(_varint(int(v)) for v in values)
+    return _tag(field, 2) + _varint(len(body)) + body
+
+
+def w_packed_float(field, values):
+    body = struct.pack("<%df" % len(values), *values)
+    return _tag(field, 2) + _varint(len(body)) + body
+
+
+def tensor_proto(name, arr):
+    arr = _np.ascontiguousarray(arr)
+    dtype = NP_TO_ONNX[arr.dtype]
+    out = w_packed_int64(1, arr.shape)          # dims
+    out += w_varint(2, dtype)                   # data_type
+    out += w_bytes(8, name)                     # name
+    out += w_bytes(9, arr.tobytes())            # raw_data
+    return out
+
+
+def attribute(name, value):
+    out = w_bytes(1, name)
+    if isinstance(value, bool):
+        out += w_varint(3, int(value)) + w_varint(20, A_INT)
+    elif isinstance(value, int):
+        out += w_varint(3, value) + w_varint(20, A_INT)
+    elif isinstance(value, float):
+        out += _tag(2, 5) + struct.pack("<f", value) + w_varint(20, A_FLOAT)
+    elif isinstance(value, str):
+        out += w_bytes(4, value) + w_varint(20, A_STRING)
+    elif isinstance(value, bytes):
+        out += w_bytes(4, value) + w_varint(20, A_STRING)
+    elif isinstance(value, (list, tuple)):
+        if all(isinstance(v, int) for v in value):
+            out += b"".join(w_varint(8, v) for v in value)
+            out += w_varint(20, A_INTS)
+        else:
+            out += b"".join(_tag(7, 5) + struct.pack("<f", float(v))
+                            for v in value)
+            out += w_varint(20, A_FLOATS)
+    else:
+        raise TypeError("unsupported attribute %r=%r" % (name, value))
+    return out
+
+
+def node(op_type, inputs, outputs, name="", **attrs):
+    out = b"".join(w_bytes(1, i) for i in inputs)
+    out += b"".join(w_bytes(2, o) for o in outputs)
+    out += w_bytes(3, name or outputs[0])
+    out += w_bytes(4, op_type)
+    out += b"".join(w_msg(5, attribute(k, v))
+                    for k, v in attrs.items() if v is not None)
+    return out
+
+
+def value_info(name, shape, dtype=FLOAT):
+    dims = b"".join(w_msg(1, w_varint(1, d)) for d in shape)
+    tensor_type = w_varint(1, dtype) + w_msg(2, dims)
+    type_proto = w_msg(1, tensor_type)
+    return w_bytes(1, name) + w_msg(2, type_proto)
+
+
+def graph(nodes, name, inputs, outputs, initializers):
+    out = b"".join(w_msg(1, n) for n in nodes)
+    out += w_bytes(2, name)
+    out += b"".join(w_msg(5, t) for t in initializers)
+    out += b"".join(w_msg(11, vi) for vi in inputs)
+    out += b"".join(w_msg(12, vi) for vi in outputs)
+    return out
+
+
+def model(graph_bytes, opset=13, producer="mxnet_tpu"):
+    out = w_varint(1, 8)                        # ir_version
+    out += w_bytes(2, producer)                 # producer_name
+    out += w_bytes(3, "0.1")                    # producer_version
+    out += w_msg(7, graph_bytes)                # graph
+    out += w_msg(8, w_varint(2, opset))         # opset_import (domain="")
+    return out
+
+
+# ---------------------------------------------------------------- reader
+
+def _read_varint(buf, pos):
+    result = shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+
+
+def parse(buf):
+    """Parse a protobuf message into {field: [values]}; length-delimited
+    fields stay bytes (caller re-parses nested messages)."""
+    fields = {}
+    pos = 0
+    n = len(buf)
+    while pos < n:
+        key, pos = _read_varint(buf, pos)
+        field, wire = key >> 3, key & 7
+        if wire == 0:
+            val, pos = _read_varint(buf, pos)
+        elif wire == 2:
+            ln, pos = _read_varint(buf, pos)
+            val = buf[pos:pos + ln]
+            pos += ln
+        elif wire == 5:
+            val = struct.unpack("<I", buf[pos:pos + 4])[0]
+            pos += 4
+        elif wire == 1:
+            val = struct.unpack("<Q", buf[pos:pos + 8])[0]
+            pos += 8
+        else:
+            raise ValueError("unsupported wire type %d" % wire)
+        fields.setdefault(field, []).append(val)
+    return fields
+
+
+def _unpack_varints(data):
+    vals, pos = [], 0
+    while pos < len(data):
+        v, pos = _read_varint(data, pos)
+        vals.append(v)
+    return vals
+
+
+def _signed(v):
+    return v - (1 << 64) if v >= (1 << 63) else v
+
+
+def parse_tensor(buf):
+    f = parse(buf)
+    dims = []
+    for d in f.get(1, []):
+        if isinstance(d, bytes):
+            dims.extend(_signed(v) for v in _unpack_varints(d))
+        else:
+            dims.append(_signed(d))
+    dtype = ONNX_TO_NP[f.get(2, [FLOAT])[0]]
+    name = f.get(8, [b""])[0].decode("utf-8")
+    if 9 in f:
+        arr = _np.frombuffer(f[9][0], dtype=dtype).reshape(dims).copy()
+    elif 4 in f:  # float_data (packed or repeated)
+        raw = f[4][0] if isinstance(f[4][0], bytes) else None
+        if raw is not None:
+            arr = _np.frombuffer(raw, dtype="<f4").reshape(dims).copy()
+        else:
+            arr = _np.array(f[4], dtype=_np.float32).reshape(dims)
+    elif 7 in f:  # int64_data
+        vals = []
+        for item in f[7]:
+            if isinstance(item, bytes):
+                vals.extend(_signed(v) for v in _unpack_varints(item))
+            else:
+                vals.append(_signed(item))
+        arr = _np.array(vals, dtype=_np.int64).reshape(dims)
+    else:
+        arr = _np.zeros(dims, dtype=dtype)
+    return name, arr
+
+
+def parse_attribute(buf):
+    f = parse(buf)
+    name = f[1][0].decode("utf-8")
+    atype = f.get(20, [None])[0]
+    if atype == A_INT or (atype is None and 3 in f):
+        return name, _signed(f[3][0])
+    if atype == A_FLOAT or (atype is None and 2 in f):
+        return name, struct.unpack("<f", struct.pack("<I", f[2][0]))[0]
+    if atype == A_STRING or (atype is None and 4 in f):
+        return name, f[4][0].decode("utf-8", "replace")
+    if atype == A_INTS or (atype is None and 8 in f):
+        vals = []
+        for item in f.get(8, []):
+            if isinstance(item, bytes):
+                vals.extend(_signed(v) for v in _unpack_varints(item))
+            else:
+                vals.append(_signed(item))
+        return name, vals
+    if atype == A_FLOATS or (atype is None and 7 in f):
+        vals = []
+        for item in f.get(7, []):
+            if isinstance(item, int):
+                vals.append(struct.unpack("<f", struct.pack("<I", item))[0])
+            else:
+                vals.extend(_np.frombuffer(item, "<f4").tolist())
+        return name, vals
+    if atype == A_TENSOR or (atype is None and 5 in f):
+        return name, parse_tensor(f[5][0])[1]
+    return name, None
+
+
+def parse_node(buf):
+    f = parse(buf)
+    return {
+        "inputs": [b.decode("utf-8") for b in f.get(1, [])],
+        "outputs": [b.decode("utf-8") for b in f.get(2, [])],
+        "name": f.get(3, [b""])[0].decode("utf-8"),
+        "op_type": f.get(4, [b""])[0].decode("utf-8"),
+        "attrs": dict(parse_attribute(a) for a in f.get(5, [])),
+    }
+
+
+def parse_value_info(buf):
+    f = parse(buf)
+    name = f.get(1, [b""])[0].decode("utf-8")
+    shape = []
+    dtype = FLOAT
+    if 2 in f:
+        tp = parse(f[2][0])
+        if 1 in tp:  # tensor_type
+            tt = parse(tp[1][0])
+            dtype = tt.get(1, [FLOAT])[0]
+            if 2 in tt:
+                sh = parse(tt[2][0])
+                for dim in sh.get(1, []):
+                    df = parse(dim)
+                    shape.append(_signed(df[1][0]) if 1 in df else -1)
+    return name, tuple(shape), dtype
+
+
+def parse_graph(buf):
+    f = parse(buf)
+    return {
+        "nodes": [parse_node(n) for n in f.get(1, [])],
+        "name": f.get(2, [b""])[0].decode("utf-8"),
+        "initializers": dict(parse_tensor(t) for t in f.get(5, [])),
+        "inputs": [parse_value_info(v) for v in f.get(11, [])],
+        "outputs": [parse_value_info(v) for v in f.get(12, [])],
+    }
+
+
+def parse_model(buf):
+    f = parse(buf)
+    if 7 not in f:
+        raise ValueError("not an ONNX ModelProto (no graph field)")
+    return parse_graph(f[7][0])

@@ -1,0 +1,303 @@
+"""Symbol graph -> ONNX export.
+
+Role parity: reference ``python/mxnet/contrib/onnx/mx2onnx/export_model.py``
+(+ _op_translations.py per-op converters). Targets opset 11. The ONNX
+bytes are produced by the wire-format codec in ``_proto`` (no onnx
+package in this environment).
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from . import _proto as P
+
+
+def _ints(v, n=None):
+    if v is None:
+        return [1] * (n or 0)
+    if isinstance(v, int):
+        return [v] * (n or 1)
+    return [int(x) for x in v]
+
+
+def _pads2(pad, ndim=2):
+    p = _ints(pad, ndim) if pad is not None else [0] * ndim
+    return p + p  # symmetric begin+end
+
+
+class _Ctx:
+    def __init__(self, params=None):
+        self.nodes = []
+        self.initializers = []
+        self.counter = 0
+        self.params = params or {}
+
+    def const(self, name, arr):
+        self.initializers.append(P.tensor_proto(name, arr))
+        return name
+
+    def add(self, op_type, inputs, outputs, name="", **attrs):
+        self.nodes.append(P.node(op_type, inputs, outputs, name, **attrs))
+
+
+def _conv(ctx, name, ins, kw):
+    kernel = _ints(kw.get("kernel"))
+    attrs = dict(kernel_shape=kernel,
+                 strides=_ints(kw.get("stride"), len(kernel)),
+                 dilations=_ints(kw.get("dilate"), len(kernel)),
+                 pads=_pads2(kw.get("pad"), len(kernel)),
+                 group=int(kw.get("num_group", 1)))
+    ctx.add("Conv", [i for i in ins if i is not None], [name], name, **attrs)
+
+
+def _deconv(ctx, name, ins, kw):
+    kernel = _ints(kw.get("kernel"))
+    ctx.add("ConvTranspose", [i for i in ins if i is not None], [name], name,
+            kernel_shape=kernel,
+            strides=_ints(kw.get("stride"), len(kernel)),
+            dilations=_ints(kw.get("dilate"), len(kernel)),
+            pads=_pads2(kw.get("pad"), len(kernel)),
+            group=int(kw.get("num_group", 1)))
+
+
+def _fc(ctx, name, ins, kw):
+    data = ins[0]
+    if kw.get("flatten", True):
+        flat = name + "_flat"
+        ctx.add("Flatten", [data], [flat], flat, axis=1)
+        data = flat
+    gemm_in = [data, ins[1]] + ([ins[2]] if len(ins) > 2 and ins[2] else [])
+    ctx.add("Gemm", gemm_in, [name], name, alpha=1.0, beta=1.0,
+            transA=0, transB=1)
+
+
+def _bn(ctx, name, ins, kw):
+    ins = list(ins[:5])
+    if kw.get("fix_gamma", False):
+        # the op ignores the stored gamma when fix_gamma (ops/nn.py
+        # BatchNorm); export a matching all-ones scale
+        gamma = ctx.params.get(ins[1])
+        if gamma is None:
+            raise NotImplementedError(
+                "cannot export fix_gamma BatchNorm %s: gamma %r is not a "
+                "bound parameter" % (name, ins[1]))
+        shape = gamma.shape if hasattr(gamma, "shape") else (len(gamma),)
+        ins[1] = ctx.const(name + "_fixed_gamma",
+                           _np.ones(shape, _np.float32))
+    ctx.add("BatchNormalization", ins, [name], name,
+            # the op's own default (ops/nn.py BatchNorm eps=1e-3)
+            epsilon=float(kw.get("eps", 1e-3)),
+            momentum=float(kw.get("momentum", 0.9)))
+
+
+_ACT = {"relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh",
+        "softrelu": "Softplus", "softsign": "Softsign"}
+
+
+def _activation(ctx, name, ins, kw):
+    ctx.add(_ACT[kw.get("act_type", "relu")], [ins[0]], [name], name)
+
+
+def _pooling(ctx, name, ins, kw):
+    ptype = kw.get("pool_type", "max")
+    if kw.get("global_pool", False):
+        op = "GlobalMaxPool" if ptype == "max" else "GlobalAveragePool"
+        ctx.add(op, [ins[0]], [name], name)
+        return
+    kernel = _ints(kw.get("kernel"))
+    attrs = dict(kernel_shape=kernel,
+                 strides=_ints(kw.get("stride"), len(kernel)),
+                 pads=_pads2(kw.get("pad"), len(kernel)),
+                 # 'full' convention == ONNX ceil_mode (opset >= 10)
+                 ceil_mode=int(kw.get("pooling_convention",
+                                      "valid") == "full"))
+    if ptype == "max":
+        ctx.add("MaxPool", [ins[0]], [name], name, **attrs)
+    else:
+        ctx.add("AveragePool", [ins[0]], [name], name,
+                count_include_pad=int(kw.get("count_include_pad", True)),
+                **attrs)
+
+
+def _softmax(ctx, name, ins, kw):
+    ctx.add("Softmax", [ins[0]], [name], name, axis=int(kw.get("axis", -1)))
+
+
+def _dropout(ctx, name, ins, kw):
+    ctx.add("Dropout", [ins[0]], [name], name, ratio=float(kw.get("p", 0.5)))
+
+
+def _leaky(ctx, name, ins, kw):
+    act = kw.get("act_type", "leaky")
+    if act == "leaky":
+        ctx.add("LeakyRelu", [ins[0]], [name], name,
+                alpha=float(kw.get("slope", 0.25)))
+    elif act == "elu":
+        ctx.add("Elu", [ins[0]], [name], name,
+                alpha=float(kw.get("slope", 0.25)))
+    elif act == "prelu":
+        ctx.add("PRelu", list(ins[:2]), [name], name)
+    else:
+        raise ValueError("cannot export LeakyReLU act_type=%s" % act)
+
+
+def _reshape(ctx, name, ins, kw):
+    shape = ctx.const(name + "_shape",
+                      _np.array(kw.get("shape"), _np.int64))
+    ctx.add("Reshape", [ins[0], shape], [name], name)
+
+
+def _binop(onnx_op):
+    def conv(ctx, name, ins, kw):
+        ctx.add(onnx_op, list(ins[:2]), [name], name)
+    return conv
+
+
+def _scalar_op(onnx_op, rev=False):
+    def conv(ctx, name, ins, kw):
+        c = ctx.const(name + "_c",
+                      _np.array(float(kw.get("scalar", 0.0)), _np.float32))
+        inputs = [c, ins[0]] if rev else [ins[0], c]
+        ctx.add(onnx_op, inputs, [name], name)
+    return conv
+
+
+def _unary(onnx_op):
+    def conv(ctx, name, ins, kw):
+        ctx.add(onnx_op, [ins[0]], [name], name)
+    return conv
+
+
+def _concat(ctx, name, ins, kw):
+    ctx.add("Concat", list(ins), [name], name, axis=int(kw.get("dim", 1)))
+
+
+def _transpose(ctx, name, ins, kw):
+    ctx.add("Transpose", [ins[0]], [name], name,
+            perm=_ints(kw.get("axes")) or None)
+
+
+def _clip(ctx, name, ins, kw):
+    lo = ctx.const(name + "_min",
+                   _np.array(float(kw.get("a_min", 0.0)), _np.float32))
+    hi = ctx.const(name + "_max",
+                   _np.array(float(kw.get("a_max", 0.0)), _np.float32))
+    ctx.add("Clip", [ins[0], lo, hi], [name], name)
+
+
+def _mean(ctx, name, ins, kw):
+    axes = kw.get("axis")
+    ctx.add("ReduceMean", [ins[0]], [name], name,
+            axes=_ints(axes) if axes is not None else None,
+            keepdims=int(kw.get("keepdims", False)))
+
+
+CONVERTERS = {
+    "Convolution": _conv, "convolution": _conv,
+    "Deconvolution": _deconv,
+    "FullyConnected": _fc, "fully_connected": _fc,
+    "BatchNorm": _bn, "batch_norm": _bn,
+    "Activation": _activation, "activation": _activation,
+    "Pooling": _pooling, "pooling": _pooling,
+    "softmax": _softmax, "Softmax": _softmax, "SoftmaxOutput": _softmax,
+    "log_softmax": _unary("LogSoftmax"),
+    "Dropout": _dropout, "dropout": _dropout,
+    "LeakyReLU": _leaky,
+    "reshape": _reshape, "Reshape": _reshape,
+    "Flatten": _unary("Flatten"), "flatten": _unary("Flatten"),
+    "add": _binop("Add"), "elemwise_add": _binop("Add"),
+    "broadcast_add": _binop("Add"), "_plus": _binop("Add"),
+    "subtract": _binop("Sub"), "broadcast_sub": _binop("Sub"),
+    "multiply": _binop("Mul"), "broadcast_mul": _binop("Mul"),
+    "divide": _binop("Div"), "broadcast_div": _binop("Div"),
+    "dot": _binop("MatMul"), "matmul": _binop("MatMul"),
+    "_plus_scalar": _scalar_op("Add"),
+    "_minus_scalar": _scalar_op("Sub"),
+    "_rminus_scalar": _scalar_op("Sub", rev=True),
+    "_mul_scalar": _scalar_op("Mul"),
+    "_div_scalar": _scalar_op("Div"),
+    "_rdiv_scalar": _scalar_op("Div", rev=True),
+    "relu": _unary("Relu"), "sigmoid": _unary("Sigmoid"),
+    "tanh": _unary("Tanh"), "exp": _unary("Exp"), "log": _unary("Log"),
+    "sqrt": _unary("Sqrt"), "abs": _unary("Abs"),
+    "negative": _unary("Neg"), "identity": _unary("Identity"),
+    "_copy": _unary("Identity"),
+    "concat": _concat, "Concat": _concat,
+    "transpose": _transpose,
+    "clip": _clip,
+    "mean": _mean,
+}
+
+
+def export_model(sym, params, input_shape=None, input_type=_np.float32,
+                 onnx_file_path="model.onnx", verbose=False):
+    """Export a Symbol + params to an ONNX file (reference
+    mx2onnx/export_model.py:44 signature). ``input_shape`` is a list of
+    shapes for the graph's data variables. Returns the file path."""
+    from ...symbol.symbol import Symbol
+    from ... import symbol as sym_mod
+    if isinstance(sym, str):
+        sym = sym_mod.load(sym)
+    if not isinstance(sym, Symbol):
+        raise TypeError("sym must be a Symbol or symbol file path")
+    params = {k.split(":", 1)[1] if k.startswith(("arg:", "aux:")) else k: v
+              for k, v in (params or {}).items()}
+
+    ctx = _Ctx(params)
+    nodes = sym._toposort()
+    out_names = {}  # (id(node), out_idx) -> onnx name
+    inputs = []
+    shapes_in = list(input_shape or [])
+
+    for n in nodes:
+        name = n._name or "node%d" % ctx.counter
+        ctx.counter += 1
+        if n._op is None:
+            if n._name in params:
+                arr = params[n._name]
+                arr = arr.asnumpy() if hasattr(arr, "asnumpy") else arr
+                ctx.const(n._name, arr)
+            else:
+                shape = shapes_in.pop(0) if shapes_in else (1,)
+                inputs.append(P.value_info(
+                    n._name, shape, P.NP_TO_ONNX[_np.dtype(input_type)]))
+            out_names[(id(n), 0)] = n._name
+            continue
+        conv = CONVERTERS.get(n._op.name)
+        if conv is None:
+            raise NotImplementedError(
+                "ONNX export: no converter for op %r (node %s)"
+                % (n._op.name, name))
+        ins = []
+        for p in getattr(n, "_raw_inputs", n._inputs):
+            if isinstance(p, tuple) and p and p[0] == "const":
+                ins.append(None if p[1] is None else p[1])
+            else:
+                ins.append(out_names[(id(p[0]), p[1])])
+        conv(ctx, name, ins, n._kwargs)
+        out_names[(id(n), 0)] = name
+
+    outputs = []
+    try:
+        kw = {}
+        si = list(input_shape or [])
+        for n in nodes:
+            if n._op is None and n._name not in params and si:
+                kw[n._name] = si.pop(0)
+        for n in nodes:
+            if n._op is None and n._name in params:
+                kw[n._name] = tuple(params[n._name].shape)
+        _, out_shapes, _ = sym.infer_shape(**kw)
+    except Exception:
+        out_shapes = None
+    for i, (s, oi) in enumerate(sym._outputs_list()):
+        oname = out_names[(id(s), oi)]
+        shape = tuple(out_shapes[i]) if out_shapes else ()
+        outputs.append(P.value_info(oname, shape))
+
+    g = P.graph(ctx.nodes, "mxnet_tpu_graph", inputs, outputs,
+                ctx.initializers)
+    buf = P.model(g, opset=11)
+    with open(onnx_file_path, "wb") as f:
+        f.write(buf)
+    return onnx_file_path

@@ -1,0 +1,199 @@
+"""ONNX -> Symbol graph import.
+
+Role parity: reference ``python/mxnet/contrib/onnx/onnx2mx/import_model.py``
+(+ _op_translations.py). Parses the ONNX file with the ``_proto`` codec
+and rebuilds the graph over this framework's op registry, returning
+(sym, arg_params, aux_params) like the reference.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from . import _proto as P
+
+
+def _attr_pad(pads):
+    if not pads:
+        return None
+    n = len(pads) // 2
+    if list(pads[:n]) != list(pads[n:]):
+        raise NotImplementedError("asymmetric ONNX pads %s" % (pads,))
+    return tuple(pads[:n])
+
+
+def import_model(model_file):
+    """Load an ONNX model file -> (sym, arg_params, aux_params)
+    (reference onnx2mx/import_model.py:30)."""
+    from ... import symbol as S
+    from ...ndarray import ndarray as _nd
+
+    with open(model_file, "rb") as f:
+        g = P.parse_model(f.read())
+
+    inits = g["initializers"]
+    values = {}          # onnx tensor name -> Symbol
+    consumed_as_attr = set()
+    arg_params, aux_params = {}, {}
+
+    input_names = [n for n, _, _ in g["inputs"] if n not in inits]
+
+    def val(name):
+        if name in values:
+            return values[name]
+        v = S.var(name)
+        values[name] = v
+        return v
+
+    for n, arr in inits.items():
+        values[n] = S.var(n)
+
+    for node in g["nodes"]:
+        op = node["op_type"]
+        a = node["attrs"]
+        ins = node["inputs"]
+        out = node["outputs"][0]
+        name = node["name"] or out
+
+        if op == "Conv":
+            kernel = tuple(a.get("kernel_shape"))
+            sym = S.Convolution(
+                val(ins[0]), *[val(i) for i in ins[1:]],
+                kernel=kernel,
+                stride=tuple(a.get("strides", (1,) * len(kernel))),
+                dilate=tuple(a.get("dilations", (1,) * len(kernel))),
+                pad=_attr_pad(a.get("pads")) or (0,) * len(kernel),
+                num_filter=int(inits[ins[1]].shape[0]),
+                num_group=int(a.get("group", 1)),
+                no_bias=len(ins) < 3, name=name)
+        elif op == "ConvTranspose":
+            kernel = tuple(a.get("kernel_shape"))
+            sym = S.Deconvolution(
+                val(ins[0]), *[val(i) for i in ins[1:]],
+                kernel=kernel,
+                stride=tuple(a.get("strides", (1,) * len(kernel))),
+                dilate=tuple(a.get("dilations", (1,) * len(kernel))),
+                pad=_attr_pad(a.get("pads")) or (0,) * len(kernel),
+                num_filter=int(inits[ins[1]].shape[1]
+                               * int(a.get("group", 1))),
+                num_group=int(a.get("group", 1)),
+                no_bias=len(ins) < 3, name=name)
+        elif op == "Gemm":
+            assert int(a.get("transB", 0)) == 1 and \
+                int(a.get("transA", 0)) == 0, "only transB=1 Gemm supported"
+            assert float(a.get("alpha", 1.0)) == 1.0 and \
+                float(a.get("beta", 1.0)) == 1.0, \
+                "only alpha=beta=1 Gemm supported"
+            sym = S.FullyConnected(
+                val(ins[0]), *[val(i) for i in ins[1:]],
+                num_hidden=int(inits[ins[1]].shape[0]),
+                no_bias=len(ins) < 3, flatten=False, name=name)
+        elif op == "MatMul":
+            sym = S.dot(val(ins[0]), val(ins[1]), name=name)
+        elif op == "BatchNormalization":
+            sym = S.BatchNorm(*[val(i) for i in ins],
+                              eps=float(a.get("epsilon", 1e-5)),
+                              momentum=float(a.get("momentum", 0.9)),
+                              # ONNX semantics always apply the scale
+                              # tensor; never ignore gamma on import
+                              fix_gamma=False, name=name)
+            for aux_in in ins[3:5]:
+                if aux_in in inits:
+                    aux_params[aux_in] = _nd.array(inits[aux_in])
+        elif op in ("Relu", "Sigmoid", "Tanh", "Softplus", "Softsign",
+                    "Exp", "Log", "Sqrt", "Abs", "Neg", "Identity",
+                    "LogSoftmax"):
+            fn = {"Relu": S.relu, "Sigmoid": S.sigmoid, "Tanh": S.tanh,
+                  "Softplus": S.softrelu, "Softsign": S.softsign,
+                  "Exp": S.exp, "Log": S.log, "Sqrt": S.sqrt,
+                  "Abs": S.abs, "Neg": S.negative, "Identity": S.identity,
+                  "LogSoftmax": S.log_softmax}[op]
+            sym = fn(val(ins[0]), name=name)
+        elif op == "LeakyRelu":
+            sym = S.LeakyReLU(val(ins[0]), act_type="leaky",
+                              slope=float(a.get("alpha", 0.01)), name=name)
+        elif op == "Elu":
+            sym = S.LeakyReLU(val(ins[0]), act_type="elu",
+                              slope=float(a.get("alpha", 1.0)), name=name)
+        elif op == "PRelu":
+            sym = S.LeakyReLU(val(ins[0]), val(ins[1]), act_type="prelu",
+                              name=name)
+        elif op in ("MaxPool", "AveragePool"):
+            kernel = tuple(a.get("kernel_shape"))
+            sym = S.Pooling(
+                val(ins[0]), kernel=kernel,
+                stride=tuple(a.get("strides", (1,) * len(kernel))),
+                pad=_attr_pad(a.get("pads")) or (0,) * len(kernel),
+                pooling_convention="full" if a.get("ceil_mode") else "valid",
+                pool_type="max" if op == "MaxPool" else "avg",
+                # ONNX spec default: exclude padding from the average
+                count_include_pad=bool(a.get("count_include_pad", 0)),
+                name=name)
+        elif op in ("GlobalMaxPool", "GlobalAveragePool"):
+            sym = S.Pooling(val(ins[0]), global_pool=True, kernel=(1, 1),
+                            pool_type="max" if op == "GlobalMaxPool"
+                            else "avg", name=name)
+        elif op == "Softmax":
+            if "axis" in a:
+                sym = S.softmax(val(ins[0]), axis=int(a["axis"]), name=name)
+            else:
+                # opset<=12 default: axis=1 with flatten-to-2D semantics
+                flat = S.reshape(val(ins[0]), shape=(0, -1),
+                                 name=name + "_flat2d")
+                soft = S.softmax(flat, axis=-1, name=name + "_sm")
+                sym = S.reshape_like(soft, val(ins[0]), name=name)
+        elif op == "Dropout":
+            sym = S.Dropout(val(ins[0]), p=float(a.get("ratio", 0.5)),
+                            name=name)
+        elif op == "Flatten":
+            sym = S.Flatten(val(ins[0]), name=name)
+        elif op == "Reshape":
+            shape = inits.get(ins[1])
+            if shape is None:
+                raise NotImplementedError("dynamic Reshape shape input")
+            consumed_as_attr.add(ins[1])
+            sym = S.reshape(val(ins[0]),
+                            shape=tuple(int(v) for v in shape), name=name)
+        elif op == "Transpose":
+            sym = S.transpose(val(ins[0]),
+                              axes=tuple(a["perm"]) if a.get("perm")
+                              else None, name=name)
+        elif op == "Concat":
+            sym = S.concat(*[val(i) for i in ins],
+                           dim=int(a.get("axis", 1)), name=name)
+        elif op == "Clip":
+            def _bound(idx, default):
+                # the spec encodes an omitted bound as a missing or
+                # empty-string input
+                if len(ins) <= idx or not ins[idx]:
+                    return default
+                if ins[idx] not in inits:
+                    raise NotImplementedError(
+                        "Clip bound %r comes from a computed tensor; only "
+                        "initializer bounds are supported" % ins[idx])
+                consumed_as_attr.add(ins[idx])
+                return float(inits[ins[idx]])
+            lo = _bound(1, -_np.inf)
+            hi = _bound(2, _np.inf)
+            sym = S.clip(val(ins[0]), a_min=lo, a_max=hi, name=name)
+        elif op in ("Add", "Sub", "Mul", "Div"):
+            fn = {"Add": S.broadcast_add, "Sub": S.broadcast_sub,
+                  "Mul": S.broadcast_mul, "Div": S.broadcast_div}[op]
+            sym = fn(val(ins[0]), val(ins[1]), name=name)
+        elif op == "ReduceMean":
+            sym = S.mean(val(ins[0]),
+                         axis=tuple(a["axes"]) if a.get("axes") else None,
+                         # ONNX spec default keepdims=1
+                         keepdims=bool(a.get("keepdims", 1)), name=name)
+        else:
+            raise NotImplementedError(
+                "ONNX import: unsupported op %r (node %s)" % (op, name))
+        values[out] = sym
+
+    for n, arr in inits.items():
+        if n in consumed_as_attr or n in aux_params:
+            continue
+        arg_params[n] = _nd.array(arr)
+
+    out_syms = [values[n] for n, _, _ in g["outputs"]]
+    sym = out_syms[0] if len(out_syms) == 1 else S.Group(out_syms)
+    return sym, arg_params, aux_params

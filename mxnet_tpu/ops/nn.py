@@ -20,7 +20,6 @@ from jax import lax
 from ..base import dtype_np
 from ._common import _bind_key, _bind_train
 from .registry import register
-from .. import _tape
 
 
 # ------------------------------------------------------------ dense / conv

@@ -275,7 +275,8 @@ def _npi_bernoulli(prob=None, logit=None, size=None, ctx=None, dtype=None,
         prob = 0.5
     elif prob is None:
         prob = jax.nn.sigmoid(jnp.asarray(logit))
-    out = jax.random.bernoulli(key, prob, tuple(size or ()))
+    shape = tuple(size) if size is not None else jnp.shape(prob)
+    out = jax.random.bernoulli(key, prob, shape)
     return out.astype(_dt(dtype))
 
 

@@ -1,0 +1,170 @@
+"""ONNX export/import round trip (reference
+python/mxnet/contrib/onnx + tests/python-pytest/onnx/)."""
+import struct
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, gluon
+from mxnet_tpu.contrib.onnx import export_model, import_model
+from mxnet_tpu.contrib.onnx import _proto as P
+
+
+def _export_net(net, x, tmp_path, tag):
+    y0 = net(x).asnumpy()
+    sf, pf = net.export(str(tmp_path / tag))
+    sym, arg_params, aux_params = mx.model.load_checkpoint(
+        str(tmp_path / tag), 0)
+    params = dict(arg_params)
+    params.update(aux_params)
+    onnx_path = export_model(sym, params, input_shape=[x.shape],
+                             onnx_file_path=str(tmp_path / (tag + ".onnx")))
+    return y0, onnx_path
+
+
+def _forward_imported(onnx_path, x):
+    sym, arg_params, aux_params = import_model(onnx_path)
+    bindings = dict(arg_params)
+    bindings.update(aux_params)
+    data_name = [n for n in sym.list_inputs()
+                 if n not in bindings][0]
+    bindings[data_name] = x
+    ex = sym.bind(mx.cpu(), bindings)
+    return ex.forward()[0].asnumpy()
+
+
+def test_onnx_mlp_roundtrip(tmp_path):
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(16, activation="relu"), gluon.nn.Dense(4))
+    net.initialize()
+    x = nd.array(np.random.RandomState(0).randn(2, 8).astype(np.float32))
+    y0, path = _export_net(net, x, tmp_path, "mlp")
+    np.testing.assert_allclose(_forward_imported(path, x), y0, rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_onnx_conv_bn_pool_roundtrip(tmp_path):
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Conv2D(8, 3, padding=1), gluon.nn.BatchNorm(),
+            gluon.nn.Activation("relu"), gluon.nn.MaxPool2D(2),
+            gluon.nn.Flatten(), gluon.nn.Dense(5))
+    net.initialize()
+    x = nd.array(np.random.RandomState(1).randn(2, 3, 8, 8)
+                 .astype(np.float32))
+    y0, path = _export_net(net, x, tmp_path, "conv")
+    np.testing.assert_allclose(_forward_imported(path, x), y0, rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_onnx_resnet18_roundtrip(tmp_path):
+    from mxnet_tpu.gluon.model_zoo import vision
+    net = vision.resnet18_v1()
+    net.initialize()
+    x = nd.array(np.random.RandomState(2).randn(1, 3, 32, 32)
+                 .astype(np.float32))
+    y0, path = _export_net(net, x, tmp_path, "r18")
+    np.testing.assert_allclose(_forward_imported(path, x), y0, rtol=1e-3,
+                               atol=1e-4)
+
+
+def test_onnx_file_structure(tmp_path):
+    """The emitted bytes are a structurally-valid ModelProto: parses with
+    an independent walk, has ir_version/producer/opset, graph in/outputs."""
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(3, in_units=2))
+    net.initialize()
+    x = nd.ones((1, 2))
+    _, path = _export_net(net, x, tmp_path, "s")
+    raw = open(path, "rb").read()
+    fields = P.parse(raw)
+    assert fields[1][0] == 8                      # ir_version
+    assert b"mxnet_tpu" in fields[2][0]           # producer_name
+    opset = P.parse(fields[8][0])
+    assert opset[2][0] == 11                      # opset version
+    g = P.parse_graph(fields[7][0])
+    assert g["inputs"] and g["outputs"] and g["nodes"]
+    assert any(n["op_type"] == "Gemm" for n in g["nodes"])
+    # initializers carry raw tensor data
+    w = [a for n, a in g["initializers"].items() if a.shape == (3, 2)]
+    assert w and w[0].dtype == np.float32
+
+
+def test_onnx_input_shape_recorded(tmp_path):
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(3, in_units=4))
+    net.initialize()
+    x = nd.ones((2, 4))
+    _, path = _export_net(net, x, tmp_path, "shp")
+    g = P.parse_model(open(path, "rb").read())
+    (name, shape, dtype), = g["inputs"]
+    assert shape == (2, 4)
+    assert dtype == P.FLOAT
+
+
+def test_onnx_fix_gamma_exports_ones(tmp_path):
+    """Symbol-level BatchNorm with fix_gamma=True ignores the stored gamma;
+    the exported model must use ones, not the stored values."""
+    data = mx.sym.var("data")
+    out = mx.sym.BatchNorm(data, fix_gamma=True, name="bn0")
+    params = {"bn0_gamma": nd.array(np.full((3,), 7.0, np.float32)),
+              "bn0_beta": nd.zeros((3,)),
+              "bn0_moving_mean": nd.zeros((3,)),
+              "bn0_moving_var": nd.ones((3,))}
+    path = export_model(out, params, input_shape=[(1, 3, 4, 4)],
+                        onnx_file_path=str(tmp_path / "fg.onnx"))
+    g = P.parse_model(open(path, "rb").read())
+    fixed = [a for n, a in g["initializers"].items() if "fixed_gamma" in n]
+    assert fixed and np.all(fixed[0] == 1.0)
+    x = nd.array(np.random.RandomState(3).randn(1, 3, 4, 4)
+                 .astype(np.float32))
+    y_src = out.bind(mx.cpu(), dict(params, data=x)).forward()[0].asnumpy()
+    np.testing.assert_allclose(_forward_imported(path, x), y_src,
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_onnx_softmax_default_axis_flatten_semantics(tmp_path):
+    """An external opset-11 Softmax with no axis attr means axis=1 with
+    flatten-to-2D semantics."""
+    n = P.node("Softmax", ["data"], ["out"], "sm")
+    g = P.graph([n], "g", [P.value_info("data", (2, 3, 4))],
+                [P.value_info("out", (2, 3, 4))], [])
+    path = str(tmp_path / "sm.onnx")
+    open(path, "wb").write(P.model(g, opset=11))
+    sym, arg_params, aux_params = import_model(path)
+    x = np.random.RandomState(4).randn(2, 3, 4).astype(np.float32)
+    out = sym.bind(mx.cpu(), {"data": nd.array(x)}).forward()[0].asnumpy()
+    flat = x.reshape(2, -1)
+    e = np.exp(flat - flat.max(axis=1, keepdims=True))
+    expect = (e / e.sum(axis=1, keepdims=True)).reshape(x.shape)
+    np.testing.assert_allclose(out, expect, rtol=1e-5, atol=1e-6)
+
+
+def test_onnx_pooling_ceil_mode_roundtrip(tmp_path):
+    """'full' pooling convention (gluon ceil_mode=True, e.g. SqueezeNet)
+    must survive as ONNX ceil_mode — losing it shrinks feature maps."""
+    data = mx.sym.var("data")
+    out = mx.sym.Pooling(data, kernel=(3, 3), stride=(2, 2),
+                         pool_type="max", pooling_convention="full",
+                         name="p0")
+    x = nd.array(np.random.RandomState(5).randn(1, 2, 8, 8)
+                 .astype(np.float32))
+    y0 = out.bind(mx.cpu(), {"data": x}).forward()[0].asnumpy()
+    assert y0.shape[-1] == 4  # ceil((8-3)/2)+1; 'valid' would give 3
+    path = export_model(out, {}, input_shape=[(1, 2, 8, 8)],
+                        onnx_file_path=str(tmp_path / "cm.onnx"))
+    g = P.parse_model(open(path, "rb").read())
+    (node,) = [n for n in g["nodes"] if n["op_type"] == "MaxPool"]
+    assert node["attrs"]["ceil_mode"] == 1
+    np.testing.assert_allclose(_forward_imported(path, x), y0, rtol=1e-6)
+
+
+def test_onnx_unsupported_op_raises(tmp_path):
+    data = mx.sym.var("data")
+    out = mx.sym.topk(data, k=2)
+    try:
+        export_model(out, {}, input_shape=[(2, 5)],
+                     onnx_file_path=str(tmp_path / "x.onnx"))
+    except NotImplementedError as e:
+        assert "topk" in str(e)
+    else:
+        raise AssertionError("expected NotImplementedError")
