@@ -12,6 +12,7 @@ for true fp16 use, with dynamic scaling semantics preserved.
 """
 from __future__ import annotations
 
+import contextlib
 import logging
 
 import numpy as _np
@@ -59,15 +60,19 @@ def init_trainer(trainer):
     return trainer
 
 
+@contextlib.contextmanager
 def scale_loss(loss, trainer):
-    """Context/identity: with bf16 there is no scaling; matches reference
-    semantics when scale == 1."""
+    """Context manager yielding the scaled loss (reference amp.py:
+    ``with amp.scale_loss(loss, trainer) as scaled: scaled.backward()``).
+    With bf16 the scale is 1 and this is the identity."""
     scaler = getattr(trainer, "_amp_loss_scaler", None)
     if scaler is None or scaler.loss_scale == 1.0:
-        return loss
+        yield loss
+        return
     if isinstance(loss, (list, tuple)):
-        return [l * scaler.loss_scale for l in loss]
-    return loss * scaler.loss_scale
+        yield [l * scaler.loss_scale for l in loss]
+    else:
+        yield loss * scaler.loss_scale
 
 
 def unscale(optimizer_or_trainer):

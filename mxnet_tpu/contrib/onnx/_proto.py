@@ -214,12 +214,14 @@ def parse_tensor(buf):
     name = f.get(8, [b""])[0].decode("utf-8")
     if 9 in f:
         arr = _np.frombuffer(f[9][0], dtype=dtype).reshape(dims).copy()
-    elif 4 in f:  # float_data (packed or repeated)
-        raw = f[4][0] if isinstance(f[4][0], bytes) else None
-        if raw is not None:
-            arr = _np.frombuffer(raw, dtype="<f4").reshape(dims).copy()
-        else:
-            arr = _np.array(f[4], dtype=_np.float32).reshape(dims)
+    elif 4 in f:  # float_data — packed chunks and/or unpacked fixed32
+        vals = []
+        for item in f[4]:
+            if isinstance(item, bytes):
+                vals.extend(_np.frombuffer(item, "<f4").tolist())
+            else:  # wire-type-5 value: raw uint32 bit pattern
+                vals.append(struct.unpack("<f", struct.pack("<I", item))[0])
+        arr = _np.array(vals, dtype=_np.float32).reshape(dims)
     elif 7 in f:  # int64_data
         vals = []
         for item in f[7]:

@@ -31,6 +31,7 @@ class _Ctx:
         self.initializers = []
         self.counter = 0
         self.params = params or {}
+        self.skip_params = set()  # graph vars replaced by a converter
 
     def const(self, name, arr):
         self.initializers.append(P.tensor_proto(name, arr))
@@ -82,6 +83,7 @@ def _bn(ctx, name, ins, kw):
                 "cannot export fix_gamma BatchNorm %s: gamma %r is not a "
                 "bound parameter" % (name, ins[1]))
         shape = gamma.shape if hasattr(gamma, "shape") else (len(gamma),)
+        ctx.skip_params.add(ins[1])  # stored gamma is dead in the graph
         ins[1] = ctx.const(name + "_fixed_gamma",
                            _np.ones(shape, _np.float32))
     ctx.add("BatchNormalization", ins, [name], name,
@@ -120,7 +122,14 @@ def _pooling(ctx, name, ins, kw):
 
 
 def _softmax(ctx, name, ins, kw):
-    ctx.add("Softmax", [ins[0]], [name], name, axis=int(kw.get("axis", -1)))
+    axis = int(kw.get("axis", -1))
+    if axis != -1:
+        # opset-11 Softmax attr means "flatten [axis..n)" — only the
+        # last-axis case coincides with mxnet's per-axis semantics
+        raise NotImplementedError(
+            "opset-11 ONNX export supports softmax over the last axis "
+            "only (node %s has axis=%d)" % (name, axis))
+    ctx.add("Softmax", [ins[0]], [name], name, axis=-1)
 
 
 def _dropout(ctx, name, ins, kw):
@@ -249,14 +258,13 @@ def export_model(sym, params, input_shape=None, input_type=_np.float32,
     inputs = []
     shapes_in = list(input_shape or [])
 
+    pending_params = []  # emitted after the walk; converters may replace
     for n in nodes:
         name = n._name or "node%d" % ctx.counter
         ctx.counter += 1
         if n._op is None:
             if n._name in params:
-                arr = params[n._name]
-                arr = arr.asnumpy() if hasattr(arr, "asnumpy") else arr
-                ctx.const(n._name, arr)
+                pending_params.append(n._name)
             else:
                 shape = shapes_in.pop(0) if shapes_in else (1,)
                 inputs.append(P.value_info(
@@ -276,6 +284,12 @@ def export_model(sym, params, input_shape=None, input_type=_np.float32,
                 ins.append(out_names[(id(p[0]), p[1])])
         conv(ctx, name, ins, n._kwargs)
         out_names[(id(n), 0)] = name
+
+    for pname in pending_params:
+        if pname in ctx.skip_params:
+            continue
+        arr = params[pname]
+        ctx.const(pname, arr.asnumpy() if hasattr(arr, "asnumpy") else arr)
 
     outputs = []
     try:

@@ -21,6 +21,14 @@ def _attr_pad(pads):
     return tuple(pads[:n])
 
 
+def _check_no_auto_pad(a, name):
+    ap = a.get("auto_pad")
+    if ap and ap != "NOTSET":
+        raise NotImplementedError(
+            "auto_pad=%r on node %s is not supported; re-export the model "
+            "with explicit pads" % (ap, name))
+
+
 def import_model(model_file):
     """Load an ONNX model file -> (sym, arg_params, aux_params)
     (reference onnx2mx/import_model.py:30)."""
@@ -34,8 +42,6 @@ def import_model(model_file):
     values = {}          # onnx tensor name -> Symbol
     consumed_as_attr = set()
     arg_params, aux_params = {}, {}
-
-    input_names = [n for n, _, _ in g["inputs"] if n not in inits]
 
     def val(name):
         if name in values:
@@ -55,6 +61,7 @@ def import_model(model_file):
         name = node["name"] or out
 
         if op == "Conv":
+            _check_no_auto_pad(a, name)
             kernel = tuple(a.get("kernel_shape"))
             sym = S.Convolution(
                 val(ins[0]), *[val(i) for i in ins[1:]],
@@ -66,6 +73,7 @@ def import_model(model_file):
                 num_group=int(a.get("group", 1)),
                 no_bias=len(ins) < 3, name=name)
         elif op == "ConvTranspose":
+            _check_no_auto_pad(a, name)
             kernel = tuple(a.get("kernel_shape"))
             sym = S.Deconvolution(
                 val(ins[0]), *[val(i) for i in ins[1:]],
@@ -118,6 +126,7 @@ def import_model(model_file):
             sym = S.LeakyReLU(val(ins[0]), val(ins[1]), act_type="prelu",
                               name=name)
         elif op in ("MaxPool", "AveragePool"):
+            _check_no_auto_pad(a, name)
             kernel = tuple(a.get("kernel_shape"))
             sym = S.Pooling(
                 val(ins[0]), kernel=kernel,
@@ -133,11 +142,14 @@ def import_model(model_file):
                             pool_type="max" if op == "GlobalMaxPool"
                             else "avg", name=name)
         elif op == "Softmax":
-            if "axis" in a:
-                sym = S.softmax(val(ins[0]), axis=int(a["axis"]), name=name)
+            # opset<=12 semantics: coerce dims [axis..n) into ONE block and
+            # normalize jointly (default axis=1). axis=-1 degenerates to a
+            # plain last-axis softmax.
+            axis = int(a.get("axis", 1))
+            if axis == -1:
+                sym = S.softmax(val(ins[0]), axis=-1, name=name)
             else:
-                # opset<=12 default: axis=1 with flatten-to-2D semantics
-                flat = S.reshape(val(ins[0]), shape=(0, -1),
+                flat = S.reshape(val(ins[0]), shape=(0,) * axis + (-1,),
                                  name=name + "_flat2d")
                 soft = S.softmax(flat, axis=-1, name=name + "_sm")
                 sym = S.reshape_like(soft, val(ins[0]), name=name)

@@ -115,6 +115,7 @@ def test_onnx_fix_gamma_exports_ones(tmp_path):
     g = P.parse_model(open(path, "rb").read())
     fixed = [a for n, a in g["initializers"].items() if "fixed_gamma" in n]
     assert fixed and np.all(fixed[0] == 1.0)
+    assert "bn0_gamma" not in g["initializers"]  # dead tensor not exported
     x = nd.array(np.random.RandomState(3).randn(1, 3, 4, 4)
                  .astype(np.float32))
     y_src = out.bind(mx.cpu(), dict(params, data=x)).forward()[0].asnumpy()
@@ -137,6 +138,51 @@ def test_onnx_softmax_default_axis_flatten_semantics(tmp_path):
     e = np.exp(flat - flat.max(axis=1, keepdims=True))
     expect = (e / e.sum(axis=1, keepdims=True)).reshape(x.shape)
     np.testing.assert_allclose(out, expect, rtol=1e-5, atol=1e-6)
+
+
+def test_onnx_softmax_explicit_axis_coerce_semantics(tmp_path):
+    """opset-11 Softmax(axis=1) on a 3-D tensor normalizes jointly over
+    the flattened trailing block, not per-axis."""
+    n = P.node("Softmax", ["data"], ["out"], "sm", axis=1)
+    g = P.graph([n], "g", [P.value_info("data", (2, 3, 4))],
+                [P.value_info("out", (2, 3, 4))], [])
+    path = str(tmp_path / "sm1.onnx")
+    open(path, "wb").write(P.model(g, opset=11))
+    sym, _, _ = import_model(path)
+    x = np.random.RandomState(6).randn(2, 3, 4).astype(np.float32)
+    out = sym.bind(mx.cpu(), {"data": nd.array(x)}).forward()[0].asnumpy()
+    flat = x.reshape(2, -1)
+    e = np.exp(flat - flat.max(axis=1, keepdims=True))
+    expect = (e / e.sum(axis=1, keepdims=True)).reshape(x.shape)
+    np.testing.assert_allclose(out, expect, rtol=1e-5, atol=1e-6)
+
+
+def test_onnx_unpacked_float_data_tensor(tmp_path):
+    """TensorProto float_data in unpacked repeated encoding (wire type 5)
+    must be bit-reinterpreted, not value-cast."""
+    import struct as st
+    body = P.w_packed_int64(1, (2,)) + P.w_varint(2, P.FLOAT)
+    body += P.w_bytes(8, "w")
+    for v in (1.0, 2.5):
+        body += P._tag(4, 5) + st.pack("<f", v)
+    name, arr = P.parse_tensor(body)
+    np.testing.assert_allclose(arr, np.float32([1.0, 2.5]))
+
+
+def test_onnx_auto_pad_rejected(tmp_path):
+    n = P.node("Conv", ["data", "w"], ["out"], "c0",
+               kernel_shape=[3, 3], auto_pad="SAME_UPPER")
+    g = P.graph([n], "g", [P.value_info("data", (1, 1, 4, 4))],
+                [P.value_info("out", (1, 1, 4, 4))],
+                [P.tensor_proto("w", np.zeros((1, 1, 3, 3), np.float32))])
+    path = str(tmp_path / "ap.onnx")
+    open(path, "wb").write(P.model(g, opset=11))
+    try:
+        import_model(path)
+    except NotImplementedError as e:
+        assert "auto_pad" in str(e)
+    else:
+        raise AssertionError("expected NotImplementedError for auto_pad")
 
 
 def test_onnx_pooling_ceil_mode_roundtrip(tmp_path):
