@@ -57,6 +57,7 @@ _LAZY = {
     "engine": ".engine",
     "contrib": ".contrib",
     "amp": ".contrib.amp",
+    "config": ".config",
     "model": ".model",
     "operator": ".operator",
     "rnn": ".rnn",

@@ -1,0 +1,180 @@
+"""Environment-variable configuration system.
+
+Parity surface: the reference's ~58 documented ``MXNET_*`` knobs
+(reference ``docs/static_site/src/pages/api/faq/env_var.md``). Every
+documented name is registered here with its reference default and its
+disposition on this TPU stack:
+
+- ``wired``      — read and honored by a subsystem in this codebase
+- ``subsumed``   — the concern is owned by XLA/PJRT (schedulers, memory
+                   pools, kernel autotuning, fusion): setting it is
+                   accepted and recorded but has no separate effect,
+                   because there is no hand-rolled engine to tune
+- ``n/a``        — CUDA/MKLDNN/Cython specifics with no TPU counterpart
+
+Use :func:`get` for typed reads, :func:`describe` for the full table
+(the runtime analogue of the reference doc page).
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = ["get", "set", "describe", "KNOBS"]
+
+
+class Knob:
+    __slots__ = ("name", "default", "typ", "disposition", "doc")
+
+    def __init__(self, name, default, typ, disposition, doc):
+        self.name = name
+        self.default = default
+        self.typ = typ
+        self.disposition = disposition
+        self.doc = doc
+
+
+def _k(name, default, typ, disp, doc):
+    return name, Knob(name, default, typ, disp, doc)
+
+
+KNOBS = dict([
+    # ---- wired ------------------------------------------------------------
+    _k("MXNET_ENGINE_TYPE", "ThreadedEnginePerDevice", str, "wired",
+       "NaiveEngine = blocking dispatch for debugging (engine.py)"),
+    _k("MXNET_CPU_WORKER_NTHREADS", 1, int, "wired",
+       "host-side worker threads: DataLoader default num_workers and the "
+       "native IO pump decode pool"),
+    _k("MXNET_EXEC_BULK_EXEC_MAX_NODE_TRAIN", 15, int, "wired",
+       "bulk-dispatch span size hint (engine.py bulk context)"),
+    _k("MXNET_PROFILER_AUTOSTART", 0, int, "wired",
+       "start the profiler at import (profiler.py)"),
+    _k("MXNET_PROFILER_MODE", 0, int, "wired",
+       "profile symbolic-only (0) or all (1) operators"),
+    _k("MXNET_UPDATE_ON_KVSTORE", 0, int, "wired",
+       "run the optimizer inside the kvstore (model._create_kvstore)"),
+    _k("MXNET_GLUON_REPO", "https://apache-mxnet.s3-accelerate."
+       "dualstack.amazonaws.com/", str, "wired",
+       "base URL for model-zoo/dataset downloads (no egress here: used "
+       "only to compute cache paths)"),
+    _k("MXNET_HOME", os.path.join(os.path.expanduser("~"), ".mxnet"), str,
+       "wired", "cache directory for datasets and model parameters"),
+    _k("MXNET_ENFORCE_DETERMINISM", 0, int, "wired",
+       "XLA on TPU is deterministic given fixed seeds; flag recorded and "
+       "surfaced via runtime features"),
+    _k("MXNET_SAFE_ACCUMULATION", 0, int, "wired",
+       "bf16 matmuls already accumulate in fp32 on the MXU; reductions "
+       "here run in fp32 — flag accepted for script parity"),
+    # ---- subsumed by XLA/PJRT --------------------------------------------
+    _k("MXNET_EXEC_BULK_EXEC_INFERENCE", 1, int, "subsumed",
+       "XLA compiles whole programs; bulking is implicit"),
+    _k("MXNET_EXEC_BULK_EXEC_TRAIN", 1, int, "subsumed",
+       "XLA compiles whole programs; bulking is implicit"),
+    _k("MXNET_EXEC_BULK_EXEC_MAX_NODE_TRAIN_FWD", -1, int, "subsumed",
+       "see MXNET_EXEC_BULK_EXEC_TRAIN"),
+    _k("MXNET_EXEC_BULK_EXEC_MAX_NODE_TRAIN_BWD", -1, int, "subsumed",
+       "see MXNET_EXEC_BULK_EXEC_TRAIN"),
+    _k("MXNET_EXEC_ENABLE_INPLACE", True, bool, "subsumed",
+       "XLA buffer assignment + donation owns aliasing"),
+    _k("MXNET_EXEC_NUM_TEMP", 1, int, "subsumed",
+       "workspace memory is planned by XLA"),
+    _k("MXNET_BACKWARD_DO_MIRROR", 0, int, "subsumed",
+       "rematerialization = jax.checkpoint/remat policies"),
+    _k("MXNET_ELIMINATE_COMMON_EXPR", 1, int, "subsumed", "XLA CSE pass"),
+    _k("MXNET_USE_FUSION", 1, int, "subsumed", "XLA fusion pass"),
+    _k("MXNET_FUSION_VERBOSE", 0, int, "subsumed",
+       "use XLA_FLAGS dumping instead"),
+    _k("MXNET_SUBGRAPH_BACKEND", "NONE", str, "subsumed",
+       "one compiler backend (XLA); partitioning is internal"),
+    _k("MXNET_GPU_MEM_POOL_TYPE", "Naive", str, "subsumed",
+       "PJRT owns the device allocator"),
+    _k("MXNET_GPU_MEM_POOL_RESERVE", 5, int, "subsumed",
+       "PJRT owns the device allocator"),
+    _k("MXNET_GPU_MEM_LARGE_ALLOC_ROUND_SIZE", 2 * 1024 * 1024, int,
+       "subsumed", "PJRT owns the device allocator"),
+    _k("MXNET_GPU_MEM_POOL_ROUND_LINEAR_CUTOFF", 24, int, "subsumed",
+       "PJRT owns the device allocator"),
+    _k("MXNET_GPU_WORKER_NTHREADS", 2, int, "subsumed",
+       "PJRT stream executor owns device queues"),
+    _k("MXNET_GPU_WORKER_NSTREAMS", 1, int, "subsumed",
+       "PJRT stream executor owns device queues"),
+    _k("MXNET_GPU_COPY_NTHREADS", 2, int, "subsumed",
+       "PJRT owns transfer streams"),
+    _k("MXNET_CPU_PRIORITY_NTHREADS", 4, int, "subsumed",
+       "no priority op queue; XLA program order"),
+    _k("MXNET_CPU_TEMP_COPY", 4, int, "subsumed", "PJRT transfer path"),
+    _k("MXNET_GPU_TEMP_COPY", 1, int, "subsumed", "PJRT transfer path"),
+    _k("MXNET_CPU_PARALLEL_RAND_COPY", 1, int, "subsumed",
+       "PJRT transfer path"),
+    _k("MXNET_GPU_PARALLEL_RAND_COPY", 4, int, "subsumed",
+       "PJRT transfer path"),
+    _k("MXNET_CPU_PARALLEL_COPY_SIZE", 200000, int, "subsumed",
+       "PJRT transfer path"),
+    _k("MXNET_OPTIMIZER_AGGREGATION_SIZE", 4, int, "subsumed",
+       "optimizer updates are fused into the jitted step"),
+    _k("MXNET_KVSTORE_REDUCTION_NTHREADS", 4, int, "subsumed",
+       "reductions ride XLA collectives"),
+    _k("MXNET_KVSTORE_BIGARRAY_BOUND", 1000000, int, "subsumed",
+       "no key sharding: one collective per tensor"),
+    _k("MXNET_KVSTORE_USETREE", 0, int, "subsumed",
+       "ICI torus topology handled by the XLA collective scheduler"),
+    _k("MXNET_KVSTORE_LOGTREE", 0, int, "subsumed", "see USETREE"),
+    _k("MXNET_KVSTORE_TREE_ARRAY_BOUND", 10000000, int, "subsumed",
+       "see USETREE"),
+    _k("MXNET_KVSTORE_TREE_BACKTRACK", 0, int, "subsumed", "see USETREE"),
+    _k("MXNET_KVSTORE_TREE_LINK_USAGE_PENALTY", 0.7, float, "subsumed",
+       "see USETREE"),
+    # ---- n/a (CUDA / MKLDNN / Cython specifics) ---------------------------
+    _k("MXNET_CUDNN_AUTOTUNE_DEFAULT", 1, int, "n/a",
+       "XLA autotunes TPU kernels"),
+    _k("MXNET_CUDA_ALLOW_TENSOR_CORE", 1, int, "n/a",
+       "MXU bf16 is the native path"),
+    _k("MXNET_CUDA_TENSOR_OP_MATH_ALLOW_CONVERSION", 0, int, "n/a",
+       "use amp bf16 policies"),
+    _k("MXNET_CUDA_LIB_CHECKING", 1, int, "n/a", "no CUDA libs"),
+    _k("MXNET_CUDNN_LIB_CHECKING", 1, int, "n/a", "no cuDNN"),
+    _k("MXNET_GPU_CUDNN_DROPOUT_STATE_COPY", 0, int, "n/a",
+       "RNG keys are functional state here"),
+    _k("MXNET_ENABLE_GPU_P2P", 1, int, "n/a", "ICI mesh instead of P2P"),
+    _k("MXNET_CPU_NNPACK_NTHREADS", 4, int, "n/a", "no NNPACK"),
+    _k("MXNET_MKLDNN_ENABLED", 1, int, "n/a", "no MKLDNN"),
+    _k("MXNET_MKLDNN_CACHE_NUM", -1, int, "n/a", "no MKLDNN"),
+    _k("MXNET_ENABLE_CYTHON", 1, int, "n/a", "pure python frontend"),
+    _k("MXNET_ENFORCE_CYTHON", 0, int, "n/a", "pure python frontend"),
+    _k("MXNET_LIBRARY_PATH", "", str, "n/a",
+       "no dlopen'd accelerator libs; custom kernels register via "
+       "mx.operator.register_op"),
+    _k("MXNET_MP_WORKER_NTHREADS", 1, int, "wired",
+       "worker threads per DataLoader worker (thread pool, not fork)"),
+    _k("MXNET_MP_OPENCV_NUM_THREADS", 0, int, "n/a", "no OpenCV"),
+])
+
+
+def get(name, default=None):
+    """Typed env read. Unknown names fall back to raw os.environ access
+    (reference behavior: any MXNET_* var can be probed)."""
+    knob = KNOBS.get(name)
+    raw = os.environ.get(name)
+    if knob is None:
+        return raw if raw is not None else default
+    if raw is None:
+        return knob.default if default is None else default
+    if knob.typ is bool:
+        return raw.lower() in ("1", "true", "yes", "on")
+    try:
+        return knob.typ(raw)
+    except (TypeError, ValueError):
+        return knob.default
+
+
+def set(name, value):  # noqa: A001  (parity with reference os.environ use)
+    os.environ[name] = str(value)
+
+
+def describe():
+    """Render the knob table (name, disposition, current, doc)."""
+    lines = ["%-44s %-9s %-22s %s" % ("name", "status", "value", "doc")]
+    for name in sorted(KNOBS):
+        k = KNOBS[name]
+        lines.append("%-44s %-9s %-22r %s"
+                     % (name, k.disposition, get(name), k.doc[:60]))
+    return "\n".join(lines)

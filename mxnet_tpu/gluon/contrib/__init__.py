@@ -1,0 +1,5 @@
+"""gluon.contrib (reference ``python/mxnet/gluon/contrib/__init__.py``):
+experimental layers, cells, and the Estimator fit API."""
+from . import nn
+from . import rnn
+from . import estimator

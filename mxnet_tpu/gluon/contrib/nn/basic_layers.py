@@ -1,0 +1,151 @@
+"""gluon.contrib.nn layers.
+
+Parity surface: reference
+``python/mxnet/gluon/contrib/nn/basic_layers.py`` — Concurrent :31,
+HybridConcurrent :64, Identity :97, SparseEmbedding :118,
+SyncBatchNorm :165, PixelShuffle1D/2D/3D :244-:354.
+
+TPU notes: SparseEmbedding's row_sparse gradient is a host-framework
+trick for huge tables on CPU parameter servers; here it is the dense
+Embedding (XLA gathers are fast, and sharded tables ride the mesh — see
+mxnet_tpu.parallel). SyncBatchNorm's cross-device statistics come for
+free inside an SPMD step (the batch axis is already global), so it is
+BatchNorm with the same extended signature.
+"""
+from __future__ import annotations
+
+from ...block import HybridBlock
+from ...nn.basic_layers import (Sequential, HybridSequential, Embedding,
+                                BatchNorm)
+
+__all__ = ["Concurrent", "HybridConcurrent", "Identity", "SparseEmbedding",
+           "SyncBatchNorm", "PixelShuffle1D", "PixelShuffle2D",
+           "PixelShuffle3D"]
+
+
+class Concurrent(Sequential):
+    """Run children on the same input, concat outputs (reference :31)."""
+
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.axis = axis
+
+    def forward(self, x):
+        from .... import ndarray as F
+        out = [block(x) for block in self._children.values()]
+        return F.concat(*out, dim=self.axis)
+
+
+class HybridConcurrent(HybridSequential):
+    """Hybridizable Concurrent (reference :64)."""
+
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.axis = axis
+
+    def hybrid_forward(self, F, x):
+        out = [block(x) for block in self._children.values()]
+        return F.concat(*out, dim=self.axis)
+
+
+class Identity(HybridBlock):
+    """Identity mapping, useful in Concurrent skip branches
+    (reference :97)."""
+
+    def hybrid_forward(self, F, x):
+        return x
+
+
+class SparseEmbedding(Embedding):
+    """API shell over dense Embedding (reference :118 used
+    sparse_grad row_sparse storage; see module docstring)."""
+
+    def __init__(self, input_dim, output_dim, dtype="float32",
+                 weight_initializer=None, **kwargs):
+        super().__init__(input_dim, output_dim, dtype=dtype,
+                         weight_initializer=weight_initializer, **kwargs)
+
+    def __repr__(self):
+        return "SparseEmbedding(%d -> %d)" % (self._input_dim,
+                                              self._output_dim)
+
+
+class SyncBatchNorm(BatchNorm):
+    """Cross-device BatchNorm (reference :165). Under SPMD the normalizing
+    statistics are computed over the global batch inside the compiled
+    step, so the base implementation already synchronizes; num_devices/
+    ndev and key are accepted for API parity."""
+
+    def __init__(self, in_channels=0, num_devices=None, momentum=0.9,
+                 epsilon=1e-5, center=True, scale=True,
+                 use_global_stats=False, beta_initializer="zeros",
+                 gamma_initializer="ones",
+                 running_mean_initializer="zeros",
+                 running_variance_initializer="ones", **kwargs):
+        super().__init__(axis=1, momentum=momentum, epsilon=epsilon,
+                         center=center, scale=scale,
+                         use_global_stats=use_global_stats,
+                         beta_initializer=beta_initializer,
+                         gamma_initializer=gamma_initializer,
+                         running_mean_initializer=running_mean_initializer,
+                         running_variance_initializer=
+                         running_variance_initializer,
+                         in_channels=in_channels, **kwargs)
+        self._num_devices = num_devices
+
+
+class _PixelShuffle(HybridBlock):
+    def __init__(self, factor, ndim, **kwargs):
+        super().__init__(**kwargs)
+        self._ndim = ndim
+        if isinstance(factor, int):
+            factor = (factor,) * ndim
+        self._factors = tuple(int(f) for f in factor)
+
+    def __repr__(self):
+        return "%s(factors=%s)" % (type(self).__name__, (self._factors,))
+
+
+class PixelShuffle1D(_PixelShuffle):
+    """(N, C*f, W) -> (N, C, W*f) sub-pixel upscale (reference :244)."""
+
+    def __init__(self, factor, **kwargs):
+        super().__init__(factor, 1, **kwargs)
+
+    def hybrid_forward(self, F, x):
+        (f,) = self._factors
+        x = F.reshape(x, shape=(0, -4, -1, f, 0))   # (N, C, f, W)
+        x = F.transpose(x, axes=(0, 1, 3, 2))       # (N, C, W, f)
+        return F.reshape(x, shape=(0, 0, -3))       # (N, C, W*f)
+
+
+class PixelShuffle2D(_PixelShuffle):
+    """(N, C*f1*f2, H, W) -> (N, C, H*f1, W*f2) (reference :292)."""
+
+    def __init__(self, factor, **kwargs):
+        super().__init__(factor, 2, **kwargs)
+
+    def hybrid_forward(self, F, x):
+        f1, f2 = self._factors
+        x = F.reshape(x, shape=(0, -4, -1, f1 * f2, 0, 0))
+        x = F.reshape(x, shape=(0, 0, -4, f1, f2, 0, 0))
+        x = F.transpose(x, axes=(0, 1, 4, 2, 5, 3))
+        x = F.reshape(x, shape=(0, 0, -3, -3))
+        return x
+
+
+class PixelShuffle3D(_PixelShuffle):
+    """(N, C*f1*f2*f3, D, H, W) -> (N, C, D*f1, H*f2, W*f3)
+    (reference :354)."""
+
+    def __init__(self, factor, **kwargs):
+        super().__init__(factor, 3, **kwargs)
+
+    def hybrid_forward(self, F, x):
+        f1, f2, f3 = self._factors
+        x = F.reshape(x, shape=(0, -4, -1, f1 * f2 * f3, 0, 0, 0))
+        x = F.reshape(x, shape=(0, 0, -4, f1, f2 * f3, 0, 0, 0))
+        x = F.reshape(x, shape=(0, 0, 0, -4, f2, f3, 0, 0, 0))
+        x = F.transpose(x, axes=(0, 1, 5, 2, 6, 3, 7, 4))
+        x = F.reshape(x, shape=(0, 0, -3, -3, -3))
+        return x

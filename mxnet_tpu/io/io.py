@@ -488,8 +488,12 @@ class ImageRecordIter(DataIter):
     def __init__(self, path_imgrec, data_shape, batch_size=1,
                  label_width=1, shuffle=False, mean_r=0.0, mean_g=0.0,
                  mean_b=0.0, std_r=1.0, std_g=1.0, std_b=1.0,
-                 rand_crop=False, rand_mirror=False, preprocess_threads=4,
+                 rand_crop=False, rand_mirror=False, preprocess_threads=None,
                  prefetch_buffer=4, **kwargs):
+        if preprocess_threads is None:
+            import os as _os
+            env = _os.environ.get("MXNET_CPU_WORKER_NTHREADS")
+            preprocess_threads = int(env) if env else 4
         super().__init__(batch_size)
         # native C++ pipeline (src/io/pump.cc): threaded decode+augment and
         # double-buffered prefetch, GIL-free — used when the library is

@@ -1,14 +1,18 @@
-"""Checkpoint helpers + legacy kvstore-placement logic (reference
+"""Checkpoint helpers, legacy kvstore placement, and FeedForward (reference
 ``python/mxnet/model.py``: save_checkpoint, load_checkpoint,
-_create_kvstore :95 and the BatchEndParam consumed by callbacks)."""
+_create_kvstore :95, the BatchEndParam consumed by callbacks, and the
+pre-Module FeedForward estimator :472-:1036)."""
 from __future__ import annotations
 
 import logging
 
+import numpy as _np
+
 from . import symbol as sym_mod
 from .ndarray import ndarray as _nd
 
-__all__ = ["save_checkpoint", "load_checkpoint", "BatchEndParam"]
+__all__ = ["save_checkpoint", "load_checkpoint", "BatchEndParam",
+           "FeedForward"]
 
 from .callback import BatchEndParam  # noqa: F401  (re-export, ref model.py:69)
 
@@ -47,7 +51,8 @@ def _create_kvstore(kvstore, num_device, arg_params):
     update always runs on-worker; a store is only created for multi-device
     aggregation or dist modes."""
     from . import kvstore as kvs
-    update_on_kvstore = False
+    from . import config
+    update_on_kvstore = bool(config.get("MXNET_UPDATE_ON_KVSTORE"))
     if kvstore is None:
         kv = None
     elif isinstance(kvstore, kvs.KVStore):
@@ -59,4 +64,166 @@ def _create_kvstore(kvstore, num_device, arg_params):
             kv = kvs.create(kvstore)
     else:
         raise TypeError("kvstore must be KVStore, str or None")
+    if kv is None:
+        update_on_kvstore = False  # no store to run the update on
     return (kv, update_on_kvstore)
+
+
+class FeedForward:
+    """The legacy pre-Module estimator (reference ``model.py:472``): wraps
+    symbol + params with sklearn-style fit/predict/score. Internally this
+    drives a Module (exactly how the reference's own docs recommend
+    migrating), so the compiled-executor path is shared."""
+
+    def __init__(self, symbol, ctx=None, num_epoch=None, epoch_size=None,
+                 optimizer="sgd", initializer=None, numpy_batch_size=128,
+                 arg_params=None, aux_params=None, allow_extra_params=False,
+                 begin_epoch=0, **kwargs):
+        from . import initializer as init_mod
+        self.symbol = symbol
+        self.ctx = ctx if isinstance(ctx, (list, tuple)) else \
+            [ctx] if ctx is not None else None
+        self.num_epoch = num_epoch
+        self.epoch_size = epoch_size
+        self.optimizer = optimizer
+        self.initializer = initializer or init_mod.Uniform(0.01)
+        self.numpy_batch_size = numpy_batch_size
+        self.arg_params = arg_params
+        self.aux_params = aux_params
+        self.allow_extra_params = allow_extra_params
+        self.begin_epoch = begin_epoch
+        self.kwargs = dict(kwargs)
+        self._module = None
+
+    # ---- data plumbing ----------------------------------------------------
+    def _as_iter(self, X, y=None, batch_size=None, shuffle=False):
+        from . import io as io_mod
+        if hasattr(X, "provide_data"):
+            return X
+        batch_size = batch_size or self.numpy_batch_size
+        X = X.asnumpy() if hasattr(X, "asnumpy") else _np.asarray(X)
+        if y is not None:
+            y = y.asnumpy() if hasattr(y, "asnumpy") else _np.asarray(y)
+        return io_mod.NDArrayIter(X, y, batch_size=batch_size,
+                                  shuffle=shuffle)
+
+    def _init_module(self, data_iter, for_training=True):
+        from .module import Module
+
+        def _name(desc):
+            return desc[0] if isinstance(desc, (tuple, list)) \
+                else getattr(desc, "name", desc)
+
+        # names come from the iterator (reference FeedForward derives them
+        # from X), restricted to what the symbol actually declares
+        sym_args = set(self.symbol.list_arguments())
+        data_names = tuple(_name(d) for d in data_iter.provide_data)
+        provide_label = getattr(data_iter, "provide_label", None) or []
+        label_names = tuple(n for n in (_name(l) for l in provide_label)
+                            if n in sym_args)
+        self._module = Module(self.symbol, data_names=data_names,
+                              label_names=label_names or None,
+                              context=self.ctx)
+        label_shapes = [l for l in provide_label
+                        if _name(l) in label_names] or None
+        self._module.bind(data_shapes=data_iter.provide_data,
+                          label_shapes=label_shapes,
+                          for_training=for_training)
+        self._module.init_params(initializer=self.initializer,
+                                 arg_params=self.arg_params,
+                                 aux_params=self.aux_params,
+                                 allow_missing=self.arg_params is not None,
+                                 allow_extra=self.allow_extra_params)
+        return self._module
+
+    # ---- estimator API ----------------------------------------------------
+    def fit(self, X, y=None, eval_data=None, eval_metric="acc",
+            epoch_end_callback=None, batch_end_callback=None,
+            kvstore="local", logger=None, work_load_list=None, monitor=None,
+            eval_end_callback=None, eval_batch_end_callback=None):
+        """reference model.py:793 FeedForward.fit."""
+        train_iter = self._as_iter(X, y, shuffle=True)
+        mod = self._init_module(train_iter)
+        if logger is not None:
+            mod.logger = logger
+        if eval_data is not None and not hasattr(eval_data, "provide_data"):
+            eval_data = self._as_iter(eval_data[0], eval_data[1])
+        mod.fit(train_iter, eval_data=eval_data, eval_metric=eval_metric,
+                epoch_end_callback=epoch_end_callback,
+                batch_end_callback=batch_end_callback, kvstore=kvstore,
+                optimizer=self.optimizer,
+                optimizer_params=dict(self.kwargs),
+                eval_end_callback=eval_end_callback,
+                eval_batch_end_callback=eval_batch_end_callback,
+                begin_epoch=self.begin_epoch,
+                num_epoch=self.num_epoch or 1, monitor=monitor)
+        self.arg_params, self.aux_params = mod.get_params()
+        return self
+
+    def predict(self, X, num_batch=None, return_data=False, reset=True):
+        """reference model.py:607 — forward over X, concatenated numpy."""
+        # loss-layer symbols (SoftmaxOutput etc.) keep a label input; feed
+        # blank labels for inference, as the reference executor does
+        needs_label = any(n.endswith("label")
+                          for n in self.symbol.list_arguments())
+        y = None
+        if needs_label and not hasattr(X, "provide_data"):
+            Xa = X.asnumpy() if hasattr(X, "asnumpy") else _np.asarray(X)
+            y = _np.zeros((len(Xa),), _np.float32)
+        data_iter = self._as_iter(X, y)
+        if self._module is None or not self._module.binded:
+            mod = self._init_module(data_iter, for_training=False)
+        else:
+            mod = self._module
+        outs = mod.predict(data_iter, num_batch=num_batch)
+        if isinstance(outs, list):
+            return [o.asnumpy() for o in outs]
+        return outs.asnumpy()
+
+    def score(self, X, y=None, eval_metric="acc", num_batch=None,
+              batch_end_callback=None, reset=True):
+        """reference model.py:679."""
+        from . import metric as metric_mod
+        data_iter = self._as_iter(X, y)
+        if self._module is None or not self._module.binded:
+            mod = self._init_module(data_iter, for_training=False)
+        else:
+            mod = self._module
+        metric = metric_mod.create(eval_metric)
+        res = mod.score(data_iter, metric, num_batch=num_batch)
+        vals = [v for _, v in res]
+        return vals[0] if len(vals) == 1 else vals
+
+    # ---- persistence ------------------------------------------------------
+    def save(self, prefix, epoch=None):
+        """reference model.py:943 — prefix-symbol.json + prefix-NNNN.params."""
+        epoch = epoch if epoch is not None else (self.num_epoch or 0)
+        save_checkpoint(prefix, epoch, self.symbol, self.arg_params or {},
+                        self.aux_params or {})
+
+    @staticmethod
+    def load(prefix, epoch, ctx=None, **kwargs):
+        """reference model.py:964."""
+        symbol, arg_params, aux_params = load_checkpoint(prefix, epoch)
+        return FeedForward(symbol, ctx=ctx, arg_params=arg_params,
+                           aux_params=aux_params, begin_epoch=epoch,
+                           **kwargs)
+
+    @staticmethod
+    def create(symbol, X, y=None, ctx=None, num_epoch=None,
+               epoch_size=None, optimizer="sgd", initializer=None,
+               eval_data=None, eval_metric="acc", epoch_end_callback=None,
+               batch_end_callback=None, kvstore="local", logger=None,
+               work_load_list=None, eval_end_callback=None,
+               eval_batch_end_callback=None, **kwargs):
+        """reference model.py:996 — construct and fit in one call."""
+        model = FeedForward(symbol, ctx=ctx, num_epoch=num_epoch,
+                            epoch_size=epoch_size, optimizer=optimizer,
+                            initializer=initializer, **kwargs)
+        model.fit(X, y, eval_data=eval_data, eval_metric=eval_metric,
+                  epoch_end_callback=epoch_end_callback,
+                  batch_end_callback=batch_end_callback, kvstore=kvstore,
+                  logger=logger, work_load_list=work_load_list,
+                  eval_end_callback=eval_end_callback,
+                  eval_batch_end_callback=eval_batch_end_callback)
+        return model

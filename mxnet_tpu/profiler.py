@@ -20,6 +20,11 @@ __all__ = ["set_config", "profiler_set_config", "set_state",
 _state = {"running": False, "dir": "/tmp/mxnet_tpu_profile",
           "aggregate": defaultdict(lambda: [0, 0.0])}
 
+# MXNET_PROFILER_AUTOSTART=1 (reference env_var.md): begin profiling at
+# import and flush the trace at interpreter exit
+from . import config as _config  # noqa: E402
+_autostart_pending = bool(int(_config.get("MXNET_PROFILER_AUTOSTART")))
+
 
 def set_config(**kwargs):
     """reference profiler.py:33 — accepts the reference's kwargs
@@ -173,3 +178,9 @@ class Marker:
     def mark(self, scope="process"):
         entry = _state["aggregate"]["marker:" + self.name]
         entry[0] += 1
+
+
+if _autostart_pending:
+    import atexit
+    set_state("run")
+    atexit.register(lambda: set_state("stop"))

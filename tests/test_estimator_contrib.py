@@ -1,0 +1,227 @@
+"""Estimator API, gluon.contrib layers/cells, legacy FeedForward, and the
+MXNET_* env-knob system (reference gluon/contrib/estimator/,
+gluon/contrib/nn, model.py FeedForward, env_var.md)."""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, gluon
+from mxnet_tpu.gluon.contrib import nn as cnn
+from mxnet_tpu.gluon.contrib import rnn as crnn
+from mxnet_tpu.gluon.contrib.estimator import (
+    Estimator, EarlyStoppingHandler, CheckpointHandler, LoggingHandler,
+    EpochEnd)
+
+R = np.random.RandomState(21)
+
+
+def _toy_loader(n=64, batch=16):
+    X = nd.array(R.randn(n, 4).astype(np.float32))
+    Y = nd.array((R.randn(n) > 0).astype(np.float32))
+    return gluon.data.DataLoader(gluon.data.ArrayDataset(X, Y),
+                                 batch_size=batch)
+
+
+def _toy_net():
+    net = gluon.nn.Sequential()
+    net.add(gluon.nn.Dense(8, activation="relu"), gluon.nn.Dense(2))
+    return net
+
+
+# -------------------------------------------------------------- estimator
+
+def test_estimator_fit_epochs_runs_metrics():
+    est = Estimator(_toy_net(), loss=gluon.loss.SoftmaxCrossEntropyLoss(),
+                    metrics=mx.metric.Accuracy())
+    est.fit(train_data=_toy_loader(), epochs=2)
+    name, acc = est.train_metrics[0].get()
+    assert 0.0 <= acc <= 1.0
+    lname, lval = est.train_loss_metrics[0].get()
+    assert np.isfinite(lval)
+
+
+def test_estimator_fit_batches_stops():
+    seen = []
+
+    class CountBatches(EpochEnd):
+        def epoch_end(self, estimator, *a, **k):
+            seen.append(1)
+
+    est = Estimator(_toy_net(), loss=gluon.loss.SoftmaxCrossEntropyLoss())
+    est.fit(train_data=_toy_loader(), batches=3,
+            event_handlers=[CountBatches()])
+    assert est.stop_training
+
+
+def test_estimator_validation_handler():
+    est = Estimator(_toy_net(), loss=gluon.loss.SoftmaxCrossEntropyLoss(),
+                    metrics=mx.metric.Accuracy())
+    est.fit(train_data=_toy_loader(), val_data=_toy_loader(), epochs=1)
+    _, vloss = est.val_loss_metrics[0].get()
+    assert np.isfinite(vloss)
+
+
+def test_estimator_early_stopping():
+    est = Estimator(_toy_net(), loss=gluon.loss.SoftmaxCrossEntropyLoss(),
+                    metrics=mx.metric.Accuracy())
+    stopper = EarlyStoppingHandler(monitor=est.train_loss_metrics[0],
+                                   patience=0, mode="max", min_delta=1e9)
+    est.fit(train_data=_toy_loader(), epochs=50,
+            event_handlers=[stopper])
+    # impossible-improvement monitor -> stop after first epochs, not 50
+    assert stopper.current_epoch < 50
+
+
+def test_estimator_checkpoint_handler(tmp_path):
+    est = Estimator(_toy_net(), loss=gluon.loss.SoftmaxCrossEntropyLoss(),
+                    metrics=mx.metric.Accuracy())
+    ckpt = CheckpointHandler(str(tmp_path), model_prefix="m",
+                             max_checkpoints=2)
+    est.fit(train_data=_toy_loader(), epochs=3, event_handlers=[ckpt])
+    files = sorted(os.listdir(str(tmp_path)))
+    assert len([f for f in files if f.endswith(".params")]) == 2  # capped
+
+
+def test_estimator_rejects_non_dataloader():
+    est = Estimator(_toy_net(), loss=gluon.loss.SoftmaxCrossEntropyLoss())
+    with pytest.raises(ValueError):
+        est.fit(train_data=[1, 2, 3], epochs=1)
+    with pytest.raises(ValueError):
+        est.fit(train_data=_toy_loader())  # neither epochs nor batches
+
+
+# ---------------------------------------------------------- contrib layers
+
+def test_concurrent_and_identity():
+    net = cnn.HybridConcurrent(axis=1)
+    net.add(gluon.nn.Dense(3, in_units=4), cnn.Identity())
+    net.initialize()
+    out = net(nd.ones((2, 4)))
+    assert out.shape == (2, 7)
+    net2 = cnn.Concurrent(axis=1)
+    net2.add(gluon.nn.Dense(2, in_units=4), cnn.Identity())
+    net2.initialize()
+    assert net2(nd.ones((2, 4))).shape == (2, 6)
+
+
+def test_pixelshuffle_layers():
+    assert cnn.PixelShuffle1D(3)(nd.ones((1, 6, 5))).shape == (1, 2, 15)
+    x = nd.array(np.arange(8 * 4, dtype=np.float32).reshape(1, 8, 2, 2))
+    y = cnn.PixelShuffle2D(2)(x)
+    assert y.shape == (1, 2, 4, 4)
+    # content check vs manual depth-to-space of the first output channel
+    xn = x.asnumpy()[0]
+    expect00 = np.array([[xn[0, 0, 0], xn[1, 0, 0]],
+                         [xn[2, 0, 0], xn[3, 0, 0]]], np.float32)
+    np.testing.assert_array_equal(y.asnumpy()[0, 0, :2, :2], expect00)
+    assert cnn.PixelShuffle3D(2)(nd.ones((1, 16, 2, 2, 2))).shape == \
+        (1, 2, 4, 4, 4)
+
+
+def test_sync_batchnorm_and_sparse_embedding():
+    sb = cnn.SyncBatchNorm(in_channels=4, num_devices=8)
+    sb.initialize()
+    assert sb(nd.ones((2, 4, 3, 3))).shape == (2, 4, 3, 3)
+    emb = cnn.SparseEmbedding(10, 6)
+    emb.initialize()
+    out = emb(nd.array(np.array([1, 5], np.float32)))
+    assert out.shape == (2, 6)
+
+
+def test_lstmp_cell_projection():
+    cell = crnn.LSTMPCell(8, 4, input_size=5)
+    cell.initialize()
+    out, states = cell(nd.ones((2, 5)), cell.begin_state(2))
+    assert out.shape == (2, 4)
+    assert states[0].shape == (2, 4) and states[1].shape == (2, 8)
+
+
+def test_variational_dropout_mask_consistent():
+    base = gluon.rnn.RNNCell(4, input_size=4)
+    vd = crnn.VariationalDropoutCell(base, drop_outputs=0.5)
+    vd.initialize()
+    from mxnet_tpu import _tape
+    prev = _tape.set_training(True)
+    try:
+        states = vd.begin_state(2)
+        out1, states = vd(nd.ones((2, 4)), states)
+        mask1 = vd._output_mask.asnumpy()
+        out2, states = vd(nd.ones((2, 4)), states)
+        mask2 = vd._output_mask.asnumpy()
+        np.testing.assert_array_equal(mask1, mask2)  # same mask all steps
+        vd.reset()
+        assert vd._output_mask is None
+    finally:
+        _tape.set_training(prev)
+
+
+# ------------------------------------------------------------- FeedForward
+
+def _ff_symbol():
+    data = mx.sym.var("data")
+    fc1 = mx.sym.FullyConnected(data, num_hidden=16, name="fc1")
+    act = mx.sym.Activation(fc1, act_type="relu")
+    fc2 = mx.sym.FullyConnected(act, num_hidden=2, name="fc2")
+    return mx.sym.SoftmaxOutput(fc2, mx.sym.var("softmax_label"),
+                                name="softmax")
+
+
+def test_feedforward_fit_predict_save_load(tmp_path):
+    X = R.randn(64, 5).astype(np.float32)
+    Y = (X.sum(axis=1) > 0).astype(np.float32)
+    model = mx.model.FeedForward(_ff_symbol(), num_epoch=4,
+                                 optimizer="sgd", learning_rate=0.1,
+                                 numpy_batch_size=16)
+    model.fit(X, Y)
+    pred = model.predict(X)
+    assert pred.shape == (64, 2)
+    acc = (pred.argmax(axis=1) == Y).mean()
+    assert acc > 0.75, acc
+    prefix = str(tmp_path / "ff")
+    model.save(prefix)
+    loaded = mx.model.FeedForward.load(prefix, 4)
+    np.testing.assert_allclose(loaded.predict(X), pred, atol=1e-5)
+
+
+def test_feedforward_create():
+    X = R.randn(32, 5).astype(np.float32)
+    Y = (X.sum(axis=1) > 0).astype(np.float32)
+    model = mx.model.FeedForward.create(_ff_symbol(), X, Y, num_epoch=1,
+                                        learning_rate=0.05,
+                                        numpy_batch_size=16)
+    assert model.arg_params
+
+
+# ------------------------------------------------------------- env config
+
+def test_config_registry_covers_reference_knobs():
+    from mxnet_tpu import config
+    assert len(config.KNOBS) >= 55
+    for name in ("MXNET_ENGINE_TYPE", "MXNET_CPU_WORKER_NTHREADS",
+                 "MXNET_CUDNN_AUTOTUNE_DEFAULT", "MXNET_KVSTORE_USETREE",
+                 "MXNET_HOME"):
+        assert name in config.KNOBS
+    table = config.describe()
+    assert "MXNET_USE_FUSION" in table and "subsumed" in table
+
+
+def test_config_typed_get(monkeypatch):
+    from mxnet_tpu import config
+    monkeypatch.setenv("MXNET_CPU_WORKER_NTHREADS", "7")
+    assert config.get("MXNET_CPU_WORKER_NTHREADS") == 7
+    monkeypatch.setenv("MXNET_EXEC_ENABLE_INPLACE", "false")
+    assert config.get("MXNET_EXEC_ENABLE_INPLACE") is False
+    monkeypatch.delenv("MXNET_CPU_WORKER_NTHREADS")
+    assert config.get("MXNET_CPU_WORKER_NTHREADS") == 1  # reference default
+
+
+def test_config_update_on_kvstore(monkeypatch):
+    from mxnet_tpu.model import _create_kvstore
+    monkeypatch.setenv("MXNET_UPDATE_ON_KVSTORE", "1")
+    _, update = _create_kvstore("local", 2, {})
+    assert update is True
+    monkeypatch.delenv("MXNET_UPDATE_ON_KVSTORE")
+    _, update = _create_kvstore("local", 2, {})
+    assert update is False
