@@ -491,9 +491,9 @@ class ImageRecordIter(DataIter):
                  rand_crop=False, rand_mirror=False, preprocess_threads=None,
                  prefetch_buffer=4, **kwargs):
         if preprocess_threads is None:
-            import os as _os
-            env = _os.environ.get("MXNET_CPU_WORKER_NTHREADS")
-            preprocess_threads = int(env) if env else 4
+            from .. import config as _config
+            preprocess_threads = _config.get("MXNET_CPU_WORKER_NTHREADS",
+                                             default=4)
         super().__init__(batch_size)
         # native C++ pipeline (src/io/pump.cc): threaded decode+augment and
         # double-buffered prefetch, GIL-free — used when the library is

@@ -1,135 +1,371 @@
-"""Sparse NDArray API: row_sparse + CSR.
+"""Sparse NDArray API: row_sparse + CSR with real compressed storage.
 
 Parity surface: reference ``python/mxnet/ndarray/sparse.py`` and the
 storage-type machinery (`include/mxnet/ndarray.h:61-66` kDefaultStorage/
 kRowSparseStorage/kCSRStorage; cast_storage
-`src/operator/tensor/cast_storage.cc`).
+`src/operator/tensor/cast_storage-inl.h`; sparse dot
+`src/operator/tensor/dot-inl.h`).
 
-TPU-native design: XLA has no native sparse layouts, so sparse arrays are
-API-complete views that keep (indices, data) host/device-side and densify on
-compute — the documented dense-fallback strategy (SURVEY §5.9). Row-sparse
-gradient *semantics* (the reason MXNet has row_sparse: embedding grads) are
-preserved where they matter: optimizers take a `lazy_update` path keyed on
-rows, and kvstore row_sparse_pull is supported.
+TPU-native design: the *compressed payload is the authoritative storage* —
+``RowSparseNDArray`` holds (values[nnz_rows, ...], indices[nnz_rows]) and
+``CSRNDArray`` holds (data[nnz], indices[nnz], indptr[rows+1]) as device
+arrays. XLA has no native sparse layouts, so dense views are materialized
+lazily (one vectorized scatter) and cached; sparse-aware compute paths
+(``sparse.dot`` via gather + segment_sum, ``sparse.retain``, row-sparse
+optimizer updates) never densify. This mirrors the reference's split between
+storage (Chunk aux_data) and FComputeEx sparse kernels.
 """
 from __future__ import annotations
 
 import numpy as _np
+import jax
 import jax.numpy as jnp
 
-from .ndarray import NDArray, array, zeros as _dense_zeros
+from .ndarray import NDArray, array as _dense_array, zeros as _dense_zeros
 
-__all__ = ["CSRNDArray", "RowSparseNDArray", "csr_matrix", "row_sparse_array",
-           "zeros", "empty", "array"]
+__all__ = ["BaseSparseNDArray", "CSRNDArray", "RowSparseNDArray",
+           "csr_matrix", "row_sparse_array", "zeros", "empty", "array",
+           "dot", "retain", "add"]
+
+
+def _compress_rows(dense):
+    """dense (host or device) -> (values, indices) dropping all-zero rows."""
+    d = _np.asarray(dense)
+    nz = _np.where(d.reshape(d.shape[0], -1).any(axis=1))[0]
+    return jnp.asarray(d[nz]), jnp.asarray(nz.astype(_np.int64))
+
+
+def _compress_csr(dense):
+    d = _np.asarray(dense)
+    if d.ndim != 2:
+        raise ValueError("csr requires 2D")
+    rows, cols = _np.nonzero(d)
+    data = d[rows, cols]
+    indptr = _np.zeros(d.shape[0] + 1, dtype=_np.int64)
+    _np.add.at(indptr, rows + 1, 1)
+    indptr = _np.cumsum(indptr)
+    return (jnp.asarray(data), jnp.asarray(cols.astype(_np.int64)),
+            jnp.asarray(indptr))
 
 
 class BaseSparseNDArray(NDArray):
-    __slots__ = ()
+    """Common lazy-densify machinery. Subclasses keep compressed payloads in
+    their own slots; ``_data`` (the dense jax.Array every inherited NDArray
+    method uses) is a property that scatters on first touch and caches."""
+    __slots__ = ("_dense_cache", "_shape_", "_dtype_")
+
+    @property
+    def _data(self):
+        if self._dense_cache is None:
+            self._dense_cache = self._densify()
+        return self._dense_cache
+
+    @_data.setter
+    def _data(self, value):
+        # mutation rebind (x[:] = ..., +=): dense value becomes truth;
+        # recompress lazily on next payload access
+        self._dense_cache = value
+        self._shape_ = tuple(value.shape)
+        self._dtype_ = value.dtype
+        self._invalidate_payload()
+
+    @property
+    def shape(self):
+        return self._shape_
+
+    @property
+    def dtype(self):
+        return _np.dtype(self._dtype_)
+
+    def tostype(self, stype):
+        return _to_stype(self, stype)
+
+    def asnumpy(self):
+        return _np.asarray(self._data)
 
 
 class RowSparseNDArray(BaseSparseNDArray):
-    """Dense-backed row_sparse view: tracks .indices/.data accessors."""
-    __slots__ = ("_indices",)
+    """values[nnz_rows, cols...] + indices[nnz_rows] — reference
+    `python/mxnet/ndarray/sparse.py` RowSparseNDArray (aux kIdx)."""
+    __slots__ = ("_values", "_idx")
 
-    def __init__(self, data, indices=None, ctx=None, dtype=None):
-        super().__init__(data, ctx=ctx, dtype=dtype, stype="row_sparse")
-        if indices is None:
-            dense = _np.asarray(self._data)
-            nz = _np.where(dense.reshape(dense.shape[0], -1).any(axis=1))[0]
-            indices = nz
-        self._indices = jnp.asarray(_np.asarray(indices, dtype=_np.int64))
+    def __init__(self, values, indices, shape, ctx=None, dtype=None):
+        v = jnp.asarray(values)
+        if dtype is not None:
+            from ..base import dtype_np
+            v = v.astype(dtype_np(dtype))
+        # bypass NDArray.__init__'s dense handling: set handle slots directly
+        self._values = v
+        self._idx = jnp.asarray(indices).astype(jnp.int64)
+        self._shape_ = tuple(shape)
+        self._dtype_ = v.dtype
+        self._dense_cache = None
+        self._ctx = ctx
+        self._grad = None
+        self._grad_req = "write"
+        self._ag_node = None
+        self._stype = "row_sparse"
+
+    def _densify(self):
+        out = jnp.zeros(self._shape_, dtype=self._dtype_)
+        if self._values.shape[0] == 0:
+            return out
+        return out.at[self._idx].set(
+            self._values.astype(self._dtype_))
+
+    def _invalidate_payload(self):
+        self._values = None
+        self._idx = None
+
+    def _payload(self):
+        if self._values is None:
+            self._values, self._idx = _compress_rows(self._dense_cache)
+        return self._values, self._idx
 
     @property
     def indices(self):
-        return NDArray(self._indices)
+        return NDArray(self._payload()[1])
 
     @property
     def data(self):
-        return NDArray(jnp.take(self._data, self._indices.astype(jnp.int32), axis=0))
+        return NDArray(self._payload()[0])
 
-    def tostype(self, stype):
-        return _to_stype(self, stype)
+    def copy(self):
+        v, i = self._payload()
+        return RowSparseNDArray(v, i, self._shape_, ctx=self._ctx)
+
+    def __repr__(self):
+        return ("<RowSparseNDArray %s @%s>" %
+                (self._shape_, self.ctx))
 
 
 class CSRNDArray(BaseSparseNDArray):
-    __slots__ = ("_indptr_", "_indices_")
+    """data[nnz] + indices[nnz] + indptr[rows+1] — reference CSRNDArray
+    (aux kIndPtr/kIdx)."""
+    __slots__ = ("_csr_data", "_csr_indices", "_csr_indptr")
 
-    def __init__(self, data, indptr=None, indices=None, ctx=None, dtype=None):
-        super().__init__(data, ctx=ctx, dtype=dtype, stype="csr")
-        if indptr is None or indices is None:
-            dense = _np.asarray(self._data)
-            indptr = [0]
-            idx = []
-            for row in dense:
-                nz = _np.nonzero(row)[0]
-                idx.extend(nz.tolist())
-                indptr.append(len(idx))
-            indptr, indices = _np.array(indptr), _np.array(idx)
-        self._indptr_ = jnp.asarray(_np.asarray(indptr, dtype=_np.int64))
-        self._indices_ = jnp.asarray(_np.asarray(indices, dtype=_np.int64))
+    def __init__(self, data, indices, indptr, shape, ctx=None, dtype=None):
+        v = jnp.asarray(data)
+        if dtype is not None:
+            from ..base import dtype_np
+            v = v.astype(dtype_np(dtype))
+        self._csr_data = v
+        self._csr_indices = jnp.asarray(indices).astype(jnp.int64)
+        self._csr_indptr = jnp.asarray(indptr).astype(jnp.int64)
+        self._shape_ = tuple(shape)
+        self._dtype_ = v.dtype
+        self._dense_cache = None
+        self._ctx = ctx
+        self._grad = None
+        self._grad_req = "write"
+        self._ag_node = None
+        self._stype = "csr"
 
-    @property
-    def indptr(self):
-        return NDArray(self._indptr_)
+    def _row_ids(self):
+        counts = _np.diff(_np.asarray(self._csr_indptr))
+        return jnp.asarray(
+            _np.repeat(_np.arange(self._shape_[0]), counts).astype(_np.int64))
 
-    @property
-    def indices(self):
-        return NDArray(self._indices_)
+    def _densify(self):
+        out = jnp.zeros(self._shape_, dtype=self._dtype_)
+        if self._csr_data.shape[0] == 0:
+            return out
+        return out.at[self._row_ids(), self._csr_indices].set(
+            self._csr_data.astype(self._dtype_))
+
+    def _invalidate_payload(self):
+        self._csr_data = None
+        self._csr_indices = None
+        self._csr_indptr = None
+
+    def _payload(self):
+        if self._csr_data is None:
+            (self._csr_data, self._csr_indices,
+             self._csr_indptr) = _compress_csr(self._dense_cache)
+        return self._csr_data, self._csr_indices, self._csr_indptr
 
     @property
     def data(self):
-        dense = _np.asarray(self._data)
-        vals = dense[dense != 0] if dense.ndim == 2 else dense
-        return NDArray(jnp.asarray(vals))
+        return NDArray(self._payload()[0])
 
-    def tostype(self, stype):
-        return _to_stype(self, stype)
+    @property
+    def indices(self):
+        return NDArray(self._payload()[1])
+
+    @property
+    def indptr(self):
+        return NDArray(self._payload()[2])
+
+    def copy(self):
+        d, i, p = self._payload()
+        return CSRNDArray(d, i, p, self._shape_, ctx=self._ctx)
+
+    def __repr__(self):
+        return "<CSRNDArray %s @%s>" % (self._shape_, self.ctx)
 
 
 def _to_stype(arr, stype):
+    if stype == arr.stype:
+        # cast_storage contract is a copy (reference cast_storage-inl.h):
+        # mutating the result must not touch the source handle
+        if isinstance(arr, BaseSparseNDArray):
+            return arr.copy()
+        return NDArray(arr._data, ctx=arr._ctx)
     if stype == "default":
         return NDArray(arr._data, ctx=arr._ctx)
     if stype == "row_sparse":
-        return RowSparseNDArray(arr._data, ctx=arr._ctx)
+        v, i = _compress_rows(arr._data)
+        return RowSparseNDArray(v, i, arr.shape, ctx=arr._ctx)
     if stype == "csr":
         if arr.ndim != 2:
             raise ValueError("csr requires 2D")
-        return CSRNDArray(arr._data, ctx=arr._ctx)
+        d, i, p = _compress_csr(arr._data)
+        return CSRNDArray(d, i, p, arr.shape, ctx=arr._ctx)
     raise ValueError("unknown stype %r" % stype)
 
 
+# ------------------------------------------------------------- constructors
+
 def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
+    """reference `sparse.py` csr_matrix: (data, indices, indptr) triplet or
+    dense/array-like source."""
     if isinstance(arg1, tuple) and len(arg1) == 3:
         data, indices, indptr = arg1
         data = _np.asarray(data)
         indices = _np.asarray(indices, dtype=_np.int64)
         indptr = _np.asarray(indptr, dtype=_np.int64)
         n_rows = len(indptr) - 1
-        n_cols = shape[1] if shape else int(indices.max()) + 1
-        dense = _np.zeros((n_rows, n_cols), dtype=data.dtype)
-        for r in range(n_rows):
-            for j in range(indptr[r], indptr[r + 1]):
-                dense[r, indices[j]] = data[j]
-        return CSRNDArray(dense, indptr=indptr, indices=indices, ctx=ctx, dtype=dtype)
-    return CSRNDArray(_np.asarray(arg1), ctx=ctx, dtype=dtype)
+        n_cols = (shape[1] if shape
+                  else (int(indices.max()) + 1 if indices.size else 0))
+        return CSRNDArray(data, indices, indptr, (n_rows, n_cols),
+                          ctx=ctx, dtype=dtype)
+    if isinstance(arg1, CSRNDArray):
+        return arg1.copy()
+    src = arg1._data if isinstance(arg1, NDArray) else _np.asarray(arg1)
+    d, i, p = _compress_csr(src)
+    return CSRNDArray(d, i, p, _np.asarray(src).shape, ctx=ctx, dtype=dtype)
 
 
 def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
+    """reference `sparse.py` row_sparse_array: (data, indices) pair or
+    dense/array-like source."""
     if isinstance(arg1, tuple) and len(arg1) == 2:
         data, indices = arg1
         data = _np.asarray(data)
         indices = _np.asarray(indices, dtype=_np.int64)
-        n_rows = shape[0] if shape else int(indices.max()) + 1
-        dense = _np.zeros((n_rows,) + data.shape[1:], dtype=data.dtype)
-        dense[indices] = data
-        return RowSparseNDArray(dense, indices=indices, ctx=ctx, dtype=dtype)
-    return RowSparseNDArray(_np.asarray(arg1), ctx=ctx, dtype=dtype)
+        if shape:
+            full_shape = tuple(shape)
+            if (data.size and data.shape[1:] != full_shape[1:]):
+                raise ValueError(
+                    "data shape %s inconsistent with shape %s"
+                    % (data.shape, full_shape))
+            if not data.size:
+                data = data.reshape((0,) + full_shape[1:])
+        else:
+            n_rows = int(indices.max()) + 1 if indices.size else 0
+            full_shape = (n_rows,) + data.shape[1:]
+        return RowSparseNDArray(data, indices, full_shape, ctx=ctx,
+                                dtype=dtype)
+    if isinstance(arg1, RowSparseNDArray):
+        return arg1.copy()
+    src = arg1._data if isinstance(arg1, NDArray) else _np.asarray(arg1)
+    v, i = _compress_rows(src)
+    return RowSparseNDArray(v, i, _np.asarray(src).shape, ctx=ctx,
+                            dtype=dtype)
 
 
 def zeros(stype, shape, ctx=None, dtype=None):
-    d = _dense_zeros(shape, ctx=ctx, dtype=dtype)
-    return _to_stype(d, stype)
+    if stype == "row_sparse":
+        shape = (shape,) if isinstance(shape, int) else tuple(shape)
+        return RowSparseNDArray(
+            jnp.zeros((0,) + shape[1:], dtype=dtype or "float32"),
+            jnp.zeros((0,), dtype=jnp.int64), shape, ctx=ctx)
+    if stype == "csr":
+        shape = tuple(shape)
+        return CSRNDArray(jnp.zeros((0,), dtype=dtype or "float32"),
+                          jnp.zeros((0,), jnp.int64),
+                          jnp.zeros((shape[0] + 1,), jnp.int64),
+                          shape, ctx=ctx)
+    return _dense_zeros(shape, ctx=ctx, dtype=dtype)
 
 
 def empty(stype, shape, ctx=None, dtype=None):
     return zeros(stype, shape, ctx=ctx, dtype=dtype)
+
+
+def array(source_array, ctx=None, dtype=None):
+    """reference `sparse.py` array: preserve the source's storage type."""
+    if isinstance(source_array, CSRNDArray):
+        return csr_matrix(source_array, ctx=ctx, dtype=dtype)
+    if isinstance(source_array, RowSparseNDArray):
+        return row_sparse_array(source_array, ctx=ctx, dtype=dtype)
+    return _dense_array(source_array, ctx=ctx, dtype=dtype)
+
+
+# ----------------------------------------------------- sparse-aware compute
+
+def dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    """Sparse matmul (reference `src/operator/tensor/dot-inl.h` FComputeEx):
+
+    - csr @ dense       -> gather + segment_sum (never densifies lhs)
+    - csr.T @ dense     -> scatter-add  (reference dot(csr.T, dense) =
+                           the embedding-gradient pattern, out row_sparse in
+                           the reference; dense here)
+    - rsp/dense fallbacks densify the sparse side.
+    """
+    if isinstance(lhs, CSRNDArray) and not transpose_b:
+        data, indices, _ = lhs._payload()
+        rows = lhs._row_ids()
+        rv = rhs._data if isinstance(rhs, NDArray) else jnp.asarray(rhs)
+        vec = rv.ndim == 1
+        if vec:  # mat-vec: lift to (n, 1) so the gather/scale broadcasts
+            rv = rv[:, None]
+        if not transpose_a:
+            gathered = rv[indices] * data[:, None].astype(rv.dtype)
+            out = jax.ops.segment_sum(gathered, rows,
+                                      num_segments=lhs.shape[0])
+        else:
+            # csr.T @ dense: out[indices[j]] += data[j] * rhs[row_ids[j]]
+            gathered = rv[rows] * data[:, None].astype(rv.dtype)
+            out = jnp.zeros((lhs.shape[1], rv.shape[1]), dtype=rv.dtype)
+            out = out.at[indices].add(gathered)
+        return NDArray(out[:, 0] if vec else out)
+    lv = lhs._data if isinstance(lhs, NDArray) else jnp.asarray(lhs)
+    rv = rhs._data if isinstance(rhs, NDArray) else jnp.asarray(rhs)
+    if transpose_a:
+        lv = lv.T
+    if transpose_b:
+        rv = rv.T
+    return NDArray(jnp.dot(lv, rv))
+
+
+def retain(data, indices):
+    """reference `sparse_retain` (`src/operator/tensor/sparse_retain-inl.h`):
+    keep only the requested rows of a row_sparse array."""
+    if not isinstance(data, RowSparseNDArray):
+        raise TypeError("retain expects a RowSparseNDArray")
+    keep = jnp.asarray(indices._data if isinstance(indices, NDArray)
+                       else indices).astype(jnp.int64)
+    values, idx = data._payload()
+    # rows of `values` whose index is in `keep` survive
+    mask = (idx[:, None] == keep[None, :]).any(axis=1)
+    kept_np = _np.where(_np.asarray(mask))[0]
+    return RowSparseNDArray(values[kept_np], idx[kept_np], data.shape,
+                            ctx=data._ctx)
+
+
+def add(lhs, rhs):
+    """elementwise add preserving row_sparse when both sides are."""
+    if isinstance(lhs, RowSparseNDArray) and isinstance(rhs, RowSparseNDArray):
+        lv, li = lhs._payload()
+        rv, ri = rhs._payload()
+        idx = jnp.asarray(_np.union1d(_np.asarray(li), _np.asarray(ri)))
+        n = idx.shape[0]
+        out = jnp.zeros((n,) + lhs.shape[1:], dtype=lhs._dtype_)
+        pos_l = jnp.searchsorted(idx, li)
+        pos_r = jnp.searchsorted(idx, ri)
+        out = out.at[pos_l].add(lv).at[pos_r].add(rv.astype(lhs._dtype_))
+        return RowSparseNDArray(out, idx, lhs.shape, ctx=lhs._ctx)
+    lv = lhs._data if isinstance(lhs, NDArray) else lhs
+    rv = rhs._data if isinstance(rhs, NDArray) else rhs
+    return NDArray(lv + rv)

@@ -95,6 +95,42 @@ class ndarray(_NDArrayBase):
     def as_np_ndarray(self):
         return self
 
+    # ---- NumPy dispatch protocol (reference
+    # `python/mxnet/numpy_dispatch_protocol.py`): plain numpy functions
+    # called on mx.np arrays dispatch back into this module, so
+    # ``onp.sum(mx.np.ones(3))`` runs the recorded mx op, not a host copy.
+    def __array_function__(self, func, types, args, kwargs):
+        fn = globals().get(func.__name__)
+        if fn is None:
+            mod = globals().get(getattr(func, "__module__", "")
+                                .rsplit(".", 1)[-1])
+            fn = getattr(mod, func.__name__, None) if mod else None
+        if fn is None:
+            return NotImplemented
+        return fn(*args, **kwargs)
+
+    def __array_ufunc__(self, ufunc, method, *inputs, **kwargs):
+        if method != "__call__":
+            return NotImplemented
+        fn = globals().get(ufunc.__name__)
+        if fn is None:
+            return NotImplemented
+        out = kwargs.pop("out", None)
+        result = fn(*inputs, **kwargs)
+        if out is not None:
+            # honor numpy's in-place out= contract for mx targets
+            targets = out if isinstance(out, tuple) else (out,)
+            results = result if isinstance(result, tuple) else (result,)
+            # NB: builtin all() — the module-level np.all shadows it here
+            import builtins
+            if len(targets) != len(results) or not builtins.all(
+                    isinstance(t, _NDArrayBase) for t in targets):
+                return NotImplemented
+            for t, r in zip(targets, results):
+                t._data = r._data
+            return targets[0] if len(targets) == 1 else targets
+        return result
+
 
 def _as_np(arr):
     if isinstance(arr, tuple):
@@ -408,6 +444,52 @@ def allclose(a, b, rtol=1e-05, atol=1e-08, equal_nan=False):
 
 def array_equal(a1, a2, equal_nan=False):
     return bool(_jnp.array_equal(_unwrap(a1), _unwrap(a2)))
+
+
+deg2rad = _make_unary("deg2rad")
+rad2deg = _make_unary("rad2deg")
+vdot = _make_binary("vdot")
+
+
+def hsplit(ary, indices_or_sections):
+    return _wrap_record(
+        "hsplit",
+        lambda v: tuple(_jnp.hsplit(v, indices_or_sections)), ary)
+
+
+def vsplit(ary, indices_or_sections):
+    return _wrap_record(
+        "vsplit",
+        lambda v: tuple(_jnp.vsplit(v, indices_or_sections)), ary)
+
+
+def indices(dimensions, dtype=None, ctx=None):
+    return ndarray(_jnp.indices(
+        dimensions, dtype=dtype_np(dtype) if dtype else _onp.int64),
+        ctx=ctx)
+
+
+def blackman(M, dtype=None, ctx=None):
+    return ndarray(_jnp.blackman(M).astype(dtype_np(dtype or "float32")),
+                   ctx=ctx)
+
+
+def hamming(M, dtype=None, ctx=None):
+    return ndarray(_jnp.hamming(M).astype(dtype_np(dtype or "float32")),
+                   ctx=ctx)
+
+
+def hanning(M, dtype=None, ctx=None):
+    return ndarray(_jnp.hanning(M).astype(dtype_np(dtype or "float32")),
+                   ctx=ctx)
+
+
+def set_printoptions(*args, **kwargs):
+    _onp.set_printoptions(*args, **kwargs)
+
+
+def genfromtxt(*args, **kwargs):
+    return array(_onp.genfromtxt(*args, **kwargs))
 
 
 from . import random  # noqa: E402,F401

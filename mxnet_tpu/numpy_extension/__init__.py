@@ -104,6 +104,35 @@ def topk(data, axis=-1, k=1, **kwargs):
     return __getattr__("topk")(data, axis=axis, k=k, **kwargs)
 
 
+def seed(seed_state=None, ctx="all"):
+    """reference `numpy_extension/random.py` npx.random seeding — delegates
+    to the framework RNG key discipline."""
+    from .. import random as _random
+    _random.seed(0 if seed_state is None else int(seed_state))
+
+
+def bernoulli(prob=None, logit=None, size=None, dtype=None, ctx=None,
+              out=None):
+    """reference `ndarray/numpy_extension/random.py` npx.random.bernoulli."""
+    import jax
+    import jax.numpy as jnp
+    from .. import numpy as np_mod
+    from .. import random as _random
+    if (prob is None) == (logit is None):
+        raise ValueError("exactly one of prob / logit must be given")
+    p = prob if prob is not None else None
+    key = _random.next_key()
+    if p is not None:
+        pv = p._data if isinstance(p, _NDArrayBase) else jnp.asarray(p)
+    else:
+        lv = (logit._data if isinstance(logit, _NDArrayBase)
+              else jnp.asarray(logit))
+        pv = jax.nn.sigmoid(lv)
+    shape = size if size is not None else jnp.shape(pv)
+    draw = jax.random.bernoulli(key, pv, shape=shape)
+    return np_mod.ndarray(draw.astype(dtype or "float32"))
+
+
 def waitall():
     from ..ndarray import ndarray as _nd
     _nd.waitall()

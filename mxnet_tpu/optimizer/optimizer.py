@@ -205,6 +205,13 @@ def _sgd_mom(w, mom, g, lr, wd, mo, rescale, clip):
 
 
 @partial(jax.jit, donate_argnums=(0,))
+def _sgd_rowwise(w, values, idx, lr, wd, rescale, clip):
+    g = jnp.clip(values * rescale, -clip, clip)
+    rows = w[idx]
+    return w.at[idx].set(rows - lr * (g + wd * rows))
+
+
+@partial(jax.jit, donate_argnums=(0,))
 def _sgd(w, g, lr, wd, rescale, clip):
     g = jnp.clip(g * rescale, -clip, clip)
     return w - lr * (g + wd * w)
@@ -393,6 +400,17 @@ class SGD(Optimizer):
     def update(self, index, weight, grad, state):
         self._update_count(index)
         lr, wd = self._get_lr(index), self._get_wd(index)
+        from ..ndarray.sparse import RowSparseNDArray
+        if (isinstance(grad, RowSparseNDArray) and self.lazy_update
+                and state is None):
+            # reference sgd_update FComputeEx row_sparse path
+            # (`src/operator/optimizer_op.cc` SGDUpdateEx): only rows present
+            # in the gradient are touched — untouched rows skip weight decay
+            values, idx = grad._payload()
+            weight._data = _sgd_rowwise(
+                weight._data, values.astype(weight._data.dtype), idx,
+                lr, wd, self.rescale_grad, _c(self.clip_gradient))
+            return
         if state is not None:
             weight._data, state._data = _sgd_mom(
                 weight._data, state._data, grad._data, lr, wd, self.momentum,

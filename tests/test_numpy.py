@@ -133,3 +133,60 @@ def test_where_einsum():
     onp.testing.assert_allclose(
         np.einsum("ij,jk->ik", x, y).asnumpy(),
         x.asnumpy() @ y.asnumpy(), rtol=1e-5)
+
+
+def test_np_surface_completions():
+    # reference numpy/multiarray.py __all__ members added for parity
+    onp.testing.assert_allclose(np.deg2rad(np.array([180.0])).asnumpy(),
+                                [onp.pi], rtol=1e-6)
+    onp.testing.assert_allclose(np.rad2deg(np.array([onp.pi])).asnumpy(),
+                                [180.0], rtol=1e-6)
+    a = np.arange(4).reshape(2, 2)
+    parts = np.hsplit(a, 2)
+    assert len(parts) == 2 and parts[0].shape == (2, 1)
+    parts = np.vsplit(a, 2)
+    assert len(parts) == 2 and parts[0].shape == (1, 2)
+    assert np.indices((2, 3)).shape == (2, 2, 3)
+    onp.testing.assert_allclose(
+        np.vdot(np.array([1.0, 2.0]), np.array([3.0, 4.0])).asnumpy(), 11.0)
+    for win in (np.blackman, np.hamming, np.hanning):
+        w = win(8)
+        assert w.shape == (8,)
+    np.set_printoptions(precision=4)
+
+
+def test_np_dispatch_protocol():
+    # reference numpy_dispatch_protocol.py: plain-numpy functions on mx.np
+    # arrays dispatch into mx (no silent host round-trip)
+    a = np.array([[1.0, 2.0], [3.0, 4.0]])
+    s = onp.sum(a)
+    assert isinstance(s, np.ndarray)
+    onp.testing.assert_allclose(s.asnumpy(), 10.0)
+    m = onp.mean(a, axis=0)
+    assert isinstance(m, np.ndarray)
+    onp.testing.assert_allclose(m.asnumpy(), [2.0, 3.0])
+    # ufunc protocol
+    r = onp.add(a, a)
+    assert isinstance(r, np.ndarray)
+    onp.testing.assert_allclose(r.asnumpy(), 2 * a.asnumpy())
+    r = onp.sqrt(a)
+    assert isinstance(r, np.ndarray)
+
+
+def test_npx_seed_bernoulli():
+    npx.seed(0)
+    draws = npx.bernoulli(prob=np.full((1000,), 0.3))
+    assert isinstance(draws, np.ndarray)
+    frac = float(draws.asnumpy().mean())
+    assert 0.2 < frac < 0.4
+    d2 = npx.bernoulli(logit=np.zeros((500,)))
+    frac2 = float(d2.asnumpy().mean())
+    assert 0.35 < frac2 < 0.65
+
+
+def test_ufunc_out_contract():
+    a = np.array([1.0, 2.0])
+    c = np.zeros((2,))
+    r = onp.add(a, a, out=c)
+    assert r is c
+    onp.testing.assert_allclose(c.asnumpy(), [2.0, 4.0])

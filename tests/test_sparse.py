@@ -1,0 +1,167 @@
+"""Sparse NDArray tests (reference `tests/python/unittest/test_sparse_ndarray.py`
+/ `test_sparse_operator.py` semantics, reduced to the supported surface)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.ndarray import sparse
+
+
+def _rand_csr(shape, density=0.3, seed=0):
+    rng = onp.random.default_rng(seed)
+    dense = rng.random(shape).astype("float32")
+    dense[rng.random(shape) > density] = 0.0
+    return dense
+
+
+def test_csr_compressed_storage_is_authoritative():
+    dense = onp.array([[0, 1, 0], [2, 0, 3], [0, 0, 0]], dtype="float32")
+    csr = sparse.csr_matrix(dense)
+    onp.testing.assert_array_equal(csr.indptr.asnumpy(), [0, 1, 3, 3])
+    onp.testing.assert_array_equal(csr.indices.asnumpy(), [1, 0, 2])
+    onp.testing.assert_allclose(csr.data.asnumpy(), [1, 2, 3])
+    assert csr.stype == "csr"
+    assert csr.shape == (3, 3)
+    onp.testing.assert_allclose(csr.asnumpy(), dense)
+
+
+def test_csr_from_triplet_no_dense_input():
+    data = [1.0, 2.0, 3.0]
+    indices = [1, 0, 2]
+    indptr = [0, 1, 3, 3]
+    csr = sparse.csr_matrix((data, indices, indptr), shape=(3, 3))
+    want = onp.array([[0, 1, 0], [2, 0, 3], [0, 0, 0]], dtype="float32")
+    onp.testing.assert_allclose(csr.asnumpy(), want)
+
+
+def test_row_sparse_payload_and_roundtrip():
+    values = onp.array([[1.0, 2.0], [3.0, 4.0]], dtype="float32")
+    rsp = sparse.row_sparse_array((values, [1, 3]), shape=(5, 2))
+    onp.testing.assert_array_equal(rsp.indices.asnumpy(), [1, 3])
+    onp.testing.assert_allclose(rsp.data.asnumpy(), values)
+    dense = rsp.asnumpy()
+    assert dense.shape == (5, 2)
+    onp.testing.assert_allclose(dense[1], [1, 2])
+    onp.testing.assert_allclose(dense[3], [3, 4])
+    assert dense[0].sum() == 0 and dense[2].sum() == 0 and dense[4].sum() == 0
+    # round trip through dense and back
+    back = rsp.tostype("default").tostype("row_sparse")
+    onp.testing.assert_array_equal(back.indices.asnumpy(), [1, 3])
+    onp.testing.assert_allclose(back.data.asnumpy(), values)
+
+
+def test_cast_storage_roundtrip_random():
+    dense = _rand_csr((8, 6))
+    csr = nd.array(dense).tostype("csr")
+    onp.testing.assert_allclose(csr.tostype("default").asnumpy(), dense,
+                                rtol=1e-6)
+    rsp = nd.array(dense).tostype("row_sparse")
+    onp.testing.assert_allclose(rsp.tostype("default").asnumpy(), dense,
+                                rtol=1e-6)
+
+
+def test_sparse_dot_csr_dense():
+    dense_l = _rand_csr((5, 7), seed=1)
+    rhs = onp.random.default_rng(2).random((7, 3)).astype("float32")
+    csr = sparse.csr_matrix(dense_l)
+    out = sparse.dot(csr, nd.array(rhs))
+    onp.testing.assert_allclose(out.asnumpy(), dense_l @ rhs, rtol=1e-5)
+
+
+def test_sparse_dot_csr_transpose():
+    dense_l = _rand_csr((5, 7), seed=3)
+    rhs = onp.random.default_rng(4).random((5, 2)).astype("float32")
+    csr = sparse.csr_matrix(dense_l)
+    out = sparse.dot(csr, nd.array(rhs), transpose_a=True)
+    onp.testing.assert_allclose(out.asnumpy(), dense_l.T @ rhs, rtol=1e-5)
+
+
+def test_sparse_retain():
+    values = onp.arange(8, dtype="float32").reshape(4, 2)
+    rsp = sparse.row_sparse_array((values, [0, 2, 4, 6]), shape=(8, 2))
+    kept = sparse.retain(rsp, nd.array([2, 6]))
+    onp.testing.assert_array_equal(kept.indices.asnumpy(), [2, 6])
+    onp.testing.assert_allclose(kept.data.asnumpy(), values[[1, 3]])
+    dense = kept.asnumpy()
+    assert dense[0].sum() == 0 and dense[4].sum() == 0
+
+
+def test_sparse_add_row_sparse():
+    a = sparse.row_sparse_array((onp.ones((2, 3), "float32"), [0, 2]),
+                                shape=(4, 3))
+    b = sparse.row_sparse_array((2 * onp.ones((2, 3), "float32"), [2, 3]),
+                                shape=(4, 3))
+    out = sparse.add(a, b)
+    assert out.stype == "row_sparse"
+    onp.testing.assert_array_equal(out.indices.asnumpy(), [0, 2, 3])
+    want = onp.zeros((4, 3), "float32")
+    want[0] = 1; want[2] = 3; want[3] = 2
+    onp.testing.assert_allclose(out.asnumpy(), want)
+
+
+def test_sparse_zeros_has_empty_payload():
+    z = sparse.zeros("row_sparse", (3, 4))
+    assert z.indices.shape == (0,)
+    assert z.asnumpy().sum() == 0
+    zc = sparse.zeros("csr", (3, 4))
+    assert zc.data.shape == (0,)
+    onp.testing.assert_array_equal(zc.indptr.asnumpy(), [0, 0, 0, 0])
+
+
+def test_row_sparse_sgd_lazy_update():
+    # reference SGDUpdateEx row_sparse path: only rows present in the grad
+    # move; with wd>0 untouched rows do NOT decay (lazy_update contract)
+    opt = mx.optimizer.SGD(learning_rate=0.5, wd=0.1, lazy_update=True)
+    w = nd.array(onp.ones((4, 2), "float32"))
+    g = sparse.row_sparse_array((onp.ones((2, 2), "float32"), [1, 3]),
+                                shape=(4, 2))
+    state = opt.create_state(0, w)
+    opt.update(0, w, g, state)
+    out = w.asnumpy()
+    onp.testing.assert_allclose(out[0], [1, 1])  # untouched
+    onp.testing.assert_allclose(out[2], [1, 1])  # untouched
+    # touched rows: w - lr*(g + wd*w) = 1 - 0.5*(1 + 0.1) = 0.45
+    onp.testing.assert_allclose(out[1], [0.45, 0.45], rtol=1e-6)
+    onp.testing.assert_allclose(out[3], [0.45, 0.45], rtol=1e-6)
+
+
+def test_sparse_mutation_invalidates_payload():
+    rsp = sparse.row_sparse_array(
+        (onp.ones((1, 2), "float32"), [1]), shape=(3, 2))
+    new = onp.array([[0, 0], [5, 6], [7, 8]], dtype="float32")
+    rsp[:] = new
+    # payload recomputed from the new dense value (zero row dropped)
+    onp.testing.assert_array_equal(rsp.indices.asnumpy(), [1, 2])
+    onp.testing.assert_allclose(rsp.asnumpy(), new)
+
+
+def test_sparse_dot_matvec_1d():
+    A = onp.array([[1, 0, 2], [0, 0, 3]], dtype="float32")
+    csr = sparse.csr_matrix(A)
+    v = nd.array(onp.array([1, 2, 3], "float32"))
+    out = sparse.dot(csr, v)
+    assert out.shape == (2,)
+    onp.testing.assert_allclose(out.asnumpy(), A @ [1, 2, 3])
+    v2 = nd.array(onp.array([1, 2], "float32"))
+    out_t = sparse.dot(csr, v2, transpose_a=True)
+    assert out_t.shape == (3,)
+    onp.testing.assert_allclose(out_t.asnumpy(), A.T @ [1, 2])
+
+
+def test_row_sparse_empty_explicit_shape():
+    z = sparse.row_sparse_array(
+        (onp.zeros((0,)), onp.zeros((0,), "int64")), shape=(4, 3))
+    assert z.shape == (4, 3)
+    assert z.tostype("default").shape == (4, 3)
+    with pytest.raises(ValueError):
+        sparse.row_sparse_array((onp.ones((2, 5), "float32"), [0, 1]),
+                                shape=(4, 3))
+
+
+def test_tostype_same_stype_copies():
+    dense = nd.array(onp.ones((2, 2), "float32"))
+    alias = dense.tostype("default")
+    assert alias is not dense
+    alias += 1
+    onp.testing.assert_allclose(dense.asnumpy(), onp.ones((2, 2)))
