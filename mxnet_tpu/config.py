@@ -83,8 +83,9 @@ KNOBS = dict([
     _k("MXNET_USE_FUSION", 1, int, "subsumed", "XLA fusion pass"),
     _k("MXNET_FUSION_VERBOSE", 0, int, "subsumed",
        "use XLA_FLAGS dumping instead"),
-    _k("MXNET_SUBGRAPH_BACKEND", "NONE", str, "subsumed",
-       "one compiler backend (XLA); partitioning is internal"),
+    _k("MXNET_SUBGRAPH_BACKEND", "NONE", str, "wired",
+       "subgraph partition backend applied at bind time "
+       "(symbol/subgraph.py; e.g. TPU_ELEMWISE)"),
     _k("MXNET_GPU_MEM_POOL_TYPE", "Naive", str, "subsumed",
        "PJRT owns the device allocator"),
     _k("MXNET_GPU_MEM_POOL_RESERVE", 5, int, "subsumed",

@@ -1,0 +1,332 @@
+"""Subgraph partitioning API (reference `src/operator/subgraph/`).
+
+Parity surface: `SubgraphProperty` / `SubgraphSelector`
+(`src/operator/subgraph/subgraph_property.h:252`, `subgraph_property.h:64`)
+and the graph-partition pass (`build_subgraph.cc`): a pluggable backend
+walks the graph, selects node groups, and replaces each group with ONE
+fused subgraph operator. The reference uses this for MKL-DNN fusion and
+TensorRT offload; `Symbol.get_backend_symbol(backend)` and the
+`MXNET_SUBGRAPH_BACKEND` env knob are the user surface.
+
+TPU-native design: a selected subgraph is compiled into a single
+``jax.jit`` callable over the region's composed pure functions — the XLA
+analogue of handing a subgraph to a vendor engine. The partitioner works
+on the Symbol DAG directly (no nnvm IndexedGraph): regions are grown
+greedily in topological order and kept *convex* (no path that leaves the
+region and re-enters), which is the same invariant the reference enforces
+before it substitutes a subgraph node.
+"""
+from __future__ import annotations
+
+import jax
+
+import itertools
+
+from ..ops import registry as _registry
+from ..ops.registry import Op
+from .symbol import Symbol
+
+_fused_counter = itertools.count()
+
+__all__ = ["SubgraphSelector", "SubgraphProperty",
+           "register_subgraph_property", "list_backends", "partition",
+           "ElementwiseFusionProperty"]
+
+
+class SubgraphSelector:
+    """Decides which ops join a region (reference subgraph_property.h:64
+    SubgraphSelector::Select/SelectInput/SelectOutput)."""
+
+    def select(self, node) -> bool:
+        """Can this node seed a new region?"""
+        return False
+
+    def select_input(self, node, producer) -> bool:
+        """Grow the region from ``node`` to its input ``producer``?"""
+        return self.select(producer)
+
+    def select_output(self, node, consumer) -> bool:
+        """Grow the region from ``node`` to its consumer?"""
+        return self.select(consumer)
+
+    def min_size(self) -> int:
+        """Regions smaller than this stay unfused."""
+        return 2
+
+
+class SubgraphProperty:
+    """A pluggable partitioning backend (reference
+    subgraph_property.h:252). Subclasses supply a selector and may
+    customize how the fused op is built."""
+
+    name = "base"
+
+    def create_selector(self) -> SubgraphSelector:
+        raise NotImplementedError
+
+    def build_fused_op(self, region_name, subgraph_fn, n_out):
+        """Wrap the composed+jitted region callable as a framework Op and
+        register it, so a partitioned symbol's JSON round-trips through
+        save/load within the session (reference CreateSubgraphNode; the
+        reference likewise requires the backend library to be loaded
+        before deserializing its subgraph ops)."""
+        op = Op(region_name, jax.jit(subgraph_fn), n_out=n_out,
+                namespace="nd", differentiable=True)
+        _registry._OP_REGISTRY[region_name] = op
+        return op
+
+
+_BACKENDS: dict = {}
+
+
+def register_subgraph_property(name, prop):
+    """reference MXSetSubgraphPropertyOpNames / backend registry
+    (`subgraph_property.h` SubgraphBackendRegistry)."""
+    _BACKENDS[name] = prop
+    return prop
+
+
+def list_backends():
+    return sorted(_BACKENDS)
+
+
+def _collect_regions(order, selector):
+    """Greedy convex region growth in topo order."""
+    pos = {id(n): i for i, n in enumerate(order)}
+    consumers = {}
+    for n in order:
+        for p, _ in n._inputs:
+            consumers.setdefault(id(p), []).append(n)
+    assigned = {}
+    regions = []
+    for seed in order:
+        if seed._op is None or id(seed) in assigned:
+            continue
+        if not selector.select(seed):
+            continue
+        region = {id(seed): seed}
+        frontier = [seed]
+        while frontier:
+            node = frontier.pop()
+            for p, _ in node._inputs:
+                if (p._op is not None and id(p) not in assigned
+                        and id(p) not in region
+                        and selector.select_input(node, p)):
+                    region[id(p)] = p
+                    frontier.append(p)
+            for c in consumers.get(id(node), ()):
+                if (id(c) not in assigned and id(c) not in region
+                        and selector.select_output(node, c)):
+                    region[id(c)] = c
+                    frontier.append(c)
+        # convexity (reference build_subgraph.cc ancestor/descendant
+        # labelling): no path may leave the region and re-enter. Propagate
+        # transitive depends-on-region through the topo interval; any
+        # outside node that (transitively) depends on the region AND
+        # directly feeds a region node witnesses a violation — cut the
+        # region back to the prefix before that node and retry.
+        changed = True
+        while changed:
+            changed = False
+            lo = min(pos[i] for i in region)
+            hi = max(pos[i] for i in region)
+            depends = {}
+            for i in range(lo, hi + 1):
+                node = order[i]
+                if id(node) in region:
+                    continue
+                depends[id(node)] = any(
+                    id(p) in region or depends.get(id(p), False)
+                    for p, _ in node._inputs)
+            for i in range(lo + 1, hi + 1):
+                mid = order[i]
+                if id(mid) in region or not depends.get(id(mid)):
+                    continue
+                if any(id(c) in region for c in consumers.get(id(mid), ())):
+                    drop = [k for k in region if pos[k] > pos[id(mid)]]
+                    for k in drop:
+                        del region[k]
+                    changed = True
+                    break
+        if len(region) >= selector.min_size():
+            for k in region:
+                assigned[k] = len(regions)
+            regions.append(sorted(region.values(),
+                                  key=lambda n: pos[id(n)]))
+    return regions, assigned, consumers
+
+
+def _region_io(region):
+    """External inputs (as (producer_symbol, out_idx) in first-use order)
+    and outputs (region nodes consumed outside / graph outputs)."""
+    inside = {id(n) for n in region}
+    ext_inputs = []
+    seen = set()
+    for n in region:
+        for p, oi in n._inputs:
+            if id(p) not in inside:
+                k = (id(p), oi)
+                if k not in seen:
+                    seen.add(k)
+                    ext_inputs.append((p, oi))
+    return ext_inputs
+
+
+def _make_subgraph_fn(region, ext_inputs, out_nodes):
+    """Compose the region into one pure function of the external inputs."""
+    inside = {id(n) for n in region}
+    ext_index = {(id(p), oi): i for i, (p, oi) in enumerate(ext_inputs)}
+
+    def fn(*args):
+        values = {}
+        for n in region:
+            call_args = []
+            for p in getattr(n, "_raw_inputs", n._inputs):
+                if isinstance(p, tuple) and p and p[0] == "const":
+                    call_args.append(p[1])
+                    continue
+                prod, oi = p
+                if id(prod) in inside:
+                    call_args.append(values[(id(prod), oi)])
+                else:
+                    call_args.append(args[ext_index[(id(prod), oi)]])
+            out = n._op.fn(*call_args, **n._kwargs)
+            if isinstance(out, tuple):
+                for i, v in enumerate(out):
+                    values[(id(n), i)] = v
+            else:
+                values[(id(n), 0)] = out
+        outs = tuple(values[(id(n), 0)] for n in out_nodes)
+        return outs if len(outs) > 1 else outs[0]
+
+    return fn
+
+
+def partition(symbol, backend):
+    """Partition a Symbol with the named backend, returning a NEW Symbol
+    whose fused regions each execute as one jitted XLA program
+    (reference `build_subgraph.cc` BuildSubgraph + Symbol.get_backend_symbol
+    `python/mxnet/symbol/symbol.py`)."""
+    prop = _BACKENDS.get(backend)
+    if prop is None:
+        raise ValueError("unknown subgraph backend %r (registered: %s)"
+                         % (backend, list_backends()))
+    selector = prop.create_selector()
+    order = symbol._toposort()
+    regions, assigned, consumers = _collect_regions(order, selector)
+    if not regions:
+        return symbol
+
+    graph_outputs = {id(s) for s, _ in symbol._outputs_list()}
+
+    # per-region fused nodes (created lazily once their inputs are mapped)
+    region_out_nodes = []
+    for region in regions:
+        inside = {id(n) for n in region}
+        outs = [n for n in region
+                if id(n) in graph_outputs
+                or any(id(c) not in inside
+                       for c in consumers.get(id(n), ()))]
+        region_out_nodes.append(outs)
+
+    mapping = {}      # id(old node) -> (new Symbol, out_idx offset fn)
+    fused_nodes = {}  # region idx -> new Symbol
+
+    def mapped(p, oi):
+        if id(p) in assigned:
+            ri = assigned[id(p)]
+            fnode = build_region(ri)
+            return (fnode, region_out_nodes[ri].index(p))
+        return (clone(p), oi)
+
+    def clone(n):
+        if id(n) in mapping:
+            return mapping[id(n)]
+        if n._op is None:
+            new = n  # variables are shared, not cloned
+        else:
+            new = Symbol(op=n._op,
+                         inputs=[mapped(p, oi) for p, oi in n._inputs],
+                         kwargs=dict(n._kwargs), name=n._name,
+                         attr=dict(n._attr))
+            new._num_out = n._num_out
+            raw = getattr(n, "_raw_inputs", None)
+            if raw is not None:
+                new_raw = []
+                for p in raw:
+                    if isinstance(p, tuple) and p and p[0] == "const":
+                        new_raw.append(p)
+                    else:
+                        new_raw.append(mapped(p[0], p[1]))
+                new._raw_inputs = new_raw
+                new._inputs = [p for p in new_raw if p[0] != "const"]
+        mapping[id(n)] = new
+        return new
+
+    building = set()
+
+    def build_region(ri):
+        if ri in fused_nodes:
+            return fused_nodes[ri]
+        if ri in building:  # an ext input of the region leads back into it
+            raise RuntimeError(
+                "non-convex subgraph region survived the convexity pass "
+                "(backend %r, region %d) — this is a partitioner bug"
+                % (backend, ri))
+        building.add(ri)
+        region = regions[ri]
+        ext_inputs = _region_io(region)
+        outs = region_out_nodes[ri]
+        fn = _make_subgraph_fn(region, ext_inputs, outs)
+        uname = "_subgraph_%s_%d" % (backend, next(_fused_counter))
+        op = prop.build_fused_op(uname, fn, len(outs))
+        node = Symbol(op=op,
+                      inputs=[mapped(p, oi) for p, oi in ext_inputs],
+                      kwargs={},
+                      name=uname,
+                      attr={"__subgraph__": backend,
+                            "__subgraph_ops__": ",".join(
+                                n._op.name for n in region)})
+        node._num_out = len(outs)
+        building.discard(ri)
+        fused_nodes[ri] = node
+        return node
+
+    new_outputs = []
+    for s, oi in symbol._outputs_list():
+        new_outputs.append(mapped(s, oi))
+    if len(new_outputs) == 1 and symbol._group is None:
+        node, oi = new_outputs[0]
+        return node
+    g = Symbol(outputs=new_outputs)
+    return g
+
+
+# ---------------------------------------------------------------- built-in
+
+# NB: selectors see node._op.name, which is the CANONICAL registry name —
+# elemwise_add/broadcast_add etc. are aliases of add (ops/core.py)
+_ELEMWISE = {"relu", "sigmoid", "tanh", "exp", "log", "sqrt", "square",
+             "Activation", "add", "multiply", "subtract", "divide",
+             "_plus_scalar", "_mul_scalar", "_minus_scalar",
+             "_div_scalar", "negative", "abs", "clip"}
+
+
+class _ElementwiseSelector(SubgraphSelector):
+    def select(self, node):
+        return node._op is not None and node._op.name in _ELEMWISE
+
+
+class ElementwiseFusionProperty(SubgraphProperty):
+    """Built-in demo backend: fuse elementwise chains into one jitted
+    program (role of the reference's pointwise fusion backend,
+    `src/executor/pointwise_fusion_pass.cc`, which NVRTC-compiles fused
+    CUDA; here the region compiles to one XLA fusion)."""
+
+    name = "TPU_ELEMWISE"
+
+    def create_selector(self):
+        return _ElementwiseSelector()
+
+
+register_subgraph_property("TPU_ELEMWISE", ElementwiseFusionProperty())

@@ -214,7 +214,25 @@ class Symbol:
     def bind(self, ctx, args, args_grad=None, grad_req="write",
              aux_states=None, group2ctx=None, shared_exec=None):
         from .executor import Executor
-        return Executor(self, ctx, args, args_grad, grad_req, aux_states)
+        sym = self._env_partitioned()
+        return Executor(sym, ctx, args, args_grad, grad_req, aux_states)
+
+    def _env_partitioned(self):
+        """Apply MXNET_SUBGRAPH_BACKEND partitioning at bind time
+        (reference `src/executor/graph_executor.cc` init applies the env
+        backend before the pass pipeline)."""
+        from .. import config as _config
+        backend = _config.get("MXNET_SUBGRAPH_BACKEND")
+        if backend and backend not in ("NONE", ""):
+            from .subgraph import partition, _BACKENDS
+            if backend in _BACKENDS:
+                return partition(self, backend)
+            import logging
+            logging.warning(
+                "MXNET_SUBGRAPH_BACKEND=%r is not a registered subgraph "
+                "backend (registered: %s); binding unpartitioned",
+                backend, sorted(_BACKENDS))
+        return self
 
     def simple_bind(self, ctx, grad_req="write", type_dict=None,
                     stype_dict=None, group2ctx=None, shared_arg_names=None,
@@ -238,7 +256,8 @@ class Symbol:
             grads = None
         aux = {n: _nd.zeros(s, ctx=ctx)
                for n, s in zip(self.list_auxiliary_states(), aux_shapes)}
-        return Executor(self, ctx, args, grads, grad_req, aux)
+        return Executor(self._env_partitioned(), ctx, args, grads,
+                        grad_req, aux)
 
     # ---- serialization ----------------------------------------------------
     def tojson(self):
@@ -289,7 +308,11 @@ class Symbol:
             f.write(self.tojson())
 
     def get_backend_symbol(self, backend):
-        return self  # XLA is the only backend; partitioning is internal
+        """Partition with a registered subgraph backend (reference
+        `python/mxnet/symbol/symbol.py` get_backend_symbol →
+        `src/c_api/c_api_symbolic.cc` MXGenBackendSubgraph)."""
+        from .subgraph import partition
+        return partition(self, backend)
 
     # ---- misc parity ------------------------------------------------------
     def attr_dict(self):

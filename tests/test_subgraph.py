@@ -1,0 +1,163 @@
+"""Subgraph partitioning tests (reference `tests/python/unittest/
+test_subgraph_op.py` semantics over the TPU-native partitioner)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+from mxnet_tpu.symbol import subgraph
+
+
+def _mlp():
+    x = sym.var("data")
+    w = sym.var("w")
+    h = sym.FullyConnected(x, w, no_bias=True, num_hidden=4, name="fc")
+    a = sym.relu(h + 1.0)
+    b = sym.tanh(a * 2.0)
+    return b
+
+
+def _bindings():
+    rng = onp.random.default_rng(0)
+    return {"data": nd.array(rng.random((2, 3)).astype("float32")),
+            "w": nd.array(rng.random((4, 3)).astype("float32"))}
+
+
+def test_partition_preserves_semantics():
+    net = _mlp()
+    vals = _bindings()
+    ex = net.bind(mx.cpu(), dict(vals))
+    want = ex.forward()[0].asnumpy()
+    fused = net.get_backend_symbol("TPU_ELEMWISE")
+    ex2 = fused.bind(mx.cpu(), dict(vals))
+    got = ex2.forward()[0].asnumpy()
+    onp.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_partition_actually_fuses():
+    net = _mlp()
+    fused = net.get_backend_symbol("TPU_ELEMWISE")
+    nodes = fused._toposort()
+    sub = [n for n in nodes if n._attr.get("__subgraph__")]
+    assert len(sub) >= 1
+    # the elementwise chain (add/relu/mul/tanh) collapsed into the region
+    ops = sub[0]._attr["__subgraph_ops__"].split(",")
+    assert len(ops) >= 3
+    # FullyConnected stays outside
+    assert all("FullyConnected" not in o for o in ops)
+    outside = [n for n in nodes if n._op is not None
+               and not n._attr.get("__subgraph__")]
+    assert any(n._op.name == "FullyConnected" for n in outside)
+
+
+def test_partition_backward_matches():
+    net = _mlp()
+    vals = _bindings()
+    grads = {k: nd.zeros(v.shape) for k, v in vals.items()}
+    ex = net.bind(mx.cpu(), dict(vals), args_grad=dict(grads))
+    out = ex.forward(is_train=True)[0]
+    ex.backward(nd.ones(out.shape))
+    want = {k: g.asnumpy().copy() for k, g in ex.grad_dict.items()}
+
+    fused = net.get_backend_symbol("TPU_ELEMWISE")
+    grads2 = {k: nd.zeros(v.shape) for k, v in vals.items()}
+    ex2 = fused.bind(mx.cpu(), dict(vals), args_grad=dict(grads2))
+    out2 = ex2.forward(is_train=True)[0]
+    ex2.backward(nd.ones(out2.shape))
+    for k in want:
+        onp.testing.assert_allclose(ex2.grad_dict[k].asnumpy(), want[k],
+                                    rtol=1e-5)
+
+
+def test_env_knob_applies_at_bind(monkeypatch):
+    monkeypatch.setenv("MXNET_SUBGRAPH_BACKEND", "TPU_ELEMWISE")
+    net = _mlp()
+    vals = _bindings()
+    ex = net.bind(mx.cpu(), dict(vals))
+    sub = [n for n in ex._symbol._toposort()
+           if n._attr.get("__subgraph__")]
+    assert sub, "bind should partition when MXNET_SUBGRAPH_BACKEND is set"
+    want = net.bind(mx.cpu(), dict(vals))  # still partitioned, fine
+    onp.testing.assert_allclose(ex.forward()[0].asnumpy(),
+                                want.forward()[0].asnumpy(), rtol=1e-6)
+
+
+def test_custom_property_registration():
+    class EverythingSelector(subgraph.SubgraphSelector):
+        def select(self, node):
+            return True
+
+        def min_size(self):
+            return 1
+
+    class WholeGraphProperty(subgraph.SubgraphProperty):
+        name = "TEST_ALL"
+
+        def create_selector(self):
+            return EverythingSelector()
+
+    subgraph.register_subgraph_property("TEST_ALL", WholeGraphProperty())
+    assert "TEST_ALL" in subgraph.list_backends()
+    net = _mlp()
+    fused = net.get_backend_symbol("TEST_ALL")
+    nodes = [n for n in fused._toposort() if n._op is not None]
+    # entire compute graph collapsed into one fused node
+    assert len(nodes) == 1
+    assert nodes[0]._attr.get("__subgraph__") == "TEST_ALL"
+    vals = _bindings()
+    got = fused.bind(mx.cpu(), dict(vals)).forward()[0].asnumpy()
+    want = net.bind(mx.cpu(), dict(vals)).forward()[0].asnumpy()
+    onp.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_unknown_backend_raises():
+    with pytest.raises(ValueError):
+        _mlp().get_backend_symbol("NOPE")
+
+
+def test_binary_elemwise_fuses():
+    x = sym.var("a")
+    y = sym.var("b")
+    out = sym.tanh(sym.elemwise_add(sym.relu(x), sym.relu(y)))
+    fused = out.get_backend_symbol("TPU_ELEMWISE")
+    subs = [n for n in fused._toposort() if n._attr.get("__subgraph__")]
+    assert len(subs) == 1
+    assert len(subs[0]._attr["__subgraph_ops__"].split(",")) == 4
+    vals = {"a": nd.array(onp.array([[-1.0, 2.0]], "float32")),
+            "b": nd.array(onp.array([[3.0, -4.0]], "float32"))}
+    got = fused.bind(mx.cpu(), dict(vals)).forward()[0].asnumpy()
+    want = out.bind(mx.cpu(), dict(vals)).forward()[0].asnumpy()
+    onp.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_non_convex_region_is_cut():
+    # a -> FC -> FC -> d  and  a -> d : a,d both selectable but the path
+    # through the two FCs leaves the region — partitioner must not fuse
+    # {a, d} together (reference build_subgraph.cc convexity labelling)
+    x = sym.var("data")
+    a = sym.relu(x)
+    c = sym.FullyConnected(sym.FullyConnected(a, num_hidden=2, name="fc1"),
+                           num_hidden=2, name="fc2")
+    d = sym.elemwise_add(a * 1.0, c)
+    fused = d.get_backend_symbol("TPU_ELEMWISE")  # must not crash
+    vals = {"data": nd.array(onp.ones((2, 2), "float32"))}
+    ex = fused.simple_bind(mx.cpu(), data=(2, 2))
+    for k in ex.arg_dict:
+        ex.arg_dict[k][:] = 0.5
+    ex0 = d.simple_bind(mx.cpu(), data=(2, 2))
+    for k in ex0.arg_dict:
+        ex0.arg_dict[k][:] = 0.5
+    onp.testing.assert_allclose(ex.forward()[0].asnumpy(),
+                                ex0.forward()[0].asnumpy(), rtol=1e-5)
+
+
+def test_partitioned_symbol_json_roundtrip():
+    from mxnet_tpu.symbol import load_json
+    net = _mlp()
+    fused = net.get_backend_symbol("TPU_ELEMWISE")
+    js = fused.tojson()
+    back = load_json(js)
+    vals = _bindings()
+    got = back.bind(mx.cpu(), dict(vals)).forward()[0].asnumpy()
+    want = net.bind(mx.cpu(), dict(vals)).forward()[0].asnumpy()
+    onp.testing.assert_allclose(got, want, rtol=1e-5)
