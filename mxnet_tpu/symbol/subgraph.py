@@ -173,30 +173,23 @@ def _region_io(region):
 
 
 def _make_subgraph_fn(region, ext_inputs, out_nodes):
-    """Compose the region into one pure function of the external inputs."""
-    inside = {id(n) for n in region}
-    ext_index = {(id(p), oi): i for i, (p, oi) in enumerate(ext_inputs)}
+    """Compose the region into one pure function of the external inputs.
+    Argument resolution reuses symbol.py's `_node_arg_values` (same
+    const/raw-input protocol as unfused evaluation) over a values dict
+    seeded with the external inputs."""
+    from .symbol import _node_arg_values, _out_key
 
     def fn(*args):
-        values = {}
+        values = {_out_key(p, oi): args[i]
+                  for i, (p, oi) in enumerate(ext_inputs)}
         for n in region:
-            call_args = []
-            for p in getattr(n, "_raw_inputs", n._inputs):
-                if isinstance(p, tuple) and p and p[0] == "const":
-                    call_args.append(p[1])
-                    continue
-                prod, oi = p
-                if id(prod) in inside:
-                    call_args.append(values[(id(prod), oi)])
-                else:
-                    call_args.append(args[ext_index[(id(prod), oi)]])
-            out = n._op.fn(*call_args, **n._kwargs)
+            out = n._op.fn(*_node_arg_values(n, values), **n._kwargs)
             if isinstance(out, tuple):
                 for i, v in enumerate(out):
-                    values[(id(n), i)] = v
+                    values[_out_key(n, i)] = v
             else:
-                values[(id(n), 0)] = out
-        outs = tuple(values[(id(n), 0)] for n in out_nodes)
+                values[_out_key(n, 0)] = out
+        outs = tuple(values[_out_key(n, 0)] for n in out_nodes)
         return outs if len(outs) > 1 else outs[0]
 
     return fn

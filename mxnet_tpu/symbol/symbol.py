@@ -214,6 +214,20 @@ class Symbol:
     def bind(self, ctx, args, args_grad=None, grad_req="write",
              aux_states=None, group2ctx=None, shared_exec=None):
         from .executor import Executor
+        # resolve positional lists against THIS symbol's argument order
+        # before partitioning (a partitioned graph may traverse variables
+        # in a different order, and Executor zips names from the symbol it
+        # is given)
+        arg_names = self.list_arguments()
+        if isinstance(args, (list, tuple)):
+            args = dict(zip(arg_names, args))
+        if isinstance(args_grad, (list, tuple)):
+            args_grad = dict(zip(arg_names, args_grad))
+        if not isinstance(grad_req, str) and \
+                isinstance(grad_req, (list, tuple)):
+            grad_req = dict(zip(arg_names, grad_req))
+        if isinstance(aux_states, (list, tuple)):
+            aux_states = dict(zip(self.list_auxiliary_states(), aux_states))
         sym = self._env_partitioned()
         return Executor(sym, ctx, args, args_grad, grad_req, aux_states)
 
@@ -226,7 +240,14 @@ class Symbol:
         if backend and backend not in ("NONE", ""):
             from .subgraph import partition, _BACKENDS
             if backend in _BACKENDS:
-                return partition(self, backend)
+                # memoize per backend: repeated binds must reuse the same
+                # fused ops (and their jit caches) instead of re-registering
+                cache = getattr(self, "_partition_cache", None)
+                if cache is None:
+                    cache = self._partition_cache = {}
+                if backend not in cache:
+                    cache[backend] = partition(self, backend)
+                return cache[backend]
             import logging
             logging.warning(
                 "MXNET_SUBGRAPH_BACKEND=%r is not a registered subgraph "

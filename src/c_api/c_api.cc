@@ -1,0 +1,340 @@
+// Flat C ABI over the mxnet_tpu runtime.
+//
+// Role parity: reference `include/mxnet/c_api.h` (3,244-line flat ABI) and
+// `src/c_api/` (NDArray CRUD c_api.cc:209-271, imperative invoke
+// c_api_ndarray.cc:87-149, registry listing). The reference keeps ONE C
+// boundary so every language binding (§2.3: R/Scala/Julia/C++/...) stays
+// mechanical; this library preserves that principle for the TPU rebuild.
+//
+// TPU-native design: the runtime's execution substrate is XLA behind the
+// Python/JAX layer, so the C ABI embeds CPython and drives the SAME
+// runtime objects the Python frontend uses (one handle type, one op
+// registry) instead of duplicating a second native runtime. A C host can
+// link this library standalone (MXTpuInit boots an interpreter) or live
+// inside an existing Python process (handles share the interpreter).
+// Every entry point is exception-safe: failures set a thread-local error
+// string readable via MXGetLastError (reference c_api_error.cc contract).
+
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#define MXTPU_API extern "C" __attribute__((visibility("default")))
+
+typedef void* NDArrayHandle;
+
+namespace {
+
+thread_local std::string g_last_error;
+
+void set_error(const std::string& msg) { g_last_error = msg; }
+
+// Scoped GIL ownership for calls arriving from arbitrary host threads.
+class GILGuard {
+ public:
+  GILGuard() : state_(PyGILState_Ensure()) {}
+  ~GILGuard() { PyGILState_Release(state_); }
+
+ private:
+  PyGILState_STATE state_;
+};
+
+std::string py_error_string() {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  PyErr_NormalizeException(&type, &value, &tb);
+  std::string msg = "unknown python error";
+  if (value) {
+    PyObject* s = PyObject_Str(value);
+    if (s) {
+      msg = PyUnicode_AsUTF8(s);
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+  return msg;
+}
+
+// Borrowed module cache (imported once per process).
+PyObject* runtime_module() {
+  static PyObject* mod = nullptr;
+  if (mod == nullptr) {
+    mod = PyImport_ImportModule("mxnet_tpu");
+  }
+  return mod;  // may be nullptr with python error set
+}
+
+PyObject* ndarray_module() {
+  static PyObject* mod = nullptr;
+  if (mod == nullptr) {
+    mod = PyImport_ImportModule("mxnet_tpu.ndarray.ndarray");
+  }
+  return mod;
+}
+
+PyObject* registry_module() {
+  static PyObject* mod = nullptr;
+  if (mod == nullptr) {
+    mod = PyImport_ImportModule("mxnet_tpu.ops.registry");
+  }
+  return mod;
+}
+
+}  // namespace
+
+// ---------------------------------------------------------------- lifecycle
+
+// Boot an interpreter when hosted by a non-Python program (reference
+// `src/initialize.cc` library init). extra_sys_path may be NULL; pass the
+// repo root when mxnet_tpu is not on the default sys.path.
+MXTPU_API int MXTpuInit(const char* extra_sys_path) {
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+  }
+  GILGuard gil;
+  if (extra_sys_path && *extra_sys_path) {
+    PyObject* sys_path = PySys_GetObject("path");  // borrowed
+    PyObject* p = PyUnicode_FromString(extra_sys_path);
+    if (sys_path && p) PyList_Insert(sys_path, 0, p);
+    Py_XDECREF(p);
+  }
+  if (runtime_module() == nullptr) {
+    set_error(py_error_string());
+    return -1;
+  }
+  return 0;
+}
+
+MXTPU_API const char* MXGetLastError() { return g_last_error.c_str(); }
+
+MXTPU_API int MXGetVersion(int* out) {
+  GILGuard gil;
+  PyObject* mod = runtime_module();
+  if (!mod) { set_error(py_error_string()); return -1; }
+  PyObject* v = PyObject_GetAttrString(mod, "__version__");
+  if (!v) { set_error(py_error_string()); return -1; }
+  // "maj.min.patch" -> 10000*maj + 100*min + patch (reference MXNET_VERSION)
+  const char* s = PyUnicode_AsUTF8(v);
+  int maj = 0, min = 0, patch = 0;
+  if (s) sscanf(s, "%d.%d.%d", &maj, &min, &patch);
+  Py_DECREF(v);
+  *out = maj * 10000 + min * 100 + patch;
+  return 0;
+}
+
+// ------------------------------------------------------------------ ndarray
+
+MXTPU_API int MXNDArrayCreate(const int64_t* shape, int ndim,
+                              const char* dtype, NDArrayHandle* out) {
+  GILGuard gil;
+  PyObject* mod = ndarray_module();
+  if (!mod) { set_error(py_error_string()); return -1; }
+  PyObject* shp = PyTuple_New(ndim);
+  for (int i = 0; i < ndim; ++i) {
+    PyTuple_SET_ITEM(shp, i, PyLong_FromLongLong(shape[i]));
+  }
+  // zeros(shape, ctx=None, dtype=...) — ctx defaults to the current device
+  PyObject* res = PyObject_CallMethod(mod, "zeros", "OOs", shp, Py_None,
+                                      dtype ? dtype : "float32");
+  Py_DECREF(shp);
+  if (!res) { set_error(py_error_string()); return -1; }
+  *out = static_cast<NDArrayHandle>(res);  // owned reference -> handle
+  return 0;
+}
+
+MXTPU_API int MXNDArrayFree(NDArrayHandle handle) {
+  GILGuard gil;
+  Py_XDECREF(static_cast<PyObject*>(handle));
+  return 0;
+}
+
+MXTPU_API int MXNDArrayGetShape(NDArrayHandle handle, int* out_ndim,
+                                int64_t* out_shape, int max_ndim) {
+  GILGuard gil;
+  PyObject* arr = static_cast<PyObject*>(handle);
+  PyObject* shp = PyObject_GetAttrString(arr, "shape");
+  if (!shp) { set_error(py_error_string()); return -1; }
+  Py_ssize_t n = PyTuple_Size(shp);
+  if (n > max_ndim) { Py_DECREF(shp); set_error("shape buffer too small");
+    return -1; }
+  *out_ndim = static_cast<int>(n);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    out_shape[i] = PyLong_AsLongLong(PyTuple_GET_ITEM(shp, i));
+  }
+  Py_DECREF(shp);
+  return 0;
+}
+
+// Blocking host<->device copies, fp32 (reference MXNDArraySyncCopyFromCPU /
+// SyncCopyToCPU, `src/c_api/c_api.cc`). Size is the element count.
+MXTPU_API int MXNDArraySyncCopyFromCPU(NDArrayHandle handle,
+                                       const float* data, int64_t size) {
+  GILGuard gil;
+  PyObject* arr = static_cast<PyObject*>(handle);
+  PyObject* np = PyImport_ImportModule("numpy");
+  if (!np) { set_error(py_error_string()); return -1; }
+  // build a numpy array viewing the host buffer, then assign via x[:] = v
+  PyObject* mv = PyMemoryView_FromMemory(
+      reinterpret_cast<char*>(const_cast<float*>(data)),
+      size * static_cast<int64_t>(sizeof(float)), PyBUF_READ);
+  PyObject* flat = PyObject_CallMethod(np, "frombuffer", "Os", mv, "float32");
+  Py_DECREF(mv);
+  Py_DECREF(np);
+  if (!flat) { set_error(py_error_string()); return -1; }
+  PyObject* shp = PyObject_GetAttrString(arr, "shape");
+  PyObject* shaped = PyObject_CallMethod(flat, "reshape", "O", shp);
+  Py_DECREF(flat);
+  Py_DECREF(shp);
+  if (!shaped) { set_error(py_error_string()); return -1; }
+  PyObject* slice = PySlice_New(nullptr, nullptr, nullptr);
+  int rc = PyObject_SetItem(arr, slice, shaped);
+  Py_DECREF(slice);
+  Py_DECREF(shaped);
+  if (rc != 0) { set_error(py_error_string()); return -1; }
+  return 0;
+}
+
+MXTPU_API int MXNDArraySyncCopyToCPU(NDArrayHandle handle, float* data,
+                                     int64_t size) {
+  GILGuard gil;
+  PyObject* arr = static_cast<PyObject*>(handle);
+  PyObject* host = PyObject_CallMethod(arr, "asnumpy", nullptr);
+  if (!host) { set_error(py_error_string()); return -1; }
+  PyObject* f32 = PyObject_CallMethod(host, "astype", "s", "float32");
+  Py_DECREF(host);
+  if (!f32) { set_error(py_error_string()); return -1; }
+  PyObject* flat = PyObject_CallMethod(f32, "ravel", nullptr);
+  Py_DECREF(f32);
+  if (!flat) { set_error(py_error_string()); return -1; }
+  Py_buffer view;
+  if (PyObject_GetBuffer(flat, &view, PyBUF_CONTIG_RO) != 0) {
+    Py_DECREF(flat);
+    set_error(py_error_string());
+    return -1;
+  }
+  int64_t n = view.len / static_cast<int64_t>(sizeof(float));
+  if (n > size) {
+    PyBuffer_Release(&view);
+    Py_DECREF(flat);
+    set_error("destination buffer too small");
+    return -1;
+  }
+  std::memcpy(data, view.buf, view.len);
+  PyBuffer_Release(&view);
+  Py_DECREF(flat);
+  return 0;
+}
+
+MXTPU_API int MXNDArrayWaitAll() {
+  GILGuard gil;
+  PyObject* mod = ndarray_module();
+  if (!mod) { set_error(py_error_string()); return -1; }
+  PyObject* r = PyObject_CallMethod(mod, "waitall", nullptr);
+  if (!r) { set_error(py_error_string()); return -1; }
+  Py_DECREF(r);
+  return 0;
+}
+
+// ---------------------------------------------------------------- operators
+
+// Invoke a registered operator by name (reference MXImperativeInvokeEx,
+// `src/c_api/c_api_ndarray.cc:138`). kwargs_json is a JSON object of
+// non-tensor parameters (the reference passes const char** keys/vals from
+// its generated frontends; JSON keeps the ABI small). Outputs are returned
+// as new handles in out_array (capacity *num_outputs, updated to actual).
+MXTPU_API int MXImperativeInvoke(const char* op_name, NDArrayHandle* inputs,
+                                 int num_inputs, const char* kwargs_json,
+                                 NDArrayHandle* out_array, int* num_outputs) {
+  GILGuard gil;
+  PyObject* reg = registry_module();
+  if (!reg) { set_error(py_error_string()); return -1; }
+  PyObject* op = PyObject_CallMethod(reg, "get_op", "s", op_name);
+  if (!op) { set_error(py_error_string()); return -1; }
+  if (op == Py_None) {
+    Py_DECREF(op);
+    set_error(std::string("unknown operator: ") + op_name);
+    return -1;
+  }
+  PyObject* args = PyTuple_New(num_inputs);
+  for (int i = 0; i < num_inputs; ++i) {
+    PyObject* a = static_cast<PyObject*>(inputs[i]);
+    Py_INCREF(a);
+    PyTuple_SET_ITEM(args, i, a);
+  }
+  PyObject* kwargs = nullptr;
+  if (kwargs_json && *kwargs_json) {
+    PyObject* json = PyImport_ImportModule("json");
+    if (json) {
+      kwargs = PyObject_CallMethod(json, "loads", "s", kwargs_json);
+      Py_DECREF(json);
+    }
+    if (!kwargs) {
+      Py_DECREF(args);
+      Py_DECREF(op);
+      set_error(py_error_string());
+      return -1;
+    }
+  }
+  PyObject* res = PyObject_Call(op, args, kwargs);
+  Py_DECREF(args);
+  Py_XDECREF(kwargs);
+  Py_DECREF(op);
+  if (!res) { set_error(py_error_string()); return -1; }
+  int cap = *num_outputs;
+  if (PyTuple_Check(res) || PyList_Check(res)) {
+    PyObject* seq = PySequence_Fast(res, "op output");
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+    if (n > cap) {
+      Py_DECREF(seq);
+      Py_DECREF(res);
+      set_error("output buffer too small");
+      return -1;
+    }
+    for (Py_ssize_t i = 0; i < n; ++i) {
+      PyObject* o = PySequence_Fast_GET_ITEM(seq, i);
+      Py_INCREF(o);
+      out_array[i] = o;
+    }
+    *num_outputs = static_cast<int>(n);
+    Py_DECREF(seq);
+    Py_DECREF(res);
+  } else {
+    if (cap < 1) { Py_DECREF(res); set_error("output buffer too small");
+      return -1; }
+    out_array[0] = res;  // transfer ownership
+    *num_outputs = 1;
+  }
+  return 0;
+}
+
+// Registry listing (reference MXListAllOpNames, `src/c_api/c_api.cc`).
+// Returned pointers stay valid until the next call on the same thread.
+MXTPU_API int MXListAllOpNames(int* out_size, const char*** out_array) {
+  GILGuard gil;
+  static thread_local std::vector<std::string> storage;
+  static thread_local std::vector<const char*> ptrs;
+  PyObject* reg = registry_module();
+  if (!reg) { set_error(py_error_string()); return -1; }
+  PyObject* names = PyObject_CallMethod(reg, "list_ops", nullptr);
+  if (!names) { set_error(py_error_string()); return -1; }
+  PyObject* seq = PySequence_Fast(names, "op names");
+  Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+  storage.clear();
+  ptrs.clear();
+  storage.reserve(n);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    storage.emplace_back(
+        PyUnicode_AsUTF8(PySequence_Fast_GET_ITEM(seq, i)));
+  }
+  for (auto& s : storage) ptrs.push_back(s.c_str());
+  Py_DECREF(seq);
+  Py_DECREF(names);
+  *out_size = static_cast<int>(n);
+  *out_array = ptrs.data();
+  return 0;
+}

@@ -1,0 +1,56 @@
+/* Flat C ABI for the mxnet_tpu runtime.
+ *
+ * Role parity: reference `include/mxnet/c_api.h` — the single C boundary
+ * every language binding crosses (§2.3 of SURVEY). See src/c_api/c_api.cc
+ * for the TPU-native design notes.
+ *
+ * Conventions (same as the reference ABI):
+ *   - every function returns 0 on success, -1 on failure;
+ *   - on failure MXGetLastError() returns a human-readable message;
+ *   - handles are opaque and must be released with MXNDArrayFree.
+ */
+#ifndef MXTPU_C_H_
+#define MXTPU_C_H_
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef void* NDArrayHandle;
+
+/* Boot/attach the runtime. extra_sys_path: directory containing the
+ * mxnet_tpu package (NULL if already importable). Safe to call from a
+ * process that already hosts a Python interpreter. */
+int MXTpuInit(const char* extra_sys_path);
+
+const char* MXGetLastError(void);
+
+/* version as 10000*major + 100*minor + patch (reference MXNET_VERSION) */
+int MXGetVersion(int* out);
+
+int MXNDArrayCreate(const int64_t* shape, int ndim, const char* dtype,
+                    NDArrayHandle* out);
+int MXNDArrayFree(NDArrayHandle handle);
+int MXNDArrayGetShape(NDArrayHandle handle, int* out_ndim,
+                      int64_t* out_shape, int max_ndim);
+int MXNDArraySyncCopyFromCPU(NDArrayHandle handle, const float* data,
+                             int64_t size);
+int MXNDArraySyncCopyToCPU(NDArrayHandle handle, float* data, int64_t size);
+int MXNDArrayWaitAll(void);
+
+/* Invoke a registered operator by name; kwargs_json carries non-tensor
+ * parameters as a JSON object (may be NULL). On entry *num_outputs is the
+ * capacity of out_array; on success it holds the actual output count. */
+int MXImperativeInvoke(const char* op_name, NDArrayHandle* inputs,
+                       int num_inputs, const char* kwargs_json,
+                       NDArrayHandle* out_array, int* num_outputs);
+
+int MXListAllOpNames(int* out_size, const char*** out_array);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif  /* MXTPU_C_H_ */

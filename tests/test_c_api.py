@@ -1,0 +1,142 @@
+"""C ABI tests (reference `include/mxnet/c_api.h` principle — §2.3: one C
+boundary for all language bindings). Two scenarios:
+
+1. ctypes in-process: the library attaches to THIS interpreter and shares
+   its runtime/handles (how the reference's own Python frontend crosses
+   the boundary).
+2. standalone C host: a compiled C program boots the runtime itself via
+   MXTpuInit — the R/Scala/Julia-binding scenario.
+"""
+import ctypes
+import os
+import pathlib
+import subprocess
+
+import numpy as onp
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+LIB = REPO / "lib" / "libmxtpu_c.so"
+
+
+def _built():
+    if LIB.exists():
+        return True
+    r = subprocess.run(["make", "-C", str(REPO / "src")],
+                       capture_output=True, text=True)
+    return r.returncode == 0 and LIB.exists()
+
+
+pytestmark = pytest.mark.skipif(not _built(),
+                                reason="libmxtpu_c.so not built")
+
+
+@pytest.fixture(scope="module")
+def capi():
+    lib = ctypes.CDLL(str(LIB))
+    c = ctypes
+    lib.MXGetLastError.restype = c.c_char_p
+    lib.MXTpuInit.argtypes = [c.c_char_p]
+    lib.MXGetVersion.argtypes = [c.POINTER(c.c_int)]
+    lib.MXNDArrayCreate.argtypes = [c.POINTER(c.c_int64), c.c_int,
+                                    c.c_char_p, c.POINTER(c.c_void_p)]
+    lib.MXNDArrayFree.argtypes = [c.c_void_p]
+    lib.MXNDArrayGetShape.argtypes = [c.c_void_p, c.POINTER(c.c_int),
+                                      c.POINTER(c.c_int64), c.c_int]
+    lib.MXNDArraySyncCopyFromCPU.argtypes = [c.c_void_p,
+                                             c.POINTER(c.c_float),
+                                             c.c_int64]
+    lib.MXNDArraySyncCopyToCPU.argtypes = [c.c_void_p,
+                                           c.POINTER(c.c_float), c.c_int64]
+    lib.MXImperativeInvoke.argtypes = [c.c_char_p, c.POINTER(c.c_void_p),
+                                       c.c_int, c.c_char_p,
+                                       c.POINTER(c.c_void_p),
+                                       c.POINTER(c.c_int)]
+    lib.MXListAllOpNames.argtypes = [c.POINTER(c.c_int),
+                                     c.POINTER(c.POINTER(c.c_char_p))]
+    assert lib.MXTpuInit(None) == 0, lib.MXGetLastError()
+    return lib
+
+
+def test_version_and_ops(capi):
+    v = ctypes.c_int()
+    assert capi.MXGetVersion(ctypes.byref(v)) == 0
+    assert v.value >= 100  # 10000*maj + 100*min + patch (0.1.0 -> 100)
+    n = ctypes.c_int()
+    names = ctypes.POINTER(ctypes.c_char_p)()
+    assert capi.MXListAllOpNames(ctypes.byref(n), ctypes.byref(names)) == 0
+    assert n.value > 400
+    seen = {names[i].decode() for i in range(min(n.value, 2000))}
+    assert "relu" in seen and "Convolution" in seen
+
+
+def test_ndarray_roundtrip_and_invoke(capi):
+    shape = (ctypes.c_int64 * 2)(2, 2)
+    h = ctypes.c_void_p()
+    assert capi.MXNDArrayCreate(shape, 2, b"float32",
+                                ctypes.byref(h)) == 0
+    src = (ctypes.c_float * 4)(-1.0, 2.0, -3.0, 4.0)
+    assert capi.MXNDArraySyncCopyFromCPU(h, src, 4) == 0
+
+    outs = (ctypes.c_void_p * 2)()
+    n_out = ctypes.c_int(2)
+    assert capi.MXImperativeInvoke(b"relu", ctypes.byref(h), 1, None,
+                                   outs, ctypes.byref(n_out)) == 0
+    assert n_out.value == 1
+    dst = (ctypes.c_float * 4)()
+    assert capi.MXNDArraySyncCopyToCPU(outs[0], dst, 4) == 0
+    onp.testing.assert_allclose(list(dst), [0.0, 2.0, 0.0, 4.0])
+
+    ndim = ctypes.c_int()
+    oshape = (ctypes.c_int64 * 8)()
+    assert capi.MXNDArrayGetShape(outs[0], ctypes.byref(ndim), oshape, 8) == 0
+    assert ndim.value == 2 and oshape[0] == 2 and oshape[1] == 2
+
+    capi.MXNDArrayFree(h)
+    capi.MXNDArrayFree(outs[0])
+
+
+def test_invoke_with_kwargs_and_error(capi):
+    shape = (ctypes.c_int64 * 2)(2, 3)
+    h = ctypes.c_void_p()
+    assert capi.MXNDArrayCreate(shape, 2, b"float32", ctypes.byref(h)) == 0
+    src = (ctypes.c_float * 6)(1, 2, 3, 4, 5, 6)
+    assert capi.MXNDArraySyncCopyFromCPU(h, src, 6) == 0
+    outs = (ctypes.c_void_p * 2)()
+    n_out = ctypes.c_int(2)
+    assert capi.MXImperativeInvoke(b"sum", ctypes.byref(h), 1,
+                                   b'{"axis": 0}', outs,
+                                   ctypes.byref(n_out)) == 0
+    dst = (ctypes.c_float * 3)()
+    assert capi.MXNDArraySyncCopyToCPU(outs[0], dst, 3) == 0
+    onp.testing.assert_allclose(list(dst), [5.0, 7.0, 9.0])
+    capi.MXNDArrayFree(outs[0])
+
+    # unknown op surfaces through MXGetLastError, not a crash
+    n_out = ctypes.c_int(2)
+    assert capi.MXImperativeInvoke(b"definitely_not_an_op",
+                                   ctypes.byref(h), 1, None, outs,
+                                   ctypes.byref(n_out)) == -1
+    assert b"unknown operator" in capi.MXGetLastError()
+    capi.MXNDArrayFree(h)
+
+
+def test_standalone_c_host():
+    """Compile tests/c_api/host_test.c against the ABI and run it as its
+    own process (boots the runtime via MXTpuInit)."""
+    exe = REPO / "lib" / "host_test"
+    src = REPO / "tests" / "c_api" / "host_test.c"
+    inc = REPO / "src" / "include"
+    r = subprocess.run(
+        ["gcc", "-O1", str(src), "-I", str(inc),
+         "-L", str(REPO / "lib"), "-lmxtpu_c",
+         "-Wl,-rpath," + str(REPO / "lib"), "-o", str(exe)],
+        capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"  # C host must not dial the TPU tunnel
+    env.pop("XLA_FLAGS", None)
+    r = subprocess.run([str(exe), str(REPO)], capture_output=True,
+                       text=True, env=env, timeout=300)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "C_API_HOST_OK" in r.stdout

@@ -161,3 +161,32 @@ def test_partitioned_symbol_json_roundtrip():
     got = back.bind(mx.cpu(), dict(vals)).forward()[0].asnumpy()
     want = net.bind(mx.cpu(), dict(vals)).forward()[0].asnumpy()
     onp.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_env_knob_positional_args_keep_original_order(monkeypatch):
+    # reviewer repro: partitioning permutes list_arguments traversal; a
+    # positional-args bind must still map by the ORIGINAL symbol's order
+    monkeypatch.setenv("MXNET_SUBGRAPH_BACKEND", "TPU_ELEMWISE")
+    a = sym.var("a")
+    c = sym.var("c")
+    out = sym.elemwise_add(
+        sym.FullyConnected(a, num_hidden=2, no_bias=True, name="fc"),
+        sym.relu(c))
+    names = out.list_arguments()
+    vals = {"a": nd.array(onp.ones((1, 2), "float32")),
+            "fc_weight": nd.array(onp.ones((2, 2), "float32")),
+            "c": nd.array(onp.zeros((1, 2), "float32"))}
+    ex = out.bind(mx.cpu(), [vals[n] for n in names])
+    onp.testing.assert_allclose(ex.forward()[0].asnumpy(), [[2.0, 2.0]])
+
+
+def test_env_knob_rebind_reuses_fused_ops(monkeypatch):
+    monkeypatch.setenv("MXNET_SUBGRAPH_BACKEND", "TPU_ELEMWISE")
+    from mxnet_tpu.ops.registry import list_ops
+    net = _mlp()
+    vals = _bindings()
+    net.bind(mx.cpu(), dict(vals)).forward()
+    n_ops_after_first = len(list_ops())
+    for _ in range(3):
+        net.bind(mx.cpu(), dict(vals)).forward()
+    assert len(list_ops()) == n_ops_after_first
