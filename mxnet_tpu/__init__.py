@@ -62,10 +62,19 @@ _LAZY = {
     "operator": ".operator",
     "rnn": ".rnn",
     "util": ".util",
+    "rtc": ".rtc",
+    "library": ".library",
+    "tvmop": ".tvmop",
+    "th": ".torch_bridge",
+    "torch_bridge": ".torch_bridge",
 }
 
 
 def __getattr__(name):
+    if name == "AttrScope":  # mx.AttrScope (reference attribute.py)
+        from .symbol import AttrScope
+        globals()[name] = AttrScope
+        return AttrScope
     target = _LAZY.get(name)
     if target is None:
         raise AttributeError("module 'mxnet_tpu' has no attribute %r" % name)

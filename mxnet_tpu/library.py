@@ -1,0 +1,53 @@
+"""External operator libraries (``mx.library``).
+
+Parity surface: reference ``python/mxnet/library.py`` — ``load(path)``
+dlopens a compiled op library built against `include/mxnet/lib_api.h:33`
+(MXLoadLib) whose ops then appear under ``mx.nd.*``.
+
+TPU-native design: an "op library" for this runtime is a Python module (or
+package) that registers pure-JAX/Pallas ops via
+``mxnet_tpu.ops.registry.register`` at import time — the registration hook
+plays lib_api.h's role, and XLA compiles the kernels, so there is no ABI
+boundary to dlopen. ``load`` imports the file/module, verifies it
+registered something, and returns the list of new op names. Shared-object
+paths are rejected with guidance (C++ custom *runtime* code belongs in
+src/ behind the C ABI; custom *kernels* are Pallas)."""
+from __future__ import annotations
+
+import importlib
+import importlib.util
+import os
+import sys
+
+from .base import MXNetError
+from .ops.registry import list_ops
+
+__all__ = ["load"]
+
+
+def load(path, verbose=True):
+    """Load an operator library and return the newly registered op names
+    (reference library.py:25 load → MXLoadLib)."""
+    before = set(list_ops())
+    if path.endswith((".so", ".dylib", ".dll")):
+        raise MXNetError(
+            "compiled op libraries are a CUDA-runtime mechanism "
+            "(reference lib_api.h); on TPU register kernels from Python "
+            "via mxnet_tpu.ops.registry.register (Pallas for custom "
+            "kernels) and mx.library.load the registering .py module")
+    if os.path.exists(path):
+        name = os.path.splitext(os.path.basename(path))[0]
+        spec = importlib.util.spec_from_file_location(name, path)
+        if spec is None or spec.loader is None:
+            raise MXNetError("cannot load op library %r" % path)
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules[name] = mod
+        spec.loader.exec_module(mod)
+    else:
+        mod = importlib.import_module(path)
+    added = sorted(set(list_ops()) - before)
+    if verbose:
+        import logging
+        logging.info("mx.library.load(%s): %d new operators %s",
+                     path, len(added), added[:8])
+    return added

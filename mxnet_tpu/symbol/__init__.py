@@ -1,6 +1,6 @@
 """Symbol API (reference ``python/mxnet/symbol/``)."""
-from .symbol import (Symbol, var, Variable, Group, load, load_json, zeros,
-                     ones, arange)
+from .symbol import (Symbol, var, Variable, Group, AttrScope, load,
+                     load_json, zeros, ones, arange)
 from .symbol import _populate_ops as _pop
 
 _pop(globals())

@@ -23,9 +23,20 @@ __all__ = ["Executor"]
 
 class Executor:
     def __init__(self, symbol, ctx, args, args_grad=None, grad_req="write",
-                 aux_states=None):
+                 aux_states=None, group2ctx=None):
         self._symbol = symbol
         self._ctx = ctx
+        # model-parallel placement (reference group2ctx,
+        # graph_executor.cc:1956): nodes whose 'ctx_group'/'__ctx_group__'
+        # attr names a group in group2ctx execute on that group's device
+        self._placement = {}
+        if group2ctx:
+            for node in symbol._toposort():
+                grp = node._attr.get("ctx_group") or \
+                    node._attr.get("__ctx_group__")
+                if grp is not None and grp in group2ctx:
+                    self._placement[id(node)] = \
+                        group2ctx[grp].jax_device
         arg_names = symbol.list_arguments()
         aux_names = symbol.list_auxiliary_states()
         if isinstance(args, (list, tuple)):
@@ -70,12 +81,14 @@ class Executor:
             symbol = self._symbol
 
             names_c, train_c = key_names, is_train
+            placement_c = self._placement
 
             def run(rng, binding_vals):
                 _random.push_trace_key(rng)
                 try:
                     binds = dict(zip(names_c, binding_vals))
-                    return evaluate_graph(symbol, binds, train=train_c)
+                    return evaluate_graph(symbol, binds, train=train_c,
+                                          placement=placement_c)
                 finally:
                     _random.pop_trace_key()
 
@@ -107,7 +120,8 @@ class Executor:
             try:
                 b = dict(binds)
                 b.update(dict(zip(wanted, vals)))
-                return evaluate_graph(symbol, b, train=True)
+                return evaluate_graph(symbol, b, train=True,
+                                      placement=self._placement)
             finally:
                 _random.pop_trace_key()
 

@@ -273,13 +273,25 @@ def partition(symbol, backend):
         fn = _make_subgraph_fn(region, ext_inputs, outs)
         uname = "_subgraph_%s_%d" % (backend, next(_fused_counter))
         op = prop.build_fused_op(uname, fn, len(outs))
+        attrs = {"__subgraph__": backend,
+                 "__subgraph_ops__": ",".join(n._op.name for n in region)}
+        # keep group2ctx placement working through fusion: a region whose
+        # ops all share one ctx_group carries it onto the fused node
+        groups = {n._attr.get("ctx_group") or n._attr.get("__ctx_group__")
+                  for n in region}
+        groups.discard(None)
+        if len(groups) == 1:
+            attrs["ctx_group"] = next(iter(groups))
+        elif len(groups) > 1:
+            import logging
+            logging.warning(
+                "subgraph region %s spans ctx_groups %s; placement "
+                "attrs dropped for the fused node", uname, sorted(groups))
         node = Symbol(op=op,
                       inputs=[mapped(p, oi) for p, oi in ext_inputs],
                       kwargs={},
                       name=uname,
-                      attr={"__subgraph__": backend,
-                            "__subgraph_ops__": ",".join(
-                                n._op.name for n in region)})
+                      attr=attrs)
         node._num_out = len(outs)
         building.discard(ri)
         fused_nodes[ri] = node

@@ -28,7 +28,8 @@ from ..ops.registry import get_op, list_ops
 from .. import _tape
 from .. import random as _random
 
-__all__ = ["Symbol", "var", "Variable", "Group", "load", "load_json",
+__all__ = ["Symbol", "var", "Variable", "Group", "AttrScope", "load",
+           "load_json",
            "zeros", "ones", "arange"]
 
 
@@ -223,13 +224,13 @@ class Symbol:
             args = dict(zip(arg_names, args))
         if isinstance(args_grad, (list, tuple)):
             args_grad = dict(zip(arg_names, args_grad))
-        if not isinstance(grad_req, str) and \
-                isinstance(grad_req, (list, tuple)):
+        if isinstance(grad_req, (list, tuple)):
             grad_req = dict(zip(arg_names, grad_req))
         if isinstance(aux_states, (list, tuple)):
             aux_states = dict(zip(self.list_auxiliary_states(), aux_states))
         sym = self._env_partitioned()
-        return Executor(sym, ctx, args, args_grad, grad_req, aux_states)
+        return Executor(sym, ctx, args, args_grad, grad_req, aux_states,
+                        group2ctx=group2ctx)
 
     def _env_partitioned(self):
         """Apply MXNET_SUBGRAPH_BACKEND partitioning at bind time
@@ -361,10 +362,46 @@ def _out_key(sym, idx):
     return "%s#%d" % (id(sym), idx)
 
 
+class AttrScope:
+    """Scoped default attributes for symbols created inside the block
+    (reference `python/mxnet/attribute.py` AttrScope) — the canonical use
+    is model-parallel group placement::
+
+        with mx.AttrScope(ctx_group='dev1'):
+            h = mx.sym.FullyConnected(x, num_hidden=128)
+        ex = net.bind(ctx, args, group2ctx={'dev1': mx.tpu(1)})
+    """
+    _stack: list = []
+
+    def __init__(self, **attrs):
+        self._attrs = {k: str(v) for k, v in attrs.items()}
+
+    def __enter__(self):
+        # merge computed per entry onto a class-level stack: the instance
+        # is never mutated, so scopes are reusable and reentrant
+        base = AttrScope._stack[-1] if AttrScope._stack else {}
+        AttrScope._stack.append({**base, **self._attrs})
+        return self
+
+    def __exit__(self, *a):
+        AttrScope._stack.pop()
+
+    @staticmethod
+    def current_attrs():
+        return dict(AttrScope._stack[-1]) if AttrScope._stack else {}
+
+
+def _with_scope_attrs(attr):
+    merged = AttrScope.current_attrs()
+    if attr:
+        merged.update(attr)
+    return merged or None
+
+
 def var(name, attr=None, shape=None, lr_mult=None, wd_mult=None, dtype=None,
         init=None, stype=None, **kwargs):
     """Create a variable symbol (reference symbol.py var)."""
-    s = Symbol(op=None, name=name, attr=attr)
+    s = Symbol(op=None, name=name, attr=_with_scope_attrs(attr))
     s._shape_hint = tuple(shape) if shape is not None else None
     s._dtype_hint = dtype
     s._init = init
@@ -465,7 +502,8 @@ def _sym_op(opname):
                 node_inputs.append(outs[0])
             else:
                 node_inputs.append(("const", a))
-        node = Symbol(op=op, inputs=[], kwargs=kwargs, name=name, attr=attr)
+        node = Symbol(op=op, inputs=[], kwargs=kwargs, name=name,
+                      attr=_with_scope_attrs(attr))
         node._raw_inputs = node_inputs
         node._inputs = [p for p in node_inputs if p[0] != "const"]
         return node
@@ -494,8 +532,16 @@ def _node_arg_values(node, values):
     return args
 
 
-def evaluate_graph(root, bindings, train=False):
-    """Evaluate symbol graph given name→jax-array bindings for variables."""
+def evaluate_graph(root, bindings, train=False, placement=None):
+    """Evaluate symbol graph given name→jax-array bindings for variables.
+
+    ``placement`` maps node id → jax device for model-parallel group
+    placement (reference group2ctx, `graph_executor.cc:1956-2061`): a
+    placed node's inputs are device_put onto its group device, so XLA
+    runs the op there and materializes the cross-device copies the
+    reference's executor inserts explicitly. Works inside jit (the
+    transfer becomes a sharding annotation in the one compiled program).
+    """
     order = root._toposort()
     values = {}
     prev_train = _tape.set_training(train)
@@ -508,6 +554,10 @@ def evaluate_graph(root, bindings, train=False):
                 values[_out_key(node, 0)] = bindings[node._name]
                 continue
             args = _node_arg_values(node, values)
+            dev = placement.get(id(node)) if placement else None
+            if dev is not None:
+                args = [jax.device_put(a, dev)
+                        if hasattr(a, "dtype") else a for a in args]
             out = node._op.fn(*args, **node._kwargs)
             if isinstance(out, tuple):
                 for i, v in enumerate(out):

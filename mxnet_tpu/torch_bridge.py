@@ -1,0 +1,70 @@
+"""Torch interop (``mx.th`` / reference ``python/mxnet/torch.py``).
+
+Parity surface: the reference bridges Torch7 tensor functions into MXNet
+(`torch.py:37` _make_torch_function over a C glue layer) so users can mix
+torch ops with NDArrays.
+
+TPU-native design: PyTorch (CPU) interops through dlpack/numpy — no glue
+runtime. ``to_torch``/``from_torch`` convert NDArray <-> torch.Tensor
+(zero-copy via dlpack where both sides allow it), and ``torch_function``
+wraps any torch callable so it consumes/produces NDArrays, which is what
+the reference's generated `mx.th.*` namespace did for Torch7."""
+from __future__ import annotations
+
+from .base import MXNetError
+from .ndarray.ndarray import NDArray
+
+__all__ = ["to_torch", "from_torch", "torch_function"]
+
+
+def _torch():
+    try:
+        import torch
+        return torch
+    except ImportError as e:  # pragma: no cover
+        raise MXNetError("torch is not available: %s" % e)
+
+
+def to_torch(arr):
+    """NDArray -> torch.Tensor (dlpack when possible, else host copy)."""
+    torch = _torch()
+    if not isinstance(arr, NDArray):
+        raise TypeError("expected NDArray")
+    try:
+        return torch.from_dlpack(arr._data)
+    except Exception:
+        return torch.from_numpy(arr.asnumpy())
+
+
+def from_torch(tensor, ctx=None):
+    """torch.Tensor -> NDArray."""
+    torch = _torch()
+    if not isinstance(tensor, torch.Tensor):
+        raise TypeError("expected torch.Tensor")
+    t = tensor.detach().cpu().contiguous()
+    return NDArray(t.numpy(), ctx=ctx)
+
+
+def torch_function(fn):
+    """Wrap a torch callable to take/return NDArrays (the role of the
+    reference's generated mx.th.* functions)::
+
+        mx_conv = mx.th.torch_function(torch.nn.functional.conv2d)
+        y = mx_conv(x, w)           # x, w, y are NDArrays
+    """
+    torch = _torch()
+
+    def wrapped(*args, **kwargs):
+        def conv(a):
+            return to_torch(a) if isinstance(a, NDArray) else a
+        out = fn(*[conv(a) for a in args],
+                 **{k: conv(v) for k, v in kwargs.items()})
+        if isinstance(out, torch.Tensor):
+            return from_torch(out)
+        if isinstance(out, (tuple, list)):
+            return type(out)(from_torch(o) if isinstance(o, torch.Tensor)
+                             else o for o in out)
+        return out
+
+    wrapped.__name__ = getattr(fn, "__name__", "torch_function")
+    return wrapped

@@ -1,0 +1,20 @@
+"""TVM-operator hook (reference ``python/mxnet/tvmop.py`` +
+`src/nnvm/tvm_bridge.cc`): the reference can offload ops to TVM-compiled
+kernels. On TPU there is exactly one kernel compiler (XLA, with Pallas for
+hand-written kernels), so the TVM bridge has no role; this module keeps
+the import surface and directs users to the supported custom-kernel path."""
+from __future__ import annotations
+
+from .base import MXNetError
+
+__all__ = ["enabled", "load_module"]
+
+enabled = False
+
+
+def load_module(path):
+    raise MXNetError(
+        "TVM operator modules are not supported on the TPU runtime; "
+        "custom kernels are written with Pallas (mx.rtc.TpuModule) or "
+        "registered via mxnet_tpu.ops.registry.register / "
+        "mx.operator.CustomOp")

@@ -24,7 +24,9 @@
 
 #define MXTPU_API extern "C" __attribute__((visibility("default")))
 
-typedef void* NDArrayHandle;
+// compile against the public ABI so header/impl signature drift is a
+// compile error, not runtime corruption in C hosts
+#include "../include/mxtpu_c.h"
 
 namespace {
 
@@ -93,19 +95,28 @@ PyObject* registry_module() {
 // `src/initialize.cc` library init). extra_sys_path may be NULL; pass the
 // repo root when mxnet_tpu is not on the default sys.path.
 MXTPU_API int MXTpuInit(const char* extra_sys_path) {
-  if (!Py_IsInitialized()) {
+  bool booted_here = !Py_IsInitialized();
+  if (booted_here) {
     Py_InitializeEx(0);
   }
-  GILGuard gil;
-  if (extra_sys_path && *extra_sys_path) {
-    PyObject* sys_path = PySys_GetObject("path");  // borrowed
-    PyObject* p = PyUnicode_FromString(extra_sys_path);
-    if (sys_path && p) PyList_Insert(sys_path, 0, p);
-    Py_XDECREF(p);
+  {
+    GILGuard gil;
+    if (extra_sys_path && *extra_sys_path) {
+      PyObject* sys_path = PySys_GetObject("path");  // borrowed
+      PyObject* p = PyUnicode_FromString(extra_sys_path);
+      if (sys_path && p) PyList_Insert(sys_path, 0, p);
+      Py_XDECREF(p);
+    }
+    if (runtime_module() == nullptr) {
+      set_error(py_error_string());
+      return -1;
+    }
   }
-  if (runtime_module() == nullptr) {
-    set_error(py_error_string());
-    return -1;
+  if (booted_here) {
+    // Py_InitializeEx leaves this thread holding the GIL; release it so
+    // GILGuard can acquire from ANY host thread (the thread state stays
+    // alive for the life of the process)
+    PyEval_SaveThread();
   }
   return 0;
 }

@@ -1,0 +1,87 @@
+"""Interop-shim tests: mx.rtc (runtime kernels), mx.library (op libraries),
+mx.th (torch bridge), mx.tvmop (reference `python/mxnet/rtc.py`,
+`library.py`, `torch.py`, `tvmop.py`)."""
+import os
+import textwrap
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.base import MXNetError
+
+
+def test_rtc_module_compile_and_launch():
+    mod = mx.rtc.TpuModule(textwrap.dedent("""
+        def axpy(a, x, y):
+            return a * x + y
+
+        def double(x):
+            return x + x
+    """), exports=["axpy", "double"])
+    k = mod.get_kernel("axpy", "float a, NDArray x, NDArray y")
+    x = nd.array(onp.array([1.0, 2.0], "float32"))
+    y = nd.array(onp.array([10.0, 20.0], "float32"))
+    out = k.launch([2.0, x, y], mx.cpu(), (1, 1, 1), (1, 1, 1))
+    onp.testing.assert_allclose(out.asnumpy(), [12.0, 24.0])
+    d = mod.get_kernel("double")
+    onp.testing.assert_allclose(d(x).asnumpy(), [2.0, 4.0])
+
+
+def test_rtc_rejects_cuda_source():
+    with pytest.raises(MXNetError):
+        mx.rtc.CudaModule("__global__ void k(float* x) {}")
+
+
+def test_rtc_unknown_kernel():
+    mod = mx.rtc.TpuModule("def f(x):\n    return x\n", exports=["f"])
+    with pytest.raises(MXNetError):
+        mod.get_kernel("g")
+
+
+def test_library_load_python_op_module(tmp_path):
+    libfile = tmp_path / "my_ops.py"
+    libfile.write_text(textwrap.dedent("""
+        import jax.numpy as jnp
+        from mxnet_tpu.ops.registry import register
+
+        @register("my_softsign_test_op")
+        def my_softsign_test_op(x):
+            return x / (1 + jnp.abs(x))
+    """))
+    added = mx.library.load(str(libfile))
+    assert "my_softsign_test_op" in added
+    x = nd.array(onp.array([1.0, -1.0], "float32"))
+    out = nd.my_softsign_test_op(x)
+    onp.testing.assert_allclose(out.asnumpy(), [0.5, -0.5])
+
+
+def test_library_rejects_shared_objects():
+    with pytest.raises(MXNetError):
+        mx.library.load("libfoo.so")
+
+
+def test_torch_bridge_roundtrip():
+    torch = pytest.importorskip("torch")
+    x = nd.array(onp.arange(6, dtype="float32").reshape(2, 3))
+    t = mx.th.to_torch(x)
+    assert isinstance(t, torch.Tensor)
+    onp.testing.assert_allclose(t.numpy(), x.asnumpy())
+    back = mx.th.from_torch(t * 2)
+    onp.testing.assert_allclose(back.asnumpy(), 2 * x.asnumpy())
+
+
+def test_torch_function_wrapper():
+    torch = pytest.importorskip("torch")
+    relu = mx.th.torch_function(torch.nn.functional.relu)
+    x = nd.array(onp.array([-1.0, 2.0], "float32"))
+    out = relu(x)
+    assert isinstance(out, nd.NDArray)
+    onp.testing.assert_allclose(out.asnumpy(), [0.0, 2.0])
+
+
+def test_tvmop_stub():
+    assert mx.tvmop.enabled is False
+    with pytest.raises(MXNetError):
+        mx.tvmop.load_module("foo")
