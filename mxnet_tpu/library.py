@@ -36,13 +36,20 @@ def load(path, verbose=True):
             "via mxnet_tpu.ops.registry.register (Pallas for custom "
             "kernels) and mx.library.load the registering .py module")
     if os.path.exists(path):
-        name = os.path.splitext(os.path.basename(path))[0]
+        # namespaced module key: never clobber an importable module of the
+        # same basename, and never leave a half-initialized entry behind
+        base = os.path.splitext(os.path.basename(path))[0]
+        name = "mxnet_tpu._oplibs.%s" % base
         spec = importlib.util.spec_from_file_location(name, path)
         if spec is None or spec.loader is None:
             raise MXNetError("cannot load op library %r" % path)
         mod = importlib.util.module_from_spec(spec)
         sys.modules[name] = mod
-        spec.loader.exec_module(mod)
+        try:
+            spec.loader.exec_module(mod)
+        except BaseException:
+            sys.modules.pop(name, None)
+            raise
     else:
         mod = importlib.import_module(path)
     added = sorted(set(list_ops()) - before)

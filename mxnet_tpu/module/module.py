@@ -36,6 +36,11 @@ class Module(BaseModule):
             context = context[0]
         self._context = context
         self._symbol = symbol
+        # model-parallel placement (reference module.py group2ctxs);
+        # normalized to a single dict and forwarded at bind time
+        if isinstance(group2ctxs, (list, tuple)):
+            group2ctxs = group2ctxs[0] if group2ctxs else None
+        self._group2ctxs = group2ctxs
         self._data_names = list(data_names) if data_names else []
         self._label_names = list(label_names) if label_names else []
         self._fixed_param_names = list(fixed_param_names or [])
@@ -168,7 +173,7 @@ class Module(BaseModule):
         shape_kwargs = dict(self._data_shapes + self._label_shapes)
         self._exec = self._symbol.simple_bind(
             self._context, grad_req=grad_req if for_training else "null",
-            **shape_kwargs)
+            group2ctx=self._group2ctxs, **shape_kwargs)
         self.binded = True
         # restore previously held parameters into the fresh executor
         # (reference module.py bind: shared/loaded params survive binding)

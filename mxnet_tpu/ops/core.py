@@ -50,6 +50,13 @@ def mod(lhs, rhs):
     return jnp.mod(lhs, rhs)
 
 
+@register("fmod")
+def fmod(lhs, rhs):
+    """C-style truncated modulo (numpy fmod semantics; the reference's
+    _npi_fmod, `src/operator/numpy/np_elemwise_broadcast_op.cc`)."""
+    return jnp.fmod(lhs, rhs)
+
+
 @register("power", aliases=("broadcast_power", "_power"))
 def power(lhs, rhs):
     return jnp.power(lhs, rhs)

@@ -76,6 +76,12 @@ class TpuKernel:
     def launch(self, args, ctx=None, grid_dims=None, block_dims=None,
                shared_mem=0):
         vals = [a._data if isinstance(a, NDArray) else a for a in args]
+        if ctx is not None:
+            # honor the requested device (the reference launches on the
+            # ctx's stream); arrays move, scalars pass through
+            dev = ctx.jax_device
+            vals = [jax.device_put(v, dev) if hasattr(v, "dtype") else v
+                    for v in vals]
         out = self._fn(*vals)
         if isinstance(out, (tuple, list)):
             return [NDArray(o) for o in out]

@@ -30,6 +30,7 @@ class Executor:
         # graph_executor.cc:1956): nodes whose 'ctx_group'/'__ctx_group__'
         # attr names a group in group2ctx execute on that group's device
         self._placement = {}
+        self._group2ctx = dict(group2ctx) if group2ctx else None
         if group2ctx:
             for node in symbol._toposort():
                 grp = node._attr.get("ctx_group") or \
@@ -185,7 +186,7 @@ class Executor:
         aux = {n: _nd.zeros(s, ctx=self._ctx)
                for n, s in zip(self._aux_names, aux_shapes)}
         return Executor(self._symbol, self._ctx, new_args, grads,
-                        self._grad_req, aux)
+                        self._grad_req, aux, group2ctx=self._group2ctx)
 
     def set_monitor_callback(self, callback, monitor_all=False):
         self._monitor = callback

@@ -90,8 +90,14 @@ def list_backends():
     return sorted(_BACKENDS)
 
 
+def _node_group(n):
+    return n._attr.get("ctx_group") or n._attr.get("__ctx_group__")
+
+
 def _collect_regions(order, selector):
-    """Greedy convex region growth in topo order."""
+    """Greedy convex region growth in topo order. A differing ctx_group is
+    a fusion barrier (reference partitioner behavior): fusing across
+    groups would force one device on ops the user placed on two."""
     pos = {id(n): i for i, n in enumerate(order)}
     consumers = {}
     for n in order:
@@ -105,17 +111,20 @@ def _collect_regions(order, selector):
         if not selector.select(seed):
             continue
         region = {id(seed): seed}
+        seed_group = _node_group(seed)
         frontier = [seed]
         while frontier:
             node = frontier.pop()
             for p, _ in node._inputs:
                 if (p._op is not None and id(p) not in assigned
                         and id(p) not in region
+                        and _node_group(p) == seed_group
                         and selector.select_input(node, p)):
                     region[id(p)] = p
                     frontier.append(p)
             for c in consumers.get(id(node), ()):
                 if (id(c) not in assigned and id(c) not in region
+                        and _node_group(c) == seed_group
                         and selector.select_output(node, c)):
                     region[id(c)] = c
                     frontier.append(c)
@@ -275,18 +284,11 @@ def partition(symbol, backend):
         op = prop.build_fused_op(uname, fn, len(outs))
         attrs = {"__subgraph__": backend,
                  "__subgraph_ops__": ",".join(n._op.name for n in region)}
-        # keep group2ctx placement working through fusion: a region whose
-        # ops all share one ctx_group carries it onto the fused node
-        groups = {n._attr.get("ctx_group") or n._attr.get("__ctx_group__")
-                  for n in region}
-        groups.discard(None)
-        if len(groups) == 1:
-            attrs["ctx_group"] = next(iter(groups))
-        elif len(groups) > 1:
-            import logging
-            logging.warning(
-                "subgraph region %s spans ctx_groups %s; placement "
-                "attrs dropped for the fused node", uname, sorted(groups))
+        # regions never cross ctx_group boundaries (_collect_regions group
+        # barrier), so the fused node inherits the region's group verbatim
+        grp = _node_group(region[0])
+        if grp is not None:
+            attrs["ctx_group"] = grp
         node = Symbol(op=op,
                       inputs=[mapped(p, oi) for p, oi in ext_inputs],
                       kwargs={},

@@ -279,7 +279,7 @@ class Symbol:
         aux = {n: _nd.zeros(s, ctx=ctx)
                for n, s in zip(self.list_auxiliary_states(), aux_shapes)}
         return Executor(self._env_partitioned(), ctx, args, grads,
-                        grad_req, aux)
+                        grad_req, aux, group2ctx=group2ctx)
 
     # ---- serialization ----------------------------------------------------
     def tojson(self):
@@ -371,24 +371,35 @@ class AttrScope:
             h = mx.sym.FullyConnected(x, num_hidden=128)
         ex = net.bind(ctx, args, group2ctx={'dev1': mx.tpu(1)})
     """
-    _stack: list = []
+    import threading as _threading
+    _tls = _threading.local()
 
     def __init__(self, **attrs):
         self._attrs = {k: str(v) for k, v in attrs.items()}
 
+    @staticmethod
+    def _stack():
+        st = getattr(AttrScope._tls, "stack", None)
+        if st is None:
+            st = AttrScope._tls.stack = []
+        return st
+
     def __enter__(self):
-        # merge computed per entry onto a class-level stack: the instance
-        # is never mutated, so scopes are reusable and reentrant
-        base = AttrScope._stack[-1] if AttrScope._stack else {}
-        AttrScope._stack.append({**base, **self._attrs})
+        # merge computed per entry onto a thread-local stack: the instance
+        # is never mutated, so scopes are reusable, reentrant, and
+        # isolated between threads
+        st = AttrScope._stack()
+        base = st[-1] if st else {}
+        st.append({**base, **self._attrs})
         return self
 
     def __exit__(self, *a):
-        AttrScope._stack.pop()
+        AttrScope._stack().pop()
 
     @staticmethod
     def current_attrs():
-        return dict(AttrScope._stack[-1]) if AttrScope._stack else {}
+        st = AttrScope._stack()
+        return dict(st[-1]) if st else {}
 
 
 def _with_scope_attrs(attr):

@@ -99,6 +99,7 @@ MXTPU_API int MXTpuInit(const char* extra_sys_path) {
   if (booted_here) {
     Py_InitializeEx(0);
   }
+  int rc = 0;
   {
     GILGuard gil;
     if (extra_sys_path && *extra_sys_path) {
@@ -109,16 +110,16 @@ MXTPU_API int MXTpuInit(const char* extra_sys_path) {
     }
     if (runtime_module() == nullptr) {
       set_error(py_error_string());
-      return -1;
+      rc = -1;
     }
   }
   if (booted_here) {
-    // Py_InitializeEx leaves this thread holding the GIL; release it so
-    // GILGuard can acquire from ANY host thread (the thread state stays
-    // alive for the life of the process)
+    // Py_InitializeEx leaves this thread holding the GIL; release it —
+    // on success AND failure — so GILGuard can acquire from ANY host
+    // thread (incl. an MXTpuInit retry with a corrected sys path)
     PyEval_SaveThread();
   }
-  return 0;
+  return rc;
 }
 
 MXTPU_API const char* MXGetLastError() { return g_last_error.c_str(); }

@@ -1,0 +1,321 @@
+"""Systematic operator oracle tests (reference
+`tests/python/unittest/test_operator.py` strategy §4: op semantics vs
+NumPy + central-finite-difference gradient checks via
+`python/mxnet/test_utils.py:981 check_numeric_gradient`)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu import autograd as ag
+from mxnet_tpu.test_utils import check_numeric_gradient
+
+rng = onp.random.default_rng(42)
+
+
+def _a(*shape, lo=-2.0, hi=2.0):
+    return (rng.random(shape) * (hi - lo) + lo).astype("float32")
+
+
+def _pos(*shape):
+    return (rng.random(shape) * 2 + 0.5).astype("float32")
+
+
+# (op name, input arrays, kwargs, numpy oracle)
+UNARY_CASES = [
+    ("relu", _a(3, 4), {}, lambda x: onp.maximum(x, 0)),
+    ("sigmoid", _a(3, 4), {}, lambda x: 1 / (1 + onp.exp(-x))),
+    ("softsign", _a(3, 4), {}, lambda x: x / (1 + onp.abs(x))),
+    ("exp", _a(3, 4), {}, onp.exp),
+    ("expm1", _a(3, 4), {}, onp.expm1),
+    ("log", _pos(3, 4), {}, onp.log),
+    ("log1p", _pos(3, 4), {}, onp.log1p),
+    ("log2", _pos(3, 4), {}, onp.log2),
+    ("log10", _pos(3, 4), {}, onp.log10),
+    ("sqrt", _pos(3, 4), {}, onp.sqrt),
+    ("rsqrt", _pos(3, 4), {}, lambda x: 1 / onp.sqrt(x)),
+    ("cbrt", _pos(3, 4), {}, onp.cbrt),
+    ("rcbrt", _pos(3, 4), {}, lambda x: 1 / onp.cbrt(x)),
+    ("square", _a(3, 4), {}, onp.square),
+    ("abs", _a(3, 4), {}, onp.abs),
+    ("sign", _a(3, 4), {}, onp.sign),
+    ("floor", _a(3, 4), {}, onp.floor),
+    ("ceil", _a(3, 4), {}, onp.ceil),
+    ("trunc", _a(3, 4), {}, onp.trunc),
+    ("rint", _a(3, 4), {}, onp.rint),
+    ("negative", _a(3, 4), {}, lambda x: -x),
+    ("reciprocal", _pos(3, 4), {}, lambda x: 1 / x),
+    ("sin", _a(3, 4), {}, onp.sin),
+    ("cos", _a(3, 4), {}, onp.cos),
+    ("tan", _a(3, 4, lo=-1, hi=1), {}, onp.tan),
+    ("arcsin", _a(3, 4, lo=-0.9, hi=0.9), {}, onp.arcsin),
+    ("arccos", _a(3, 4, lo=-0.9, hi=0.9), {}, onp.arccos),
+    ("arctan", _a(3, 4), {}, onp.arctan),
+    ("sinh", _a(3, 4), {}, onp.sinh),
+    ("cosh", _a(3, 4), {}, onp.cosh),
+    ("tanh", _a(3, 4), {}, onp.tanh),
+    ("arcsinh", _a(3, 4), {}, onp.arcsinh),
+    ("arccosh", _pos(3, 4) + 1, {}, onp.arccosh),
+    ("arctanh", _a(3, 4, lo=-0.9, hi=0.9), {}, onp.arctanh),
+    ("degrees", _a(3, 4), {}, onp.degrees),
+    ("radians", _a(3, 4), {}, onp.radians),
+    ("erf", _a(3, 4), {}, None),  # oracle via scipy-free formula below
+    ("gamma", _pos(3, 4), {}, None),
+    ("gammaln", _pos(3, 4), {}, None),
+    ("logical_not", (_a(3, 4) > 0).astype("float32"), {},
+     lambda x: (~(x > 0)).astype("float32")),
+]
+
+
+@pytest.mark.parametrize("name,x,kw,oracle",
+                         [c for c in UNARY_CASES if c[3] is not None],
+                         ids=[c[0] for c in UNARY_CASES if c[3] is not None])
+def test_unary_oracle(name, x, kw, oracle):
+    got = getattr(nd, name)(nd.array(x), **kw).asnumpy()
+    onp.testing.assert_allclose(got, oracle(x), rtol=2e-5, atol=1e-5)
+
+
+BINARY_CASES = [
+    ("broadcast_add", _a(3, 4), _a(1, 4), onp.add),
+    ("broadcast_sub", _a(3, 4), _a(3, 1), onp.subtract),
+    ("broadcast_mul", _a(3, 4), _a(1, 4), onp.multiply),
+    ("broadcast_div", _a(3, 4), _pos(1, 4), onp.divide),
+    ("broadcast_power", _pos(3, 4), _a(1, 4, lo=0, hi=2), onp.power),
+    ("broadcast_maximum", _a(3, 4), _a(1, 4), onp.maximum),
+    ("broadcast_minimum", _a(3, 4), _a(1, 4), onp.minimum),
+    ("broadcast_hypot", _a(3, 4), _a(1, 4), onp.hypot),
+    ("broadcast_equal", onp.round(_a(3, 4)), onp.round(_a(1, 4)),
+     lambda a, b: (a == b).astype("float32")),
+    ("broadcast_not_equal", onp.round(_a(3, 4)), onp.round(_a(1, 4)),
+     lambda a, b: (a != b).astype("float32")),
+    ("broadcast_greater", _a(3, 4), _a(1, 4),
+     lambda a, b: (a > b).astype("float32")),
+    ("broadcast_lesser", _a(3, 4), _a(1, 4),
+     lambda a, b: (a < b).astype("float32")),
+    ("broadcast_logical_and", (_a(3, 4) > 0).astype("float32"),
+     (_a(1, 4) > 0).astype("float32"),
+     lambda a, b: onp.logical_and(a, b).astype("float32")),
+    ("arctan2", _a(3, 4), _a(3, 4), onp.arctan2),
+    ("fmod", _a(3, 4), _pos(3, 4), onp.fmod),
+]
+
+
+@pytest.mark.parametrize("name,a,b,oracle", BINARY_CASES,
+                         ids=[c[0] for c in BINARY_CASES])
+def test_binary_oracle(name, a, b, oracle):
+    got = getattr(nd, name)(nd.array(a), nd.array(b)).asnumpy()
+    onp.testing.assert_allclose(got, oracle(a, b), rtol=2e-5, atol=1e-5)
+
+
+REDUCE_CASES = [
+    ("sum", {"axis": 1}, lambda x: x.sum(axis=1)),
+    ("sum", {"axis": (0, 2), "keepdims": True},
+     lambda x: x.sum(axis=(0, 2), keepdims=True)),
+    ("mean", {"axis": 0}, lambda x: x.mean(axis=0)),
+    ("prod", {"axis": 2}, lambda x: x.prod(axis=2)),
+    ("max", {"axis": 1}, lambda x: x.max(axis=1)),
+    ("min", {"axis": 1}, lambda x: x.min(axis=1)),
+    ("argmax", {"axis": 1}, lambda x: x.argmax(axis=1).astype("float32")),
+    ("argmin", {"axis": 1}, lambda x: x.argmin(axis=1).astype("float32")),
+    ("nansum", {"axis": 1}, lambda x: onp.nansum(x, axis=1)),
+]
+
+
+@pytest.mark.parametrize("name,kw,oracle", REDUCE_CASES,
+                         ids=["%s-%s" % (c[0], i)
+                              for i, c in enumerate(REDUCE_CASES)])
+def test_reduce_oracle(name, kw, oracle):
+    x = _a(2, 3, 4)
+    got = getattr(nd, name)(nd.array(x), **kw).asnumpy()
+    onp.testing.assert_allclose(got, oracle(x), rtol=2e-5, atol=1e-5)
+
+
+def test_norm_oracle():
+    x = _a(3, 4)
+    onp.testing.assert_allclose(nd.norm(nd.array(x)).asnumpy(),
+                                onp.linalg.norm(x), rtol=1e-5)
+    onp.testing.assert_allclose(
+        nd.norm(nd.array(x), ord=1, axis=1).asnumpy(),
+        onp.abs(x).sum(axis=1), rtol=1e-5)
+
+
+# ---- shape / indexing ops --------------------------------------------------
+
+def test_shape_ops_oracle():
+    x = _a(2, 3, 4)
+    onp.testing.assert_allclose(
+        nd.transpose(nd.array(x), axes=(2, 0, 1)).asnumpy(),
+        x.transpose(2, 0, 1))
+    onp.testing.assert_allclose(
+        nd.expand_dims(nd.array(x), axis=1).asnumpy(),
+        onp.expand_dims(x, 1))
+    onp.testing.assert_allclose(nd.flip(nd.array(x), axis=2).asnumpy(),
+                                onp.flip(x, 2))
+    onp.testing.assert_allclose(nd.tile(nd.array(x), reps=(2, 1, 1)).asnumpy(),
+                                onp.tile(x, (2, 1, 1)))
+    onp.testing.assert_allclose(
+        nd.repeat(nd.array(x), repeats=2, axis=1).asnumpy(),
+        onp.repeat(x, 2, axis=1))
+    onp.testing.assert_allclose(
+        nd.reverse(nd.array(x), axis=0).asnumpy(), x[::-1])
+    onp.testing.assert_allclose(
+        nd.slice(nd.array(x), begin=(0, 1, 1), end=(2, 3, 3)).asnumpy(),
+        x[0:2, 1:3, 1:3])
+    onp.testing.assert_allclose(
+        nd.slice_axis(nd.array(x), axis=2, begin=1, end=3).asnumpy(),
+        x[:, :, 1:3])
+    onp.testing.assert_allclose(
+        nd.swapaxes(nd.array(x), dim1=0, dim2=2).asnumpy(),
+        x.swapaxes(0, 2))
+
+
+def test_indexing_ops_oracle():
+    x = _a(5, 4)
+    idx = onp.array([0, 2, 4], dtype="float32")
+    onp.testing.assert_allclose(
+        nd.take(nd.array(x), nd.array(idx)).asnumpy(), x[[0, 2, 4]])
+    # pick: per-row column selection
+    pidx = onp.array([0, 3, 1, 2, 0], dtype="float32")
+    onp.testing.assert_allclose(
+        nd.pick(nd.array(x), nd.array(pidx), axis=1).asnumpy(),
+        x[onp.arange(5), pidx.astype(int)])
+    # gather_nd / scatter_nd
+    data = _a(3, 4)
+    indices = onp.array([[0, 2], [1, 3]], dtype="float32")
+    got = nd.gather_nd(nd.array(data), nd.array(indices)).asnumpy()
+    onp.testing.assert_allclose(got, data[[0, 2], [1, 3]])
+    upd = onp.array([10.0, 20.0], dtype="float32")
+    scat = nd.scatter_nd(nd.array(upd), nd.array(indices),
+                         shape=(3, 4)).asnumpy()
+    want = onp.zeros((3, 4), "float32")
+    want[0, 1] = 10
+    want[2, 3] = 20
+    onp.testing.assert_allclose(scat, want)
+    # one_hot
+    oh = nd.one_hot(nd.array(onp.array([1, 0, 2], "float32")),
+                    depth=4).asnumpy()
+    onp.testing.assert_allclose(oh, onp.eye(4, dtype="float32")[[1, 0, 2]])
+
+
+def test_ordering_ops_oracle():
+    x = _a(4, 6)
+    onp.testing.assert_allclose(nd.sort(nd.array(x), axis=1).asnumpy(),
+                                onp.sort(x, axis=1))
+    onp.testing.assert_allclose(
+        nd.argsort(nd.array(x), axis=1).asnumpy().astype(int),
+        onp.argsort(x, axis=1))
+    k = 3
+    topk_val = nd.topk(nd.array(x), axis=1, k=k, ret_typ="value").asnumpy()
+    want = -onp.sort(-x, axis=1)[:, :k]
+    onp.testing.assert_allclose(topk_val, want, rtol=1e-6)
+
+
+def test_nn_ops_oracle():
+    x = _a(3, 5)
+    e = onp.exp(x - x.max(axis=1, keepdims=True))
+    sm = e / e.sum(axis=1, keepdims=True)
+    onp.testing.assert_allclose(nd.softmax(nd.array(x)).asnumpy(), sm,
+                                rtol=1e-5, atol=1e-6)
+    onp.testing.assert_allclose(nd.log_softmax(nd.array(x)).asnumpy(),
+                                onp.log(sm), rtol=1e-5, atol=1e-5)
+    # leaky relu family
+    lr = nd.LeakyReLU(nd.array(x), act_type="leaky", slope=0.1).asnumpy()
+    onp.testing.assert_allclose(lr, onp.where(x > 0, x, 0.1 * x), rtol=1e-6)
+    el = nd.LeakyReLU(nd.array(x), act_type="elu", slope=1.0).asnumpy()
+    onp.testing.assert_allclose(el, onp.where(x > 0, x, onp.expm1(x)),
+                                rtol=1e-5, atol=1e-6)
+    # clip
+    onp.testing.assert_allclose(
+        nd.clip(nd.array(x), a_min=-0.5, a_max=0.5).asnumpy(),
+        onp.clip(x, -0.5, 0.5))
+
+
+def test_linalg_ops_oracle():
+    a = _a(3, 4)
+    b = _a(4, 5)
+    onp.testing.assert_allclose(nd.dot(nd.array(a), nd.array(b)).asnumpy(),
+                                a @ b, rtol=1e-4)
+    ba = _a(2, 3, 4)
+    bb = _a(2, 4, 5)
+    onp.testing.assert_allclose(
+        nd.batch_dot(nd.array(ba), nd.array(bb)).asnumpy(),
+        onp.einsum("bij,bjk->bik", ba, bb), rtol=1e-4)
+
+
+def test_erf_gamma_oracles():
+    import math
+    x = _a(2, 3, lo=0.1, hi=2.0)
+    got = nd.erf(nd.array(x)).asnumpy()
+    want = onp.vectorize(math.erf)(x)
+    onp.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+    got = nd.gammaln(nd.array(x)).asnumpy()
+    want = onp.vectorize(math.lgamma)(x)
+    onp.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+    got = nd.gamma(nd.array(x)).asnumpy()
+    want = onp.vectorize(math.gamma)(x)
+    onp.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+# ---- gradient checks -------------------------------------------------------
+
+GRAD_OPS = [
+    ("sigmoid", _a(2, 3)),
+    ("tanh", _a(2, 3)),
+    ("exp", _a(2, 3, lo=-1, hi=1)),
+    ("log", _pos(2, 3)),
+    ("sqrt", _pos(2, 3)),
+    ("square", _a(2, 3)),
+    ("sin", _a(2, 3)),
+    ("cos", _a(2, 3)),
+    ("arctan", _a(2, 3)),
+    ("softsign", _a(2, 3)),
+    ("erf", _a(2, 3)),
+    ("rsqrt", _pos(2, 3)),
+]
+
+
+@pytest.mark.parametrize("name,x", GRAD_OPS, ids=[c[0] for c in GRAD_OPS])
+def test_unary_gradient_matches_fd(name, x):
+    check_numeric_gradient(lambda v: nd.sum(getattr(nd, name)(v)),
+                           [nd.array(x)], rtol=5e-3, atol=5e-4)
+
+
+def test_softmax_gradient_matches_fd():
+    w = nd.array(_a(2, 4))  # fixed weighting makes the scalar sensitive
+    check_numeric_gradient(
+        lambda v: nd.sum(nd.softmax(v) * w), [nd.array(_a(2, 4))],
+        rtol=5e-3, atol=5e-4)
+
+
+def test_reduce_gradient_matches_fd():
+    w1 = nd.array(_a(2))
+    check_numeric_gradient(lambda v: nd.sum(nd.sum(v, axis=1) * w1),
+                           [nd.array(_a(2, 3))], rtol=5e-3, atol=5e-4)
+    w2 = nd.array(_a(3))
+    check_numeric_gradient(lambda v: nd.sum(nd.mean(v, axis=0) * w2),
+                           [nd.array(_a(2, 3))], rtol=5e-3, atol=5e-4)
+
+
+def test_dot_gradient_matches_fd():
+    a, b = nd.array(_a(2, 3)), nd.array(_a(3, 2))
+    check_numeric_gradient(lambda x, y: nd.sum(nd.dot(x, y)), [a, b],
+                           rtol=5e-3, atol=5e-4)
+
+
+def test_broadcast_gradient_matches_fd():
+    a, b = nd.array(_a(2, 3)), nd.array(_a(1, 3))
+    check_numeric_gradient(lambda x, y: nd.sum(nd.broadcast_mul(x, y)),
+                           [a, b], rtol=5e-3, atol=5e-4)
+
+
+def test_gather_pick_gradients():
+    # gradient of take: scatter ones into taken rows
+    x = nd.array(_a(4, 3))
+    x.attach_grad()
+    with ag.record():
+        y = nd.take(x, nd.array(onp.array([1, 3], "float32")))
+        s = nd.sum(y)
+    s.backward()
+    g = x.grad.asnumpy()
+    onp.testing.assert_allclose(g[[1, 3]], onp.ones((2, 3)))
+    onp.testing.assert_allclose(g[[0, 2]], onp.zeros((2, 3)))

@@ -267,3 +267,40 @@ def test_fused_region_keeps_ctx_group(monkeypatch):
     assert ex._placement, "fused node must inherit the region's ctx_group"
     onp.testing.assert_allclose(ex.forward()[0].asnumpy(),
                                 onp.tanh([2.0, 2.0]), rtol=1e-6)
+
+
+def test_fusion_respects_group_barrier(monkeypatch):
+    # ops in different ctx_groups must never fuse into one region
+    monkeypatch.setenv("MXNET_SUBGRAPH_BACKEND", "TPU_ELEMWISE")
+    with sym.AttrScope(ctx_group="dev1"):
+        a = sym.var("a")
+        h = sym.relu(a) * 2.0
+    with sym.AttrScope(ctx_group="dev2"):
+        out = sym.tanh(sym.exp(h))
+    fused = out.get_backend_symbol("TPU_ELEMWISE")
+    subs = [n for n in fused._toposort() if n._attr.get("__subgraph__")]
+    groups = {s._attr.get("ctx_group") for s in subs}
+    assert None not in groups
+    assert all(
+        len({g for g in (s._attr.get("ctx_group"),)}) == 1 for s in subs)
+    # two regions, one per group
+    assert {s._attr["ctx_group"] for s in subs} == {"dev1", "dev2"}
+
+
+def test_simple_bind_and_module_forward_group2ctx():
+    import jax
+    if len(jax.devices()) < 2:
+        pytest.skip("needs multi-device mesh")
+    with sym.AttrScope(ctx_group="g1"):
+        x = sym.var("data")
+        out = sym.FullyConnected(x, num_hidden=3, name="fcg")
+    ex = out.simple_bind(mx.cpu(0), data=(2, 4),
+                         group2ctx={"g1": mx.cpu(5)})
+    assert ex._placement, "simple_bind must forward group2ctx"
+    ex2 = ex.reshape(data=(4, 4))
+    assert ex2._placement, "reshape must carry group2ctx"
+    from mxnet_tpu.module import Module
+    m = Module(out, data_names=("data",), label_names=None,
+               group2ctxs={"g1": mx.cpu(6)})
+    m.bind(data_shapes=[("data", (2, 4))], for_training=False)
+    assert m._exec._placement, "Module must forward group2ctxs"
