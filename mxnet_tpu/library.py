@@ -48,7 +48,13 @@ def load(path, verbose=True):
         try:
             spec.loader.exec_module(mod)
         except BaseException:
+            # roll back BOTH the module entry and any ops the library
+            # managed to register before failing — a half-loaded op
+            # library must not leave dispatchable ops behind
             sys.modules.pop(name, None)
+            from .ops.registry import _OP_REGISTRY
+            for op_name in set(list_ops()) - before:
+                _OP_REGISTRY.pop(op_name, None)
             raise
     else:
         mod = importlib.import_module(path)

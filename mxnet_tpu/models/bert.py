@@ -1,0 +1,181 @@
+"""BERT for masked-LM + next-sentence pretraining (BASELINE.md reference
+config "BERT-base pretraining"; the reference ecosystem ships BERT via
+GluonNLP on the same Gluon substrate).
+
+Mesh-first like models/transformer.py: parameter names carry qkv/proj/
+ffn_up/ffn_down markers so the Megatron tensor-parallel rules
+(`mxnet_tpu.parallel` + `models.transformer.tp_rules`) apply unchanged;
+attention routes through `_contrib_dot_product_attention` (flash kernel /
+ring attention capable). Padding is handled with a boolean keep-mask
+broadcast to (B, 1, 1, T) — XLA fuses it into the softmax."""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from ..gluon.block import HybridBlock
+from ..gluon import nn
+from .transformer import MultiHeadAttention, tp_rules  # noqa: F401
+
+__all__ = ["BERTModel", "BERTEncoder", "bert_tiny", "bert_base",
+           "BERTPretrainingLoss"]
+
+
+class _MaskedAttention(MultiHeadAttention):
+    """MultiHeadAttention with a padding keep-mask (bidirectional)."""
+
+    def __init__(self, units, num_heads, dropout=0.0, **kwargs):
+        super().__init__(units, num_heads, dropout=dropout, causal=False,
+                         **kwargs)
+
+    def hybrid_forward(self, F, x, mask=None):
+        B, T, C = x.shape
+        H = self._num_heads
+        qkv = self.qkv(x)
+        qkv = qkv.reshape((B, T, 3, H, C // H))
+        qkv = F.transpose(qkv, axes=(2, 0, 3, 1, 4))
+        q, k, v = qkv[0], qkv[1], qkv[2]
+        out = F._contrib_dot_product_attention(
+            q, k, v, mask=mask, dropout=self._dropout, causal=False)
+        out = F.transpose(out, axes=(0, 2, 1, 3)).reshape((B, T, C))
+        return self.proj(out)
+
+
+class _BERTLayer(HybridBlock):
+    """Post-norm encoder block (BERT convention: residual -> LayerNorm)."""
+
+    def __init__(self, units, num_heads, hidden_size, dropout=0.0, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.attn = _MaskedAttention(units, num_heads, dropout)
+            self.ln1 = nn.LayerNorm(in_channels=units)
+            self.ffn_up = nn.Dense(hidden_size, flatten=False,
+                                   in_units=units, prefix="ffn_up_")
+            self.ffn_down = nn.Dense(units, flatten=False,
+                                     in_units=hidden_size,
+                                     prefix="ffn_down_")
+            self.ln2 = nn.LayerNorm(in_channels=units)
+            self.dropout = nn.Dropout(dropout)
+
+    def hybrid_forward(self, F, x, mask=None):
+        x = self.ln1(x + self.dropout(self.attn(x, mask)))
+        h = F.LeakyReLU(self.ffn_up(x), act_type="gelu")
+        x = self.ln2(x + self.dropout(self.ffn_down(h)))
+        return x
+
+
+class BERTEncoder(HybridBlock):
+    """Token + segment + learned-position embeddings -> N encoder blocks."""
+
+    def __init__(self, vocab_size, units, num_layers, num_heads,
+                 hidden_size, max_length=512, num_segments=2, dropout=0.0,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self._max_length = max_length
+        with self.name_scope():
+            self.word_embed = nn.Embedding(vocab_size, units,
+                                           prefix="word_embed_")
+            self.segment_embed = nn.Embedding(num_segments, units,
+                                              prefix="segment_embed_")
+            self.pos_embed = nn.Embedding(max_length, units,
+                                          prefix="pos_embed_")
+            self.ln = nn.LayerNorm(in_channels=units)
+            self.dropout = nn.Dropout(dropout)
+            self.layers = []
+            for i in range(num_layers):
+                layer = _BERTLayer(units, num_heads, hidden_size, dropout,
+                                   prefix="layer%d_" % i)
+                self.layers.append(layer)
+                self.register_child(layer)
+
+    def hybrid_forward(self, F, tokens, segments, valid_len=None):
+        B, T = tokens.shape
+        pos = F.arange(0, T).reshape((1, T))
+        x = self.word_embed(tokens) + self.segment_embed(segments) \
+            + self.pos_embed(pos)
+        x = self.dropout(self.ln(x))
+        mask = None
+        if valid_len is not None:
+            # keep-mask (B, 1, 1, T): every query may attend to keys < len
+            ar = F.arange(0, T).reshape((1, 1, 1, T))
+            mask = F.broadcast_lesser(
+                ar, valid_len.reshape((B, 1, 1, 1)))
+        for layer in self.layers:
+            x = layer(x, mask)
+        return x
+
+
+class BERTModel(HybridBlock):
+    """Encoder + pooler + MLM decoder + NSP classifier (pretraining heads).
+
+    Forward returns ``(sequence_output, pooled, mlm_logits, nsp_logits)``.
+    """
+
+    def __init__(self, vocab_size=30522, units=768, num_layers=12,
+                 num_heads=12, hidden_size=3072, max_length=512,
+                 num_segments=2, dropout=0.1, **kwargs):
+        super().__init__(**kwargs)
+        self.vocab_size = vocab_size
+        with self.name_scope():
+            self.encoder = BERTEncoder(vocab_size, units, num_layers,
+                                       num_heads, hidden_size, max_length,
+                                       num_segments, dropout,
+                                       prefix="encoder_")
+            self.pooler = nn.Dense(units, flatten=False, in_units=units,
+                                   prefix="pooler_")
+            self.mlm_transform = nn.Dense(units, flatten=False,
+                                          in_units=units,
+                                          prefix="mlm_transform_")
+            self.mlm_ln = nn.LayerNorm(in_channels=units)
+            self.mlm_decoder = nn.Dense(vocab_size, flatten=False,
+                                        in_units=units,
+                                        prefix="mlm_decoder_")
+            self.nsp = nn.Dense(2, flatten=False, in_units=units,
+                                prefix="nsp_")
+
+    def hybrid_forward(self, F, tokens, segments, valid_len=None):
+        seq = self.encoder(tokens, segments, valid_len)
+        cls = F.slice_axis(seq, axis=1, begin=0, end=1).reshape(
+            (seq.shape[0], -1))
+        pooled = F.tanh(self.pooler(cls))
+        h = F.LeakyReLU(self.mlm_transform(seq), act_type="gelu")
+        mlm_logits = self.mlm_decoder(self.mlm_ln(h))
+        nsp_logits = self.nsp(pooled)
+        return seq, pooled, mlm_logits, nsp_logits
+
+
+class BERTPretrainingLoss(HybridBlock):
+    """Masked-LM + next-sentence loss. ``mlm_positions`` selects the masked
+    slots (B, M); ``mlm_weights`` zeroes padding in M."""
+
+    def hybrid_forward(self, F, mlm_logits, nsp_logits, mlm_labels,
+                       mlm_positions, mlm_weights, nsp_labels):
+        B, M = mlm_positions.shape
+        V = mlm_logits.shape[-1]
+        rows = F.arange(0, B).reshape((B, 1))
+        rows = F.broadcast_mul(rows, F.ones_like(mlm_positions))
+        idx = F.stack(rows.reshape((-1,)), mlm_positions.reshape((-1,)),
+                      axis=0)
+        picked = F.gather_nd(mlm_logits, idx)          # (B*M, V)
+        logp = F.log_softmax(picked, axis=-1)
+        ll = F.pick(logp, mlm_labels.reshape((-1,)), axis=-1)
+        w = mlm_weights.reshape((-1,))
+        mlm_loss = -F.sum(ll * w) / (F.sum(w) + 1e-6)
+        nsp_logp = F.log_softmax(nsp_logits, axis=-1)
+        nsp_loss = -F.mean(F.pick(nsp_logp, nsp_labels, axis=-1))
+        return mlm_loss + nsp_loss
+
+
+def bert_tiny(vocab_size=1000, max_length=128, **kwargs):
+    """2-layer/128-unit config for tests and the multichip dryrun."""
+    return BERTModel(vocab_size=vocab_size, units=128, num_layers=2,
+                     num_heads=2, hidden_size=512, max_length=max_length,
+                     dropout=0.0, **kwargs)
+
+
+def bert_base(vocab_size=30522, **kwargs):
+    """BERT-base: 12 layers x 768 units x 12 heads (the BASELINE.md
+    pretraining reference config)."""
+    return BERTModel(vocab_size=vocab_size, units=768, num_layers=12,
+                     num_heads=12, hidden_size=3072, **kwargs)

@@ -123,6 +123,12 @@ def invoke(op: Op, *args, out=None, **kwargs):
             vals.append(a)
             parents.append(_tape.Const(a))
 
+    # tensor-valued keyword args (masks, index arrays) unwrap too; they are
+    # treated as constants w.r.t. the tape (positional args carry gradients)
+    for k, v in kwargs.items():
+        if isinstance(v, NDArray):
+            kwargs[k] = v._data
+
     for kname, binder in op.state_binders.items():
         if kname not in kwargs:
             kwargs[kname] = binder()

@@ -150,20 +150,24 @@ class ShardedTrainer:
         self._step_many_fn = jax.jit(many, donate_argnums=(1, 2))
 
     def step(self, data, label, lr=None):
-        """One fused fwd+bwd+allreduce+update step. Returns the (replicated)
-        scalar loss as a host float-convertible array."""
+        """One fused fwd+bwd+allreduce+update step. ``data`` may be a
+        single array or a list/tuple of model inputs (e.g. BERT's
+        tokens+segments); each is batch-sharded over the dp axes. Returns
+        the (replicated) scalar loss as a host float-convertible array."""
         if self._step_fn is None:
             self._build_step()
         self._t += 1
-        x = data._data if isinstance(data, NDArray) else jnp.asarray(data)
-        y = label._data if isinstance(label, NDArray) else jnp.asarray(label)
+        xs = data if isinstance(data, (list, tuple)) else (data,)
         bs = batch_sharding(self._mesh, self._batch_axes)
-        x = jax.device_put(x, bs)
+        xs = tuple(jax.device_put(
+            x._data if isinstance(x, NDArray) else jnp.asarray(x), bs)
+            for x in xs)
+        y = label._data if isinstance(label, NDArray) else jnp.asarray(label)
         y = jax.device_put(y, bs)
         key = _random.next_key()
         loss_val, self._values, self._states, aux = self._step_fn(
             key, self._values, self._states, self._t,
-            lr if lr is not None else self._lr, x, y)
+            lr if lr is not None else self._lr, *xs, y)
         # functional aux-state writeback (BatchNorm moving stats)
         for h, v in zip(self._pure.aux_handles, aux):
             h._data = v

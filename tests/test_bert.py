@@ -1,0 +1,185 @@
+"""BERT model family tests (BASELINE.md "BERT-base pretraining" reference
+config, tiny-scale; mirrors reference test strategy: shapes, hybridize
+cache, loss decrease, and mesh sharding)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.models.bert import (bert_tiny, BERTPretrainingLoss,
+                                   bert_base)
+
+B, T, M, V = 4, 32, 6, 1000
+
+
+def _batch(rng):
+    tokens = nd.array(rng.integers(0, V, (B, T)).astype("float32"))
+    segments = nd.array((rng.random((B, T)) > 0.5).astype("float32"))
+    valid_len = nd.array(onp.full((B,), T, "float32"))
+    mlm_positions = nd.array(
+        onp.stack([rng.choice(T, M, replace=False) for _ in range(B)])
+        .astype("float32"))
+    mlm_labels = nd.array(rng.integers(0, V, (B, M)).astype("float32"))
+    mlm_weights = nd.array(onp.ones((B, M), "float32"))
+    nsp_labels = nd.array(rng.integers(0, 2, (B,)).astype("float32"))
+    return (tokens, segments, valid_len, mlm_positions, mlm_labels,
+            mlm_weights, nsp_labels)
+
+
+@pytest.fixture(scope="module")
+def net():
+    mx.random.seed(0)
+    net = bert_tiny(vocab_size=V, max_length=T)
+    net.initialize(mx.init.Xavier())
+    return net
+
+
+def test_bert_forward_shapes(net):
+    rng = onp.random.default_rng(0)
+    tokens, segments, valid_len = _batch(rng)[:3]
+    seq, pooled, mlm_logits, nsp_logits = net(tokens, segments, valid_len)
+    assert seq.shape == (B, T, 128)
+    assert pooled.shape == (B, 128)
+    assert mlm_logits.shape == (B, T, V)
+    assert nsp_logits.shape == (B, 2)
+    assert onp.isfinite(mlm_logits.asnumpy()).all()
+
+
+def test_bert_padding_mask_matters(net):
+    rng = onp.random.default_rng(1)
+    tokens, segments, _ = _batch(rng)[:3]
+    full = net(tokens, segments, nd.array(onp.full((B,), T, "float32")))
+    half = net(tokens, segments, nd.array(onp.full((B,), T // 2, "float32")))
+    # first-half outputs must differ when the second half is masked out
+    a = full[0].asnumpy()[:, : T // 2]
+    b = half[0].asnumpy()[:, : T // 2]
+    assert onp.abs(a - b).max() > 1e-4
+
+
+def test_bert_pretraining_step_decreases_loss(net):
+    rng = onp.random.default_rng(2)
+    batch = _batch(rng)
+    tokens, segments, valid_len = batch[:3]
+    heads = batch[3:]
+    loss_fn = BERTPretrainingLoss()
+    trainer = mx.gluon.Trainer(net.collect_params(), "adam",
+                               {"learning_rate": 1e-3})
+    losses = []
+    from mxnet_tpu import autograd as ag
+    for _ in range(8):
+        with ag.record():
+            _, _, mlm_logits, nsp_logits = net(tokens, segments, valid_len)
+            loss = loss_fn(mlm_logits, nsp_logits, heads[1], heads[0],
+                           heads[2], heads[3])
+        loss.backward()
+        trainer.step(1)
+        losses.append(float(loss.asnumpy()))
+    assert losses[-1] < losses[0] * 0.9, losses
+
+
+def test_bert_sharded_trainer_tp_dp():
+    import jax
+    if len(jax.devices()) < 4:
+        pytest.skip("needs >=4 devices")
+    from mxnet_tpu import parallel
+    from mxnet_tpu.models.transformer import tp_rules
+    mx.random.seed(0)
+    net = bert_tiny(vocab_size=V, max_length=T)
+    net.initialize(mx.init.Xavier())
+    rng = onp.random.default_rng(3)
+    batch = _batch(rng)
+    loss_fn = BERTPretrainingLoss()
+    mesh = parallel.make_mesh(dp=-1, tp=2)  # dp fills remaining devices
+    # run fwd through a sharded functionalized step: reuse ShardedTrainer
+    # machinery via a closure net that returns the pretraining loss
+    from mxnet_tpu.gluon.block import HybridBlock
+
+    class PretrainNet(HybridBlock):
+        """tokens+segments packed on a trailing axis so the batch rides
+        the trainer's single data input."""
+
+        def __init__(self, bert, **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                self.bert = bert
+            self._heads = [h._data for h in batch[3:]]
+
+        def hybrid_forward(self, F, packed):
+            tokens = F.slice_axis(packed, axis=2, begin=0, end=1) \
+                .reshape((packed.shape[0], packed.shape[1]))
+            segments = F.slice_axis(packed, axis=2, begin=1, end=2) \
+                .reshape((packed.shape[0], packed.shape[1]))
+            _, _, mlm_logits, nsp_logits = self.bert(tokens, segments, None)
+            return loss_fn(mlm_logits, nsp_logits,
+                           nd.NDArray(self._heads[1]),
+                           nd.NDArray(self._heads[0]),
+                           nd.NDArray(self._heads[2]),
+                           nd.NDArray(self._heads[3]))
+
+    wrapper = PretrainNet(net)
+    packed = nd.stack(batch[0], batch[1], axis=2)
+
+    class Identity:
+        def __call__(self, out, y):
+            return out
+
+    dummy_y = nd.zeros((B,))
+    trainer = parallel.ShardedTrainer(
+        wrapper, Identity(), "adam", {"learning_rate": 1e-3}, mesh=mesh,
+        param_rules=tp_rules())
+    l1 = float(trainer.step(packed, dummy_y).asnumpy())
+    l2 = float(trainer.step(packed, dummy_y).asnumpy())
+    assert onp.isfinite(l1) and onp.isfinite(l2)
+    assert l2 < l1, (l1, l2)
+
+
+def test_bert_base_config():
+    net = bert_base()
+    assert net.vocab_size == 30522
+    # 12 layers present
+    assert len(net.encoder.layers) == 12
+
+
+def test_sharded_trainer_multi_input_step():
+    import jax
+    if len(jax.devices()) < 2:
+        pytest.skip("needs multi-device mesh")
+    from mxnet_tpu import parallel
+    from mxnet_tpu.models.transformer import tp_rules
+    from mxnet_tpu.models.bert import BERTPretrainingLoss
+    mx.random.seed(1)
+    net = bert_tiny(vocab_size=V, max_length=T)
+    net.initialize(mx.init.Xavier())
+    rng = onp.random.default_rng(7)
+    batch = _batch(rng)
+    loss_fn = BERTPretrainingLoss()
+    from mxnet_tpu.gluon.block import HybridBlock
+
+    class PretrainNet(HybridBlock):
+        def __init__(self, bert, **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                self.bert = bert
+            self._heads = [h._data for h in batch[3:]]
+
+        def hybrid_forward(self, F, tokens, segments):
+            _, _, mlm_logits, nsp_logits = self.bert(tokens, segments, None)
+            return loss_fn(mlm_logits, nsp_logits,
+                           nd.NDArray(self._heads[1]),
+                           nd.NDArray(self._heads[0]),
+                           nd.NDArray(self._heads[2]),
+                           nd.NDArray(self._heads[3]))
+
+    class Identity:
+        def __call__(self, out, y):
+            return out
+
+    mesh = parallel.make_mesh(dp=-1, tp=2)
+    trainer = parallel.ShardedTrainer(
+        PretrainNet(net), Identity(), "adam", {"learning_rate": 1e-3},
+        mesh=mesh, param_rules=tp_rules())
+    y = nd.zeros((B,))
+    losses = [float(trainer.step((batch[0], batch[1]), y).asnumpy())
+              for _ in range(6)]
+    assert all(onp.isfinite(l) for l in losses)
+    assert losses[-1] < losses[0], losses

@@ -85,3 +85,20 @@ def test_tvmop_stub():
     assert mx.tvmop.enabled is False
     with pytest.raises(MXNetError):
         mx.tvmop.load_module("foo")
+
+
+def test_library_failed_load_rolls_back_ops(tmp_path):
+    from mxnet_tpu.ops.registry import list_ops
+    bad = tmp_path / "bad_ops.py"
+    bad.write_text(
+        "import jax.numpy as jnp\n"
+        "from mxnet_tpu.ops.registry import register\n"
+        "@register('half_loaded_test_op')\n"
+        "def half_loaded_test_op(x):\n"
+        "    return x\n"
+        "raise RuntimeError('boom mid-import')\n")
+    before = set(list_ops())
+    with pytest.raises(RuntimeError):
+        mx.library.load(str(bad))
+    assert "half_loaded_test_op" not in set(list_ops())
+    assert set(list_ops()) == before

@@ -281,8 +281,6 @@ def test_fusion_respects_group_barrier(monkeypatch):
     subs = [n for n in fused._toposort() if n._attr.get("__subgraph__")]
     groups = {s._attr.get("ctx_group") for s in subs}
     assert None not in groups
-    assert all(
-        len({g for g in (s._attr.get("ctx_group"),)}) == 1 for s in subs)
     # two regions, one per group
     assert {s._attr["ctx_group"] for s in subs} == {"dev1", "dev2"}
 
