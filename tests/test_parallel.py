@@ -336,3 +336,75 @@ def test_pipeline_matches_sequential():
     out = parallel.pipeline_spmd(stage, jnp.asarray(Ws), jnp.asarray(x),
                                  pp_mesh, n_micro=4)
     np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-4, atol=1e-5)
+
+
+def test_gradient_compression_2bit_error_feedback():
+    # reference gradient_compression.h semantics: elements quantize to
+    # {-t, 0, +t}; error feedback makes repeated pushes exact on average
+    import numpy as onp
+    kv = mx.kv.create("local")
+    # NB: per push the wire carries at most +/-t per element, so only
+    # gradients within the threshold are recoverable on average — the
+    # reference scheme has the same saturation property
+    g = onp.array([[0.3, -0.45], [0.4, 0.05]], dtype="float32")
+    kv.init("w", mx.nd.zeros((2, 2)))
+    kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    t = 0.5
+    # first push: quantized values only
+    kv.push("w", mx.nd.array(g))
+    out = mx.nd.zeros((2, 2))
+    kv.pull("w", out=out)
+    first = out.asnumpy()
+    assert set(onp.unique(first)).issubset({-t, 0.0, t})
+    # many pushes of the same gradient: running mean of dequantized pushes
+    # approaches the true gradient (error feedback carries the remainder)
+    total = first.copy()
+    n = 40
+    for _ in range(n - 1):
+        kv.push("w", mx.nd.array(g))
+        kv.pull("w", out=out)
+        total += out.asnumpy()
+    onp.testing.assert_allclose(total / n, g, atol=t / n + 1e-3)
+
+
+def test_gradient_compression_int8():
+    import numpy as onp
+    kv = mx.kv.create("local")
+    rng = onp.random.default_rng(0)
+    g = (rng.random((8, 8)) * 4 - 2).astype("float32")
+    kv.init("w", mx.nd.zeros((8, 8)))
+    kv.set_gradient_compression({"type": "int8"})
+    kv.push("w", mx.nd.array(g))
+    out = mx.nd.zeros((8, 8))
+    kv.pull("w", out=out)
+    # one int8 pass is within one quantization step of the truth
+    scale = onp.abs(g).max() / 127.0
+    onp.testing.assert_allclose(out.asnumpy(), g, atol=scale * 0.51 + 1e-6)
+
+
+def test_gradient_compression_rejects_unknown_type():
+    kv = mx.kv.create("local")
+    import pytest as _pytest
+    from mxnet_tpu.base import MXNetError
+    with _pytest.raises(MXNetError):
+        kv.set_gradient_compression({"type": "8byte"})
+
+
+def test_gradient_compression_validation_and_reinit():
+    import numpy as onp
+    from mxnet_tpu.base import MXNetError
+    import pytest as _pytest
+    kv = mx.kv.create("local")
+    with _pytest.raises(MXNetError):
+        kv.set_gradient_compression({"type": "2bit", "threshold": 0})
+    with _pytest.raises(MXNetError):
+        kv.set_gradient_compression({"type": "2bit", "threshold": -0.5})
+    # re-init clears stale residuals (shape change must not crash)
+    kv.set_gradient_compression({"type": "int8"})
+    kv.init("w", mx.nd.zeros((2, 2)))
+    kv.push("w", mx.nd.array(onp.ones((2, 2), "float32")))
+    kv.init("w", mx.nd.zeros((3, 3)))
+    kv.push("w", mx.nd.array(onp.ones((3, 3), "float32")))
+    out = mx.nd.zeros((3, 3))
+    kv.pull("w", out=out)
+    onp.testing.assert_allclose(out.asnumpy(), onp.ones((3, 3)), atol=0.02)
