@@ -1,0 +1,142 @@
+"""Per-operator forward/backward latency harness.
+
+Role parity: reference ``benchmark/opperf/opperf.py`` (per-op fwd/bwd
+latency across the registry, SURVEY §6). TPU-native notes: each op is
+timed as a jitted program (steady-state, compile excluded) and synced via
+a device→host scalar read — `block_until_ready` is not a reliable fence on
+tunneled platforms (see PERF.md). Backward latency times jax.grad of a
+sum-reduced call.
+
+Usage::
+
+    python benchmark/opperf.py                  # default op set
+    python benchmark/opperf.py relu dot softmax # named ops
+    python benchmark/opperf.py --json           # machine-readable lines
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+
+DEFAULT_OPS = ["relu", "sigmoid", "tanh", "exp", "softmax", "log_softmax",
+               "sum", "mean", "max", "dot", "batch_dot", "transpose",
+               "broadcast_add", "broadcast_mul", "take", "one_hot",
+               "FullyConnected", "Convolution", "Pooling", "BatchNorm",
+               "LayerNorm"]
+
+
+def _inputs_for(name, n):
+    """Representative inputs per op family (reference opperf's default
+    shapes)."""
+    import jax.numpy as jnp
+    rng = np.random.default_rng(0)
+    t = lambda *s: jnp.asarray(rng.random(s).astype("float32"))
+    if name == "dot":
+        return (t(n, n), t(n, n)), {}
+    if name == "batch_dot":
+        return (t(8, n, n), t(8, n, n)), {}
+    if name in ("broadcast_add", "broadcast_mul"):
+        return (t(n, n), t(1, n)), {}
+    if name == "take":
+        return (t(n, n),
+                jnp.asarray(rng.integers(0, n, (n,)).astype("int32"))), {}
+    if name == "one_hot":
+        return (jnp.asarray(rng.integers(0, n, (n,)).astype("int32")),), \
+            {"depth": n}
+    if name == "FullyConnected":
+        return (t(64, n), t(n, n)), {"no_bias": True, "num_hidden": n}
+    if name == "Convolution":
+        return (t(8, 32, 64, 64), t(64, 32, 3, 3)), \
+            {"kernel": (3, 3), "num_filter": 64, "pad": (1, 1),
+             "no_bias": True}
+    if name == "Pooling":
+        return (t(8, 32, 64, 64),), {"kernel": (2, 2), "stride": (2, 2),
+                                     "pool_type": "max"}
+    if name == "BatchNorm":
+        return (t(8, 32, 32, 32), t(32), t(32), t(32), t(32)), \
+            {"fix_gamma": False}
+    if name == "LayerNorm":
+        return (t(64, n), t(n), t(n)), {}
+    if name in ("sum", "mean", "max", "transpose"):
+        return (t(n, n),), {}
+    return (t(n, n),), {}
+
+
+def bench_op(name, n=512, reps=20):
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.ops.registry import get_op
+
+    op = get_op(name)
+    if op is None:
+        raise SystemExit("unknown op %r" % name)
+    args, kwargs = _inputs_for(name, n)
+
+    fwd = jax.jit(lambda *a: op.fn(*a, **kwargs))
+
+    def sync(x):
+        while isinstance(x, (tuple, list)):
+            x = x[0]
+        return jax.device_get(jnp.ravel(x)[0])
+
+    sync(fwd(*args))          # compile
+    sync(fwd(*args))          # steady state
+    t0 = time.perf_counter()
+    r = None
+    for _ in range(reps):
+        r = fwd(*args)
+    sync(r)
+    fwd_ms = (time.perf_counter() - t0) / reps * 1e3
+
+    bwd_ms = None
+    try:
+        def loss(*a):
+            out = op.fn(*a, **kwargs)
+            while isinstance(out, (tuple, list)):
+                out = out[0]
+            return jnp.sum(out.astype(jnp.float32))
+        # differentiate w.r.t. every float input (data AND weights — dW is
+        # the dominant backward cost for conv/dense)
+        argnums = tuple(i for i, a in enumerate(args)
+                        if jnp.issubdtype(a.dtype, jnp.floating))
+        if not argnums:
+            return fwd_ms, None
+        grad = jax.jit(jax.grad(loss, argnums=argnums))
+        sync(grad(*args))
+        sync(grad(*args))
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            r = grad(*args)
+        sync(r)
+        bwd_ms = (time.perf_counter() - t0) / reps * 1e3
+    except Exception:
+        pass  # non-differentiable / integer inputs
+    return fwd_ms, bwd_ms
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("ops", nargs="*", default=None)
+    ap.add_argument("--json", action="store_true")
+    ap.add_argument("-n", type=int, default=512, help="problem size")
+    ap.add_argument("--reps", type=int, default=20)
+    args = ap.parse_args()
+    ops = args.ops or DEFAULT_OPS
+    for name in ops:
+        fwd_ms, bwd_ms = bench_op(name, n=args.n, reps=args.reps)
+        if args.json:
+            print(json.dumps({"op": name, "fwd_ms": round(fwd_ms, 4),
+                              "bwd_ms": (round(bwd_ms, 4)
+                                         if bwd_ms is not None else None)}))
+        else:
+            bwd = "%8.3f" % bwd_ms if bwd_ms is not None else "     n/a"
+            print("%-18s fwd %8.3f ms   bwd %s ms" % (name, fwd_ms, bwd))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,113 @@
+"""BERT pretraining example (BASELINE.md reference config "BERT-base
+pretraining"; role of the reference ecosystem's GluonNLP pretraining
+script, on this framework's mesh-first substrate).
+
+Synthetic-corpus masked-LM + next-sentence pretraining:
+
+    python example/bert/pretrain_bert.py                 # bert-tiny, CPU ok
+    python example/bert/pretrain_bert.py --model base    # BERT-base
+    python example/bert/pretrain_bert.py --dp 4 --tp 2   # mesh sharding
+
+The training step is ONE fused SPMD program (ShardedTrainer): forward,
+backward, gradient allreduce over the dp axis, Adam update — with
+Megatron tensor-parallel sharding of qkv/proj/ffn weights over tp.
+"""
+import argparse
+import sys
+import time
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, parallel
+from mxnet_tpu.gluon.block import HybridBlock
+from mxnet_tpu.models.bert import (bert_tiny, bert_base,
+                                   BERTPretrainingLoss)
+from mxnet_tpu.models.transformer import tp_rules
+
+
+def synthetic_batch(rng, batch, seq_len, vocab, n_masks):
+    tokens = rng.integers(4, vocab, (batch, seq_len)).astype("float32")
+    segments = np.zeros((batch, seq_len), "float32")
+    half = seq_len // 2
+    segments[:, half:] = 1.0
+    positions = np.stack([rng.choice(seq_len, n_masks, replace=False)
+                          for _ in range(batch)]).astype("float32")
+    labels = np.take_along_axis(tokens, positions.astype(int), axis=1)
+    masked = tokens.copy()
+    np.put_along_axis(masked, positions.astype(int), 3.0, axis=1)  # [MASK]=3
+    weights = np.ones((batch, n_masks), "float32")
+    nsp = rng.integers(0, 2, (batch,)).astype("float32")
+    return masked, segments, positions, labels, weights, nsp
+
+
+class PretrainStep(HybridBlock):
+    """Computes the full pretraining loss inside the block, so the trainer
+    sees a scalar output: data = (tokens, segments, positions, labels,
+    weights, nsp_labels), label = unused dummy."""
+
+    def __init__(self, bert, **kw):
+        super().__init__(**kw)
+        with self.name_scope():
+            self.bert = bert
+        self.loss = BERTPretrainingLoss()
+
+    def hybrid_forward(self, F, tokens, segments, positions, labels,
+                       weights, nsp_labels):
+        _, _, mlm_logits, nsp_logits = self.bert(tokens, segments, None)
+        return self.loss(mlm_logits, nsp_logits, labels, positions,
+                         weights, nsp_labels)
+
+
+class PretrainLoss:
+    """Identity: the block already produced the scalar loss."""
+
+    def __call__(self, out, _dummy):
+        return out
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="tiny", choices=["tiny", "base"])
+    ap.add_argument("--batch-size", type=int, default=16)
+    ap.add_argument("--seq-len", type=int, default=128)
+    ap.add_argument("--vocab", type=int, default=1000)
+    ap.add_argument("--n-masks", type=int, default=20)
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--lr", type=float, default=1e-3)
+    ap.add_argument("--dp", type=int, default=-1)
+    ap.add_argument("--tp", type=int, default=1)
+    args = ap.parse_args()
+
+    mx.random.seed(0)
+    rng = np.random.default_rng(0)
+    if args.model == "base":
+        net = bert_base(vocab_size=args.vocab, max_length=args.seq_len)
+    else:
+        net = bert_tiny(vocab_size=args.vocab, max_length=args.seq_len)
+    net.initialize(mx.init.Xavier())
+
+    step = PretrainStep(net)
+    mesh = parallel.make_mesh(dp=args.dp, tp=args.tp)
+    trainer = parallel.ShardedTrainer(
+        step, PretrainLoss(), "adam", {"learning_rate": args.lr},
+        mesh=mesh, param_rules=tp_rules() if args.tp > 1 else None)
+
+    print("mesh:", dict(mesh.shape), file=sys.stderr)
+    t0 = time.time()
+    for i in range(args.steps):
+        m, s, p, l, w, nsp = synthetic_batch(
+            rng, args.batch_size, args.seq_len, args.vocab, args.n_masks)
+        loss = trainer.step(
+            (nd.array(m), nd.array(s), nd.array(p), nd.array(l),
+             nd.array(w), nd.array(nsp)),
+            nd.zeros((args.batch_size,)))
+        if i % 5 == 0 or i == args.steps - 1:
+            print("step %3d  loss %.4f" % (i, float(loss.asnumpy())))
+    dt = time.time() - t0
+    print("done: %d steps in %.1fs (%.1f seq/s)"
+          % (args.steps, dt, args.steps * args.batch_size / dt))
+
+
+if __name__ == "__main__":
+    main()

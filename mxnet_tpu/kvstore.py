@@ -98,12 +98,14 @@ class KVStore:
         for k, v in zip(keys, values):
             grouped.setdefault(k, []).append(v)
         for k, vals in grouped.items():
+            if k not in self._store:
+                # check before compression: a failed push must not consume
+                # or leak error-feedback residual state
+                raise MXNetError("key %s has not been initialized" % k)
             if self._compression_params:
                 vals = [NDArray(self._compress(k, i, v._data), ctx=v._ctx)
                         for i, v in enumerate(vals)]
             reduced = self._reduce(vals)
-            if k not in self._store:
-                raise MXNetError("key %s has not been initialized" % k)
             if self._updater is not None:
                 gw = NDArray(reduced)
                 self._updater(_key_int(k), gw, self._store[k])

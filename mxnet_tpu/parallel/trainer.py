@@ -135,29 +135,32 @@ class ShardedTrainer:
         self._step_fn = jax.jit(step, donate_argnums=(1, 2))
 
     def _build_step_many(self):
-        def many(key, param_vals, states, t0, lr, xs, ys):
+        def many(key, param_vals, states, t0, lr, *xs_ys):
             def body(carry, xy):
                 key, pv, st, t = carry
                 key, sub = jax.random.split(key)
                 loss, pv2, st2, _aux = self._one_step(
-                    sub, pv, st, t, lr, (xy[0],), xy[1])
+                    sub, pv, st, t, lr, xy[:-1], xy[-1])
                 return (key, pv2, st2, t + 1), loss
 
             (key, pv, st, t), losses = jax.lax.scan(
-                body, (key, list(param_vals), list(states), t0), (xs, ys))
+                body, (key, list(param_vals), list(states), t0),
+                tuple(xs_ys))
             return losses, pv, st
 
         self._step_many_fn = jax.jit(many, donate_argnums=(1, 2))
 
     def step(self, data, label, lr=None):
-        """One fused fwd+bwd+allreduce+update step. ``data`` may be a
-        single array or a list/tuple of model inputs (e.g. BERT's
-        tokens+segments); each is batch-sharded over the dp axes. Returns
-        the (replicated) scalar loss as a host float-convertible array."""
+        """One fused fwd+bwd+allreduce+update step. ``data`` is a single
+        array, or a TUPLE of model inputs (e.g. BERT's tokens+segments) —
+        a tuple means multi-input; a list still converts to one stacked
+        array (legacy behavior). Each input is batch-sharded over the dp
+        axes. Returns the (replicated) scalar loss as a host
+        float-convertible array."""
         if self._step_fn is None:
             self._build_step()
         self._t += 1
-        xs = data if isinstance(data, (list, tuple)) else (data,)
+        xs = data if isinstance(data, tuple) else (data,)
         bs = batch_sharding(self._mesh, self._batch_axes)
         xs = tuple(jax.device_put(
             x._data if isinstance(x, NDArray) else jnp.asarray(x), bs)
@@ -181,17 +184,22 @@ class ShardedTrainer:
         stats on-device across the whole span. Returns the per-step losses
         as an NDArray of shape (n_steps,).
 
-        data:  (n_steps, batch, ...), label: (n_steps, batch, ...).
+        data:  (n_steps, batch, ...) — or a TUPLE of such arrays for
+        multi-input models (a list still converts to one stacked array,
+        the legacy list-of-step-batches pattern); label:
+        (n_steps, batch, ...).
         """
         if self._step_many_fn is None:
             self._build_step_many()
-        xs = data._data if isinstance(data, NDArray) else jnp.asarray(data)
-        ys = label._data if isinstance(label, NDArray) else jnp.asarray(label)
-        n_steps = xs.shape[0]
+        data_list = data if isinstance(data, tuple) else (data,)
         # dim 0 = steps (unsharded), dim 1 = batch sharded over ALL batch
         # axes jointly (matches batch_sharding used by step())
         spec = PartitionSpec(None, self._batch_axes)
-        xs = jax.device_put(xs, NamedSharding(self._mesh, spec))
+        xs = tuple(jax.device_put(
+            x._data if isinstance(x, NDArray) else jnp.asarray(x),
+            NamedSharding(self._mesh, spec)) for x in data_list)
+        ys = label._data if isinstance(label, NDArray) else jnp.asarray(label)
+        n_steps = xs[0].shape[0]
         ys = jax.device_put(ys, NamedSharding(
             self._mesh,
             PartitionSpec(None, self._batch_axes) if ys.ndim >= 2
@@ -200,7 +208,7 @@ class ShardedTrainer:
         # t is 1-based inside updates (matches step(): first call sees t=1)
         losses, self._values, self._states = self._step_many_fn(
             key, self._values, self._states, self._t + 1,
-            lr if lr is not None else self._lr, xs, ys)
+            lr if lr is not None else self._lr, *xs, ys)
         self._t += n_steps
         # write final aux values (folded into the carried params) back into
         # the Block's handles so eval/export sees fresh running stats

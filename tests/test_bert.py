@@ -183,3 +183,36 @@ def test_sharded_trainer_multi_input_step():
               for _ in range(6)]
     assert all(onp.isfinite(l) for l in losses)
     assert losses[-1] < losses[0], losses
+
+
+def test_step_many_multi_input():
+    import jax
+    if len(jax.devices()) < 2:
+        pytest.skip("needs multi-device mesh")
+    from mxnet_tpu import parallel
+    from mxnet_tpu.gluon.block import HybridBlock
+    from mxnet_tpu.gluon import nn
+
+    class TwoInput(HybridBlock):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                self.d = nn.Dense(4, in_units=6)
+
+        def hybrid_forward(self, F, a, b):
+            return self.d(F.concat(a, b, dim=1))
+
+    mx.random.seed(0)
+    net = TwoInput()
+    net.initialize(mx.init.Xavier())
+    import mxnet_tpu.gluon as gluon
+    rng = onp.random.default_rng(0)
+    A = rng.random((3, 8, 3)).astype("float32")   # 3 steps
+    Bt = rng.random((3, 8, 3)).astype("float32")
+    Y = rng.integers(0, 4, (3, 8)).astype("float32")
+    trainer = parallel.ShardedTrainer(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+        {"learning_rate": 0.1}, mesh=parallel.make_mesh(dp=-1))
+    losses = trainer.step_many((nd.array(A), nd.array(Bt)), nd.array(Y))
+    assert losses.shape == (3,)
+    assert onp.isfinite(losses.asnumpy()).all()
