@@ -94,28 +94,34 @@ def bench_op(name, n=512, reps=20):
     fwd_ms = (time.perf_counter() - t0) / reps * 1e3
 
     bwd_ms = None
+
+    def loss(*a):
+        out = op.fn(*a, **kwargs)
+        while isinstance(out, (tuple, list)):
+            out = out[0]
+        return jnp.sum(out.astype(jnp.float32))
+
+    # differentiate w.r.t. every float input (data AND weights — dW is
+    # the dominant backward cost for conv/dense)
+    argnums = tuple(i for i, a in enumerate(args)
+                    if jnp.issubdtype(a.dtype, jnp.floating))
+    if not argnums:
+        return fwd_ms, None
     try:
-        def loss(*a):
-            out = op.fn(*a, **kwargs)
-            while isinstance(out, (tuple, list)):
-                out = out[0]
-            return jnp.sum(out.astype(jnp.float32))
-        # differentiate w.r.t. every float input (data AND weights — dW is
-        # the dominant backward cost for conv/dense)
-        argnums = tuple(i for i, a in enumerate(args)
-                        if jnp.issubdtype(a.dtype, jnp.floating))
-        if not argnums:
-            return fwd_ms, None
         grad = jax.jit(jax.grad(loss, argnums=argnums))
         sync(grad(*args))
-        sync(grad(*args))
-        t0 = time.perf_counter()
-        for _ in range(reps):
-            r = grad(*args)
-        sync(r)
-        bwd_ms = (time.perf_counter() - t0) / reps * 1e3
-    except Exception:
-        pass  # non-differentiable / integer inputs
+    except TypeError:
+        return fwd_ms, None  # genuinely non-differentiable op
+    except Exception as e:  # real failure: surface it, don't report n/a
+        print("WARNING: backward of %s failed: %s" % (name, e),
+              file=sys.stderr)
+        return fwd_ms, None
+    sync(grad(*args))
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        r = grad(*args)
+    sync(r)
+    bwd_ms = (time.perf_counter() - t0) / reps * 1e3
     return fwd_ms, bwd_ms
 
 

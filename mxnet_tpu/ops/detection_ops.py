@@ -1,0 +1,401 @@
+"""Detection / contrib vision operators.
+
+Role parity: reference ``src/operator/contrib/`` detection family —
+`bounding_box.cc` (_contrib_box_nms :38, _contrib_box_iou :120,
+_contrib_bipartite_matching :161, _contrib_box_encode :208,
+_contrib_box_decode :230), `multibox_prior.cc:103`,
+`roi_align.cc`, `bilinear_resize.cc`, `adaptive_avg_pooling.cc`,
+`boolean_mask.cc`, `allclose_op.cc`, `all_finite.cc`, `erfinv-inl.h`.
+
+TPU-native design: every kernel is static-shape XLA — NMS is a
+fixed-trip-count `lax.fori_loop` over a precomputed IoU matrix (suppressed
+rows become -1, no dynamic compaction), bipartite matching greedily
+consumes an (N, M) score matrix the same way, ROIAlign is vectorized
+bilinear gather, adaptive pooling uses integral images. `boolean_mask` is
+the one inherently-dynamic op: eager-only, with a clear error under
+tracing (the reference's dynamic-shape ops have the same caveat on
+accelerators).
+"""
+from __future__ import annotations
+
+import numpy as _np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+_MIN = -3.4e38
+
+
+def _to_corner(b, fmt):
+    if fmt == "corner":
+        return b
+    # center (x, y, w, h) -> corner
+    x, y, w, h = b[..., 0], b[..., 1], b[..., 2], b[..., 3]
+    return jnp.stack([x - w / 2, y - h / 2, x + w / 2, y + h / 2], axis=-1)
+
+
+def _from_corner(b, fmt):
+    if fmt == "corner":
+        return b
+    x1, y1, x2, y2 = b[..., 0], b[..., 1], b[..., 2], b[..., 3]
+    return jnp.stack([(x1 + x2) / 2, (y1 + y2) / 2, x2 - x1, y2 - y1],
+                     axis=-1)
+
+
+def _iou_corner(a, b):
+    """a (..., N, 4), b (..., M, 4) corner boxes -> (..., N, M) IoU."""
+    tl = jnp.maximum(a[..., :, None, :2], b[..., None, :, :2])
+    br = jnp.minimum(a[..., :, None, 2:], b[..., None, :, 2:])
+    wh = jnp.maximum(br - tl, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    area_a = jnp.maximum(a[..., 2] - a[..., 0], 0.0) * \
+        jnp.maximum(a[..., 3] - a[..., 1], 0.0)
+    area_b = jnp.maximum(b[..., 2] - b[..., 0], 0.0) * \
+        jnp.maximum(b[..., 3] - b[..., 1], 0.0)
+    union = area_a[..., :, None] + area_b[..., None, :] - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+@register("_contrib_box_iou", aliases=("box_iou",))
+def box_iou(lhs, rhs, format="corner"):
+    """reference `bounding_box.cc:120` — pairwise IoU."""
+    return _iou_corner(_to_corner(lhs, format), _to_corner(rhs, format))
+
+
+@register("_contrib_box_nms", aliases=("box_nms",))
+def box_nms(data, overlap_thresh=0.5, valid_thresh=0.0, topk=-1,
+            coord_start=2, score_index=1, id_index=-1, background_id=-1,
+            force_suppress=False, in_format="corner", out_format="corner"):
+    """reference `bounding_box.cc:38` — greedy per-batch NMS. Entries are
+    sorted by score descending; suppressed/invalid entries become -1.
+    Static-shape: output has the input's (..., N, K) shape."""
+    orig_shape = data.shape
+    k = orig_shape[-1]
+    n = orig_shape[-2]
+    flat = data.reshape((-1, n, k))
+
+    def one(batch):
+        scores = batch[:, score_index]
+        valid = scores > valid_thresh
+        if id_index >= 0 and background_id >= 0:
+            valid = valid & (batch[:, id_index] != background_id)
+        order = jnp.argsort(jnp.where(valid, -scores, jnp.inf))
+        sorted_b = batch[order]
+        sorted_valid = valid[order]
+        if topk > 0:
+            sorted_valid = sorted_valid & (jnp.arange(n) < topk)
+        boxes = _to_corner(sorted_b[:, coord_start:coord_start + 4],
+                           in_format)
+        iou = _iou_corner(boxes, boxes)
+        same_class = (jnp.ones((n, n), bool) if (force_suppress or
+                                                 id_index < 0)
+                      else (sorted_b[:, id_index][:, None] ==
+                            sorted_b[:, id_index][None, :]))
+        suppress_mat = (iou > overlap_thresh) & same_class
+
+        def body(i, keep):
+            # i suppresses later j when i itself is kept
+            row = suppress_mat[i] & (jnp.arange(n) > i) & keep[i]
+            return keep & ~row
+        keep = lax.fori_loop(0, n, body, sorted_valid)
+        out_b = sorted_b
+        if out_format != in_format:
+            coords = _from_corner(boxes, out_format)
+            out_b = out_b.at[:, coord_start:coord_start + 4].set(coords)
+        return jnp.where(keep[:, None], out_b,
+                         jnp.full_like(out_b, -1.0))
+
+    out = jax.vmap(one)(flat)
+    return out.reshape(orig_shape)
+
+
+@register("_contrib_bipartite_matching", aliases=("bipartite_matching",),
+          n_out=2)
+def bipartite_matching(data, is_ascend=False, threshold=0.5, topk=-1):
+    """reference `bounding_box.cc:161` — greedy bipartite matching on a
+    (..., N, M) score matrix. Returns (row->col matches (..., N), col->row
+    matches (..., M)); unmatched = -1."""
+    orig = data.shape
+    n, m = orig[-2], orig[-1]
+    flat = data.reshape((-1, n, m))
+    steps = n if topk <= 0 else min(topk, n)
+
+    def one(mat):
+        work = mat if not is_ascend else -mat
+        thr = threshold if not is_ascend else -threshold
+
+        def body(_, state):
+            work, row_match, col_match = state
+            idx = jnp.argmax(work)
+            i, j = idx // m, idx % m
+            ok = work[i, j] >= thr
+            row_match = jnp.where(ok, row_match.at[i].set(j), row_match)
+            col_match = jnp.where(ok, col_match.at[j].set(i), col_match)
+            work = jnp.where(ok, work.at[i, :].set(_MIN), work)
+            work = jnp.where(ok, work.at[:, j].set(_MIN), work)
+            return work, row_match, col_match
+
+        _, row_match, col_match = lax.fori_loop(
+            0, steps, body,
+            (work, jnp.full((n,), -1.0, mat.dtype),
+             jnp.full((m,), -1.0, mat.dtype)))
+        return row_match, col_match
+
+    rows, cols = jax.vmap(one)(flat)
+    return (rows.reshape(orig[:-1]), cols.reshape(orig[:-2] + (m,)))
+
+
+@register("_contrib_box_encode", aliases=("box_encode",))
+def box_encode(samples, matches, anchors, refs,
+               means=(0.0, 0.0, 0.0, 0.0), stds=(0.1, 0.1, 0.2, 0.2)):
+    """reference `bounding_box.cc:208` — SSD-style target encoding.
+    samples (B, N) in {-1, 0, 1}, matches (B, N) ref indices, anchors
+    (B, N, 4) corner, refs (B, M, 4) corner. Returns (targets, masks)."""
+    matched = jnp.take_along_axis(
+        refs, jnp.maximum(matches, 0).astype(jnp.int32)[..., None]
+        .repeat(4, axis=-1), axis=1)
+    aw = anchors[..., 2] - anchors[..., 0]
+    ah = anchors[..., 3] - anchors[..., 1]
+    ax = (anchors[..., 0] + anchors[..., 2]) / 2
+    ay = (anchors[..., 1] + anchors[..., 3]) / 2
+    gw = matched[..., 2] - matched[..., 0]
+    gh = matched[..., 3] - matched[..., 1]
+    gx = (matched[..., 0] + matched[..., 2]) / 2
+    gy = (matched[..., 1] + matched[..., 3]) / 2
+    means = jnp.asarray(means, anchors.dtype)
+    stds = jnp.asarray(stds, anchors.dtype)
+    t = jnp.stack([(gx - ax) / jnp.maximum(aw, 1e-12),
+                   (gy - ay) / jnp.maximum(ah, 1e-12),
+                   jnp.log(jnp.maximum(gw, 1e-12) /
+                           jnp.maximum(aw, 1e-12)),
+                   jnp.log(jnp.maximum(gh, 1e-12) /
+                           jnp.maximum(ah, 1e-12))], axis=-1)
+    t = (t - means) / stds
+    mask = (samples > 0.5).astype(anchors.dtype)[..., None]
+    return t * mask, jnp.broadcast_to(mask, t.shape).astype(anchors.dtype)
+
+
+@register("_contrib_box_decode", aliases=("box_decode",))
+def box_decode(data, anchors, std0=0.1, std1=0.1, std2=0.2, std3=0.2,
+               clip=-1.0, format="corner"):
+    """reference `bounding_box.cc:230` — invert box_encode."""
+    a = _to_corner(anchors, format)
+    aw = a[..., 2] - a[..., 0]
+    ah = a[..., 3] - a[..., 1]
+    ax = (a[..., 0] + a[..., 2]) / 2
+    ay = (a[..., 1] + a[..., 3]) / 2
+    dx = data[..., 0] * std0 * aw + ax
+    dy = data[..., 1] * std1 * ah + ay
+    dw = data[..., 2] * std2
+    dh = data[..., 3] * std3
+    if clip > 0:
+        dw = jnp.minimum(dw, clip)
+        dh = jnp.minimum(dh, clip)
+    dw = jnp.exp(dw) * aw / 2
+    dh = jnp.exp(dh) * ah / 2
+    return jnp.stack([dx - dw, dy - dh, dx + dw, dy + dh], axis=-1)
+
+
+@register("_contrib_MultiBoxPrior", aliases=("MultiBoxPrior",
+                                             "multibox_prior"))
+def multibox_prior(data, sizes=(1.0,), ratios=(1.0,), steps=(-1.0, -1.0),
+                   offsets=(0.5, 0.5), clip=False):
+    """reference `multibox_prior.cc:103` — anchor box generation over the
+    feature map grid of ``data`` (N, C, H, W) -> (1, H*W*A, 4) with
+    A = len(sizes) + len(ratios) - 1 (reference convention)."""
+    h, w = data.shape[-2], data.shape[-1]
+    step_y = steps[0] if steps[0] > 0 else 1.0 / h
+    step_x = steps[1] if steps[1] > 0 else 1.0 / w
+    cy = (jnp.arange(h, dtype=jnp.float32) + offsets[0]) * step_y
+    cx = (jnp.arange(w, dtype=jnp.float32) + offsets[1]) * step_x
+    cyx = jnp.stack(jnp.meshgrid(cy, cx, indexing="ij"), axis=-1)  # H,W,2
+    sizes = list(sizes)
+    ratios = list(ratios)
+    whs = []
+    for s in sizes:
+        r = ratios[0]
+        whs.append((s * _np.sqrt(r), s / _np.sqrt(r)))
+    for r in ratios[1:]:
+        s = sizes[0]
+        whs.append((s * _np.sqrt(r), s / _np.sqrt(r)))
+    whs = jnp.asarray(whs, jnp.float32)  # (A, 2) = (w, h)
+    a = whs.shape[0]
+    cxg = jnp.broadcast_to(cyx[..., 1][..., None], (h, w, a))
+    cyg = jnp.broadcast_to(cyx[..., 0][..., None], (h, w, a))
+    wg = jnp.broadcast_to(whs[:, 0], (h, w, a))
+    hg = jnp.broadcast_to(whs[:, 1], (h, w, a))
+    boxes = jnp.stack([cxg - wg / 2, cyg - hg / 2,
+                       cxg + wg / 2, cyg + hg / 2], axis=-1)
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    return boxes.reshape((1, h * w * a, 4))
+
+
+@register("_contrib_ROIAlign", aliases=("ROIAlign", "roi_align"))
+def roi_align(data, rois, pooled_size=(7, 7), spatial_scale=1.0,
+              sample_ratio=2, position_sensitive=False, aligned=False):
+    """reference `roi_align.cc` (contrib ROIAlign) — bilinear-sampled ROI
+    pooling. data (N, C, H, W); rois (R, 5) = [batch_idx, x1, y1, x2, y2]
+    in image coords; output (R, C, PH, PW), or (R, C/(PH*PW), PH, PW) when
+    ``position_sensitive`` (PSROIAlign channel-per-bin selection).
+
+    Deviation from the reference: sample_ratio<=0 ("adaptive" = per-ROI
+    ceil(roi_size/pooled_size) samples) is data-dependent and cannot be a
+    static XLA shape — it falls back to a fixed 2x2 sample grid per bin.
+    """
+    ph, pw = (pooled_size if isinstance(pooled_size, (tuple, list))
+              else (pooled_size, pooled_size))
+    n, c, hh, ww = data.shape
+    sr = int(sample_ratio) if int(sample_ratio) > 0 else 2
+    offset = 0.5 if aligned else 0.0
+    if position_sensitive and c % (ph * pw) != 0:
+        raise ValueError(
+            "position_sensitive ROIAlign needs channels %% (ph*pw) == 0, "
+            "got C=%d for pooled %dx%d" % (c, ph, pw))
+
+    def one(roi):
+        bidx = roi[0].astype(jnp.int32)
+        x1 = roi[1] * spatial_scale - offset
+        y1 = roi[2] * spatial_scale - offset
+        x2 = roi[3] * spatial_scale - offset
+        y2 = roi[4] * spatial_scale - offset
+        rw = jnp.maximum(x2 - x1, 1.0 if not aligned else 1e-6)
+        rh = jnp.maximum(y2 - y1, 1.0 if not aligned else 1e-6)
+        bw = rw / pw
+        bh = rh / ph
+        # sample grid: (ph, pw, sr, sr)
+        iy = jnp.arange(ph, dtype=data.dtype)
+        ix = jnp.arange(pw, dtype=data.dtype)
+        sy = (jnp.arange(sr, dtype=data.dtype) + 0.5) / sr
+        sx = (jnp.arange(sr, dtype=data.dtype) + 0.5) / sr
+        ys = y1 + (iy[:, None] + sy[None, :]) * bh  # (ph, sr)
+        xs = x1 + (ix[:, None] + sx[None, :]) * bw  # (pw, sr)
+        ys = jnp.clip(ys, 0.0, hh - 1.0)
+        xs = jnp.clip(xs, 0.0, ww - 1.0)
+        y0 = jnp.floor(ys)
+        x0 = jnp.floor(xs)
+        wy1 = ys - y0
+        wx1 = xs - x0
+        y0i = y0.astype(jnp.int32)
+        x0i = x0.astype(jnp.int32)
+        y1i = jnp.minimum(y0i + 1, hh - 1)
+        x1i = jnp.minimum(x0i + 1, ww - 1)
+        img = data[bidx]  # (C, H, W)
+
+        def gather(yi, xi):
+            # yi (ph, sr), xi (pw, sr) -> (C, ph, sr, pw, sr)
+            return img[:, yi[:, :, None, None], xi[None, None, :, :]]
+
+        v00 = gather(y0i, x0i)
+        v01 = gather(y0i, x1i)
+        v10 = gather(y1i, x0i)
+        v11 = gather(y1i, x1i)
+        wy1b = wy1[None, :, :, None, None]
+        wx1b = wx1[None, None, None, :, :]
+        val = (v00 * (1 - wy1b) * (1 - wx1b) + v01 * (1 - wy1b) * wx1b +
+               v10 * wy1b * (1 - wx1b) + v11 * wy1b * wx1b)
+        pooled = val.mean(axis=(2, 4))  # (C, ph, pw)
+        if position_sensitive:
+            # channel co*ph*pw + iy*pw + ix feeds output bin (co, iy, ix)
+            c_out = c // (ph * pw)
+            grp = pooled.reshape((c_out, ph * pw, ph, pw))
+            bin_idx = (jnp.arange(ph)[:, None] * pw +
+                       jnp.arange(pw)[None, :])           # (ph, pw)
+            pooled = jnp.take_along_axis(
+                grp, bin_idx[None, None, :, :].repeat(c_out, 0),
+                axis=1)[:, 0]
+        return pooled
+
+    return jax.vmap(one)(rois)
+
+
+@register("_contrib_BilinearResize2D", aliases=("BilinearResize2D",
+                                                "bilinear_resize_2d"))
+def bilinear_resize_2d(data, height=None, width=None, scale_height=None,
+                       scale_width=None, like=None, mode="size"):
+    """reference `bilinear_resize.cc` — NCHW bilinear resize via
+    jax.image.resize. Modes: explicit height/width, scale_height/_width
+    ("odd_scale"-style), or mode="like" with a reference tensor."""
+    n, c, h, w = data.shape
+    if like is not None or mode == "like":
+        if like is None:
+            raise ValueError("mode='like' requires the `like` tensor")
+        height, width = like.shape[-2], like.shape[-1]
+    elif height is None:
+        if scale_height is None:
+            raise ValueError("BilinearResize2D needs height/width, "
+                             "scale_height/scale_width, or like=")
+        height = int(round(h * scale_height))
+        width = int(round(w * (scale_width if scale_width is not None
+                               else scale_height)))
+    out_shape = (n, c, int(height), int(width))
+    return jax.image.resize(data, out_shape, method="linear")
+
+
+@register("_contrib_AdaptiveAvgPooling2D",
+          aliases=("AdaptiveAvgPooling2D", "adaptive_avg_pool2d"))
+def adaptive_avg_pooling_2d(data, output_size=(1, 1)):
+    """reference `adaptive_avg_pooling.cc` — exact variable-window average
+    pooling via integral images (cumsum), torch-compatible windows."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    oh, ow = output_size
+    n, c, h, w = data.shape
+    # integral image with leading zero row/col
+    ii = jnp.pad(jnp.cumsum(jnp.cumsum(data.astype(jnp.float32), axis=2),
+                            axis=3), ((0, 0), (0, 0), (1, 0), (1, 0)))
+    ys = (_np.arange(oh) * h) // oh
+    ye = -(-(_np.arange(1, oh + 1) * h) // oh)
+    xs = (_np.arange(ow) * w) // ow
+    xe = -(-(_np.arange(1, ow + 1) * w) // ow)
+    out = (ii[:, :, ye[:, None], xe[None, :]]
+           - ii[:, :, ys[:, None], xe[None, :]]
+           - ii[:, :, ye[:, None], xs[None, :]]
+           + ii[:, :, ys[:, None], xs[None, :]])
+    areas = ((ye - ys)[:, None] * (xe - xs)[None, :]).astype(_np.float32)
+    return (out / areas).astype(data.dtype)
+
+
+@register("_contrib_boolean_mask", aliases=("boolean_mask",))
+def boolean_mask(data, index, axis=0):
+    """reference `boolean_mask.cc` — dynamic-shape row filter. Eager-only
+    on TPU (XLA requires static shapes); under tracing raises with
+    guidance to use `where`/`sparse_retain`-style masking instead."""
+    if isinstance(data, jax.core.Tracer) or isinstance(index,
+                                                       jax.core.Tracer):
+        raise TypeError(
+            "boolean_mask produces a data-dependent shape and cannot run "
+            "inside jit/hybridize on TPU; use elementwise masking "
+            "(where/sparse_retain) or run it eagerly")
+    keep = _np.asarray(index).astype(bool)
+    return jnp.compress(keep, data, axis=axis)
+
+
+@register("_contrib_allclose", aliases=("allclose",))
+def allclose(a, b, rtol=1e-05, atol=1e-08, equal_nan=False):
+    """reference `allclose_op.cc` — scalar 0/1 tensor."""
+    return jnp.allclose(a, b, rtol=rtol, atol=atol,
+                        equal_nan=equal_nan).astype(jnp.float32)
+
+
+@register("all_finite")
+def all_finite(data, init_output=True):
+    """reference `all_finite.cc` — scalar 1.0 when every element is
+    finite (used by AMP dynamic loss scaling)."""
+    return jnp.isfinite(data).all().astype(jnp.float32)
+
+
+@register("multi_all_finite")
+def multi_all_finite(*arrays, num_arrays=None, init_output=True):
+    out = jnp.asarray(True)
+    for a in arrays:
+        out = out & jnp.isfinite(a).all()
+    return out.astype(jnp.float32)
+
+
+@register("erfinv")
+def erfinv(data):
+    """reference `erfinv-inl.h` (contrib) — inverse error function."""
+    return jax.scipy.special.erfinv(data)

@@ -159,6 +159,10 @@ class ShardedTrainer:
         float-convertible array."""
         if self._step_fn is None:
             self._build_step()
+        if isinstance(data, list):
+            raise TypeError(
+                "ShardedTrainer.step: pass a TUPLE for multi-input models "
+                "or a single stacked array — a list is ambiguous")
         self._t += 1
         xs = data if isinstance(data, tuple) else (data,)
         bs = batch_sharding(self._mesh, self._batch_axes)
@@ -191,6 +195,11 @@ class ShardedTrainer:
         """
         if self._step_many_fn is None:
             self._build_step_many()
+        if isinstance(data, list):
+            raise TypeError(
+                "ShardedTrainer.step_many: pass a TUPLE for multi-input "
+                "models or a single (n_steps, batch, ...) array — a list "
+                "is ambiguous")
         data_list = data if isinstance(data, tuple) else (data,)
         # dim 0 = steps (unsharded), dim 1 = batch sharded over ALL batch
         # axes jointly (matches batch_sharding used by step())

@@ -1,0 +1,223 @@
+"""Detection/contrib op tests (reference `tests/python/unittest/
+test_contrib_operator.py` semantics: IoU/NMS/matching/encode-decode vs
+numpy oracles)."""
+import math
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def _iou_np(a, b):
+    tl = onp.maximum(a[:, None, :2], b[None, :, :2])
+    br = onp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = onp.maximum(br - tl, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    aa = onp.maximum(a[:, 2] - a[:, 0], 0) * onp.maximum(a[:, 3] - a[:, 1], 0)
+    ab = onp.maximum(b[:, 2] - b[:, 0], 0) * onp.maximum(b[:, 3] - b[:, 1], 0)
+    union = aa[:, None] + ab[None, :] - inter
+    return onp.where(union > 0, inter / union, 0)
+
+
+def test_box_iou_matches_oracle():
+    rng = onp.random.default_rng(0)
+    a = rng.random((5, 4)).astype("float32")
+    a[:, 2:] += a[:, :2]  # well-formed corners
+    b = rng.random((7, 4)).astype("float32")
+    b[:, 2:] += b[:, :2]
+    got = nd.box_iou(nd.array(a), nd.array(b)).asnumpy()
+    onp.testing.assert_allclose(got, _iou_np(a, b), rtol=1e-5, atol=1e-6)
+
+
+def test_box_iou_center_format():
+    a = onp.array([[0.5, 0.5, 1.0, 1.0]], "float32")   # center covers [0,1]^2
+    b = onp.array([[0.0, 0.0, 1.0, 1.0]], "float32")   # corner [0,1]^2
+    got = nd.box_iou(nd.array(a), nd.array(a), format="center").asnumpy()
+    onp.testing.assert_allclose(got, [[1.0]], atol=1e-6)
+
+
+def test_box_nms_suppresses_overlaps():
+    # [cls_id, score, x1, y1, x2, y2]
+    data = onp.array([
+        [0, 0.9, 0.0, 0.0, 1.0, 1.0],
+        [0, 0.8, 0.05, 0.05, 1.0, 1.0],   # overlaps first -> suppressed
+        [0, 0.7, 2.0, 2.0, 3.0, 3.0],     # far away -> kept
+        [1, 0.6, 0.1, 0.1, 1.0, 1.0],     # other class -> kept
+    ], dtype="float32")
+    out = nd.box_nms(nd.array(data), overlap_thresh=0.5, coord_start=2,
+                     score_index=1, id_index=0).asnumpy()
+    kept = out[out[:, 1] > 0]
+    assert len(kept) == 3
+    assert set(kept[:, 0].tolist()) == {0.0, 1.0}
+    # sorted by score desc, suppressed row filled with -1
+    assert out[0, 1] == onp.float32(0.9)
+    suppressed = out[(out == -1).all(axis=1)]
+    assert len(suppressed) == 1
+
+
+def test_box_nms_force_suppress_and_topk():
+    data = onp.array([
+        [0, 0.9, 0.0, 0.0, 1.0, 1.0],
+        [1, 0.8, 0.05, 0.05, 1.0, 1.0],
+    ], dtype="float32")
+    out = nd.box_nms(nd.array(data), overlap_thresh=0.5, coord_start=2,
+                     score_index=1, id_index=0,
+                     force_suppress=True).asnumpy()
+    assert (out[1] == -1).all()   # cross-class suppression when forced
+    out2 = nd.box_nms(nd.array(data), overlap_thresh=0.99, coord_start=2,
+                      score_index=1, id_index=0, topk=1).asnumpy()
+    assert (out2[1] == -1).all()  # beyond topk invalidated
+
+
+def test_bipartite_matching():
+    score = onp.array([[0.9, 0.1], [0.8, 0.85]], dtype="float32")
+    rows, cols = nd.bipartite_matching(nd.array(score), threshold=0.5)
+    rows, cols = rows.asnumpy(), cols.asnumpy()
+    # greedy: (0,0)=0.9 first, then (1,1)=0.85
+    onp.testing.assert_array_equal(rows, [0, 1])
+    onp.testing.assert_array_equal(cols, [0, 1])
+    # high threshold: nothing matches
+    rows2, _ = nd.bipartite_matching(nd.array(score), threshold=0.95)
+    onp.testing.assert_array_equal(rows2.asnumpy(), [-1, -1])
+
+
+def test_box_encode_decode_roundtrip():
+    rng = onp.random.default_rng(1)
+    anchors = rng.random((1, 6, 4)).astype("float32")
+    anchors[..., 2:] = anchors[..., :2] + 0.5
+    refs = rng.random((1, 3, 4)).astype("float32")
+    refs[..., 2:] = refs[..., :2] + 0.5
+    matches = onp.array([[0, 1, 2, 0, 1, 2]], "float32")
+    samples = onp.ones((1, 6), "float32")
+    t, m = nd.box_encode(nd.array(samples), nd.array(matches),
+                         nd.array(anchors), nd.array(refs))
+    assert m.asnumpy().min() == 1.0
+    dec = nd.box_decode(t, nd.array(anchors)).asnumpy()
+    want = refs[0][matches[0].astype(int)]
+    onp.testing.assert_allclose(dec[0], want, rtol=1e-4, atol=1e-5)
+
+
+def test_multibox_prior_shapes_and_centers():
+    x = nd.zeros((1, 3, 4, 4))
+    anchors = nd.multibox_prior(x, sizes=(0.5, 0.25), ratios=(1, 2))
+    a = anchors.asnumpy()
+    assert a.shape == (1, 4 * 4 * 3, 4)  # sizes + ratios - 1 per cell
+    # first cell center is ((0+.5)/4, (0+.5)/4) with size .5 box
+    first = a[0, 0]
+    onp.testing.assert_allclose(((first[0] + first[2]) / 2,
+                                 (first[1] + first[3]) / 2),
+                                (0.125, 0.125), atol=1e-6)
+    onp.testing.assert_allclose(first[2] - first[0], 0.5, atol=1e-6)
+
+
+def test_roi_align_constant_and_gradient():
+    # constant image -> every pooled value equals the constant
+    data = onp.full((1, 2, 8, 8), 3.0, "float32")
+    rois = onp.array([[0, 1.0, 1.0, 6.0, 6.0]], "float32")
+    out = nd.ROIAlign(nd.array(data), nd.array(rois), pooled_size=(2, 2),
+                      spatial_scale=1.0).asnumpy()
+    assert out.shape == (1, 2, 2, 2)
+    onp.testing.assert_allclose(out, 3.0, atol=1e-5)
+    # linear ramp in x -> pooled values increase along x
+    ramp = onp.tile(onp.arange(8, dtype="float32"), (1, 1, 8, 1))
+    out2 = nd.ROIAlign(nd.array(ramp), nd.array(rois),
+                       pooled_size=(1, 2)).asnumpy()
+    assert out2[0, 0, 0, 1] > out2[0, 0, 0, 0]
+
+
+def test_bilinear_resize_2d():
+    x = onp.arange(16, dtype="float32").reshape(1, 1, 4, 4)
+    out = nd.BilinearResize2D(nd.array(x), height=8, width=8).asnumpy()
+    assert out.shape == (1, 1, 8, 8)
+    onp.testing.assert_allclose(out[0, 0, 0, 0], 0.0, atol=1e-5)
+    assert abs(out[0, 0, -1, -1] - 15.0) < 1.0
+
+
+def test_adaptive_avg_pooling_exact():
+    x = onp.arange(36, dtype="float32").reshape(1, 1, 6, 6)
+    out = nd.AdaptiveAvgPooling2D(nd.array(x), output_size=(2, 2)).asnumpy()
+    want = onp.array([[x[0, 0, :3, :3].mean(), x[0, 0, :3, 3:].mean()],
+                      [x[0, 0, 3:, :3].mean(), x[0, 0, 3:, 3:].mean()]])
+    onp.testing.assert_allclose(out[0, 0], want, rtol=1e-6)
+    # uneven split (torch-compatible window boundaries)
+    x2 = onp.arange(25, dtype="float32").reshape(1, 1, 5, 5)
+    out2 = nd.AdaptiveAvgPooling2D(nd.array(x2), output_size=(2, 2)).asnumpy()
+    want00 = x2[0, 0, :3, :3].mean()
+    onp.testing.assert_allclose(out2[0, 0, 0, 0], want00, rtol=1e-6)
+
+
+def test_boolean_mask_eager_and_traced():
+    x = onp.arange(12, dtype="float32").reshape(4, 3)
+    keep = onp.array([1, 0, 1, 0], "float32")
+    out = nd.boolean_mask(nd.array(x), nd.array(keep)).asnumpy()
+    onp.testing.assert_allclose(out, x[[0, 2]])
+    import jax
+    with pytest.raises(TypeError):
+        jax.jit(lambda a, k:
+                mx.ops.get_op("boolean_mask").fn(a, k))(x, keep)
+
+
+def test_allclose_allfinite_erfinv():
+    a = nd.array([1.0, 2.0])
+    assert float(nd.allclose(a, a).asnumpy()) == 1.0
+    assert float(nd.allclose(a, a + 1).asnumpy()) == 0.0
+    assert float(nd.all_finite(a).asnumpy()) == 1.0
+    assert float(nd.all_finite(nd.array([onp.inf])).asnumpy()) == 0.0
+    assert float(nd.multi_all_finite(a, nd.array([onp.nan])).asnumpy()) == 0.0
+    x = onp.array([-0.5, 0.0, 0.5], "float32")
+    got = nd.erfinv(nd.array(x)).asnumpy()
+    onp.testing.assert_allclose(
+        onp.vectorize(math.erf)(got), x, rtol=1e-4, atol=1e-5)
+
+
+def test_box_nms_out_format_conversion():
+    data = onp.array([[0, 0.9, 0.0, 0.0, 1.0, 1.0]], "float32")
+    out = nd.box_nms(nd.array(data), coord_start=2, score_index=1,
+                     id_index=0, in_format="corner",
+                     out_format="center").asnumpy()
+    # corner (0,0,1,1) -> center (0.5, 0.5, 1, 1)
+    onp.testing.assert_allclose(out[0, 2:], [0.5, 0.5, 1.0, 1.0], atol=1e-6)
+
+
+def test_ps_roi_align():
+    ph = pw = 2
+    c_out = 3
+    c = c_out * ph * pw
+    rng = onp.random.default_rng(0)
+    data = rng.random((1, c, 8, 8)).astype("float32")
+    rois = onp.array([[0, 0.0, 0.0, 7.0, 7.0]], "float32")
+    out = nd.ROIAlign(nd.array(data), nd.array(rois),
+                      pooled_size=(ph, pw), position_sensitive=True)
+    assert out.shape == (1, c_out, ph, pw)
+    with pytest.raises(ValueError):
+        nd.ROIAlign(nd.array(rng.random((1, 5, 8, 8)).astype("float32")),
+                    nd.array(rois), pooled_size=(2, 2),
+                    position_sensitive=True)
+
+
+def test_bilinear_resize_like_and_errors():
+    x = nd.array(onp.zeros((1, 1, 4, 4), "float32"))
+    ref = nd.array(onp.zeros((1, 1, 9, 5), "float32"))
+    out = nd.BilinearResize2D(x, like=ref, mode="like")
+    assert out.shape == (1, 1, 9, 5)
+    out2 = nd.BilinearResize2D(x, scale_height=2.0, scale_width=3.0)
+    assert out2.shape == (1, 1, 8, 12)
+    with pytest.raises(ValueError):
+        nd.BilinearResize2D(x)
+
+
+def test_trainer_rejects_list_data():
+    import jax
+    if len(jax.devices()) < 2:
+        pytest.skip("needs multi-device mesh")
+    from mxnet_tpu import parallel, gluon
+    from mxnet_tpu.gluon import nn
+    net = nn.Dense(2, in_units=3)
+    net.initialize(mx.init.Xavier())
+    tr = parallel.ShardedTrainer(net, gluon.loss.L2Loss(), "sgd",
+                                 {"learning_rate": 0.1},
+                                 mesh=parallel.make_mesh())
+    with pytest.raises(TypeError):
+        tr.step([nd.zeros((4, 3)), nd.zeros((4, 3))], nd.zeros((4, 2)))
