@@ -18,8 +18,9 @@ from . import random_ops  # noqa: F401  (sampling ops)
 from . import linalg_extra  # noqa: F401
 from . import loss_ops  # noqa: F401  (regression outputs, ROI)
 from . import image_ops  # noqa: F401
-from . import numpy_ops  # noqa: F401  (_npi_/_np_/_npx_ registrations)
 from . import detection_ops  # noqa: F401  (contrib detection family)
+from . import numpy_ops  # noqa: F401  (_npi_/_np_/_npx_ registrations;
+#                                       aliases ops above, keep last)
 
 
 def populate_namespace(target, names=None):

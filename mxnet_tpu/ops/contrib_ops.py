@@ -108,8 +108,5 @@ def smooth_l1(data, scalar=1.0):
                      jnp.abs(data) - 0.5 / s2)
 
 
-@register("_contrib_boolean_mask", differentiable=False)
-def boolean_mask(data, index, axis=0):
-    # dynamic-shape op: TPU-unfriendly; eager-only fallback via host
-    idx = jnp.nonzero(index)[0]
-    return jnp.take(data, idx, axis=axis)
+# _contrib_boolean_mask lives in detection_ops.py (eager-only with a
+# clear dynamic-shape error under tracing).

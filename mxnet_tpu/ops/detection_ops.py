@@ -330,6 +330,8 @@ def bilinear_resize_2d(data, height=None, width=None, scale_height=None,
         height = int(round(h * scale_height))
         width = int(round(w * (scale_width if scale_width is not None
                                else scale_height)))
+    elif width is None:
+        raise ValueError("BilinearResize2D: height given without width")
     out_shape = (n, c, int(height), int(width))
     return jax.image.resize(data, out_shape, method="linear")
 
@@ -343,6 +345,8 @@ def adaptive_avg_pooling_2d(data, output_size=(1, 1)):
         output_size = (output_size, output_size)
     oh, ow = output_size
     n, c, h, w = data.shape
+    if (oh, ow) == (1, 1):  # global pooling: one reduction, no cumsums
+        return data.mean(axis=(2, 3), keepdims=True)
     # integral image with leading zero row/col
     ii = jnp.pad(jnp.cumsum(jnp.cumsum(data.astype(jnp.float32), axis=2),
                             axis=3), ((0, 0), (0, 0), (1, 0), (1, 0)))
@@ -358,7 +362,8 @@ def adaptive_avg_pooling_2d(data, output_size=(1, 1)):
     return (out / areas).astype(data.dtype)
 
 
-@register("_contrib_boolean_mask", aliases=("boolean_mask",))
+@register("_contrib_boolean_mask", aliases=("boolean_mask",),
+          differentiable=False)
 def boolean_mask(data, index, axis=0):
     """reference `boolean_mask.cc` — dynamic-shape row filter. Eager-only
     on TPU (XLA requires static shapes); under tracing raises with
@@ -380,22 +385,6 @@ def allclose(a, b, rtol=1e-05, atol=1e-08, equal_nan=False):
                         equal_nan=equal_nan).astype(jnp.float32)
 
 
-@register("all_finite")
-def all_finite(data, init_output=True):
-    """reference `all_finite.cc` — scalar 1.0 when every element is
-    finite (used by AMP dynamic loss scaling)."""
-    return jnp.isfinite(data).all().astype(jnp.float32)
-
-
-@register("multi_all_finite")
-def multi_all_finite(*arrays, num_arrays=None, init_output=True):
-    out = jnp.asarray(True)
-    for a in arrays:
-        out = out & jnp.isfinite(a).all()
-    return out.astype(jnp.float32)
-
-
-@register("erfinv")
-def erfinv(data):
-    """reference `erfinv-inl.h` (contrib) — inverse error function."""
-    return jax.scipy.special.erfinv(data)
+# NB: all_finite / multi_all_finite (reference all_finite.cc) keep their
+# tensor_extra.py registrations with the reference's (1,) output shape,
+# and erfinv (reference erfinv-inl.h) its core.py registration.

@@ -160,23 +160,8 @@ def Pooling(data, kernel=(), pool_type="max", stride=(), pad=(),
     raise ValueError("unknown pool_type %s" % pool_type)
 
 
-@register("AdaptiveAvgPooling2D", aliases=("_contrib_AdaptiveAvgPooling2D",))
-def AdaptiveAvgPooling2D(data, output_size=(1, 1)):
-    osz = _pair(output_size, 2)
-    b, c, h, w = data.shape
-    if osz == (1, 1):
-        return jnp.mean(data, axis=(2, 3), keepdims=True)
-    x = data.reshape(b, c, osz[0], h // osz[0], osz[1], w // osz[1])
-    return jnp.mean(x, axis=(3, 5))
-
-
-@register("BilinearResize2D", aliases=("_contrib_BilinearResize2D",))
-def BilinearResize2D(data, height=1, width=1, scale_height=None,
-                     scale_width=None, mode="size"):
-    b, c, h, w = data.shape
-    if scale_height is not None:
-        height, width = int(h * scale_height), int(w * scale_width)
-    return jax.image.resize(data, (b, c, height, width), method="linear")
+# AdaptiveAvgPooling2D / BilinearResize2D live in detection_ops.py
+# (exact integral-image windows + mode='like' support).
 
 
 # ------------------------------------------------------------ activations

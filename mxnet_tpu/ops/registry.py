@@ -64,6 +64,14 @@ def register(name=None, n_out=1, aliases=(), namespace="nd",
     """Decorator: register a pure JAX function as a framework op."""
     def deco(fn):
         opname = name or fn.__name__
+        # duplicate registration is fatal (reference nnvm registry CHECKs):
+        # a silent override would shadow an op with different semantics
+        for n in (opname,) + tuple(aliases):
+            if n in _OP_REGISTRY:
+                raise ValueError(
+                    "operator %r is already registered (by %r); use "
+                    "register_alias to re-expose an existing op"
+                    % (n, _OP_REGISTRY[n].name))
         op = Op(opname, fn, n_out=n_out, aliases=aliases,
                 namespace=namespace, differentiable=differentiable,
                 state_binders=state_binders)

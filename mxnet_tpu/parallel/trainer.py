@@ -153,10 +153,9 @@ class ShardedTrainer:
     def step(self, data, label, lr=None):
         """One fused fwd+bwd+allreduce+update step. ``data`` is a single
         array, or a TUPLE of model inputs (e.g. BERT's tokens+segments) —
-        a tuple means multi-input; a list still converts to one stacked
-        array (legacy behavior). Each input is batch-sharded over the dp
-        axes. Returns the (replicated) scalar loss as a host
-        float-convertible array."""
+        a tuple means multi-input; lists are rejected as ambiguous. Each
+        input is batch-sharded over the dp axes. Returns the (replicated)
+        scalar loss as a host float-convertible array."""
         if self._step_fn is None:
             self._build_step()
         if isinstance(data, list):
@@ -189,8 +188,7 @@ class ShardedTrainer:
         as an NDArray of shape (n_steps,).
 
         data:  (n_steps, batch, ...) — or a TUPLE of such arrays for
-        multi-input models (a list still converts to one stacked array,
-        the legacy list-of-step-batches pattern); label:
+        multi-input models (lists are rejected as ambiguous); label:
         (n_steps, batch, ...).
         """
         if self._step_many_fn is None:

@@ -182,3 +182,51 @@ def test_dropout_grad():
     g = x.grad.asnumpy()
     # grads are 0 or 2 (1/keep_prob)
     assert set(np.unique(g)).issubset({0.0, 2.0})
+
+
+def test_higher_order_transcendental():
+    """reference tests/python/unittest/test_higher_order_grad.py: second
+    derivatives of transcendental ops match closed forms."""
+    cases = [
+        ("sin", lambda v: -onp_sin(v)),        # d2 sin = -sin
+        ("exp", lambda v: onp_exp(v)),         # d2 exp = exp
+        ("log", lambda v: -1.0 / v ** 2),      # d2 log = -1/x^2
+        ("sigmoid", None),                     # checked vs finite diff
+    ]
+    import numpy as onp
+    global onp_sin, onp_exp
+    onp_sin, onp_exp = onp.sin, onp.exp
+    vals = onp.array([0.3, 0.7, 1.3], dtype="float32")
+    for name, d2 in cases:
+        x = nd.array(vals.copy())
+        x.attach_grad()
+        with ag.record():
+            y = getattr(nd, name)(x).sum()
+            gx, = ag.grad(y, x, create_graph=True)
+            z = gx.sum()
+        z.backward()
+        got = x.grad.asnumpy()
+        if d2 is not None:
+            onp.testing.assert_allclose(got, d2(vals), rtol=1e-4,
+                                        atol=1e-5)
+        else:
+            eps = 1e-3
+
+            def g1(v):
+                s = 1 / (1 + onp.exp(-v))
+                return s * (1 - s)
+            fd = (g1(vals + eps) - g1(vals - eps)) / (2 * eps)
+            onp.testing.assert_allclose(got, fd, rtol=1e-2, atol=1e-4)
+
+
+def test_third_order_grad():
+    # d3/dx3 of x^4 = 24 x
+    x = nd.array([1.5])
+    x.attach_grad()
+    with ag.record():
+        y = (x ** 4).sum()
+        g1, = ag.grad(y, x, create_graph=True)
+        g2, = ag.grad(g1.sum(), x, create_graph=True)
+        z = g2.sum()
+    z.backward()
+    assert np.allclose(x.grad.asnumpy(), [24 * 1.5])

@@ -221,3 +221,28 @@ def test_trainer_rejects_list_data():
                                  mesh=parallel.make_mesh())
     with pytest.raises(TypeError):
         tr.step([nd.zeros((4, 3)), nd.zeros((4, 3))], nd.zeros((4, 2)))
+
+
+def test_boolean_mask_not_recorded_on_tape():
+    from mxnet_tpu import autograd as ag
+    x = nd.array(onp.arange(6, dtype="float32").reshape(3, 2))
+    x.attach_grad()
+    keep = nd.array(onp.array([1, 0, 1], "float32"))
+    with ag.record():
+        y = nd.boolean_mask(x, keep)      # non-differentiable: not taped
+        z = nd.sum(x * 2) + float(y.asnumpy().sum())
+    z.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), 2 * onp.ones((3, 2)))
+
+
+def test_bilinear_resize_height_without_width_raises():
+    x = nd.array(onp.zeros((1, 1, 4, 4), "float32"))
+    with pytest.raises(ValueError):
+        nd.BilinearResize2D(x, height=8)
+
+
+def test_adaptive_pool_global_fast_path():
+    x = onp.random.default_rng(0).random((2, 3, 5, 7)).astype("float32")
+    out = nd.AdaptiveAvgPooling2D(nd.array(x), output_size=1).asnumpy()
+    onp.testing.assert_allclose(out, x.mean(axis=(2, 3), keepdims=True),
+                                rtol=1e-6)
