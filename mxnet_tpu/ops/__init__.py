@@ -19,6 +19,7 @@ from . import linalg_extra  # noqa: F401
 from . import loss_ops  # noqa: F401  (regression outputs, ROI)
 from . import image_ops  # noqa: F401
 from . import detection_ops  # noqa: F401  (contrib detection family)
+from . import transformer_ops  # noqa: F401  (interleaved attention matmuls)
 from . import numpy_ops  # noqa: F401  (_npi_/_np_/_npx_ registrations;
 #                                       aliases ops above, keep last)
 

@@ -12,9 +12,10 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ._common import _bind_key, _bind_train
 from .registry import register
 
-__all__ = ["rnn_scan_layer"]
+__all__ = ["rnn_scan_layer", "RNN", "rnn_param_size"]
 
 
 def _gates_precompute(x, w_ih, b_ih):
@@ -102,3 +103,110 @@ def rnn_scan_layer(data, w_ih, w_hh, b_ih, b_hh, h0, c0=None,
     if reverse:
         ys = jnp.flip(ys, axis=0)
     return ys, hT
+
+
+# ------------------------------------------------------------- fused RNN op
+# (reference src/operator/rnn-inl.h RNNOp / rnn.cc `RNN`: one op carrying a
+# cuDNN-style flat parameter vector. Gate counts and the weights-then-biases
+# flat layout follow GetRnnParamSize rnn-inl.h; gate orders match the scan
+# layers above: LSTM i,f,g,o — GRU r,z,n.)
+
+_GATES = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}
+
+
+def rnn_param_size(num_layers, input_size, state_size, bidirectional=False,
+                   mode="lstm"):
+    """Total flat-parameter length (reference rnn-inl.h GetRnnParamSize)."""
+    G = _GATES[mode]
+    D = 2 if bidirectional else 1
+    size = G * state_size * D
+    first = (input_size + state_size + 2) * size
+    rest = (state_size * D + state_size + 2) * size
+    return first + (num_layers - 1) * rest
+
+
+def _split_rnn_params(params, num_layers, input_size, H, D, G):
+    """Slice the flat vector into per-(layer, direction) weight/bias sets.
+
+    Layout: all weights first (layer-major, direction-minor: i2h then h2h),
+    then all biases in the same order — the cuDNN canonical order the
+    reference packs into (rnn-inl.h).
+    """
+    off = 0
+    weights = []
+    for layer in range(num_layers):
+        inp = input_size if layer == 0 else H * D
+        per_dir = []
+        for _ in range(D):
+            w_ih = params[off:off + G * H * inp].reshape(G * H, inp)
+            off += G * H * inp
+            w_hh = params[off:off + G * H * H].reshape(G * H, H)
+            off += G * H * H
+            per_dir.append([w_ih, w_hh])
+        weights.append(per_dir)
+    for layer in range(num_layers):
+        for d in range(D):
+            b_ih = params[off:off + G * H]
+            off += G * H
+            b_hh = params[off:off + G * H]
+            off += G * H
+            weights[layer][d] += [b_ih, b_hh]
+    return weights
+
+
+@register("RNN", n_out=0, state_binders={"key": _bind_key,
+                                         "train": _bind_train})
+def RNN(data, parameters, state, state_cell=None, state_size=0,
+        num_layers=1, bidirectional=False, mode="lstm", p=0.0,
+        state_outputs=False, projection_size=None, key=None, train=False,
+        **_ignored):
+    """Fused multi-layer (bi)directional RNN/LSTM/GRU over (T, B, I) input.
+
+    Inputs follow the reference op: ``data`` time-major (seq, batch, feat),
+    ``parameters`` a flat vector (layout above), ``state`` (L*D, B, H), and
+    ``state_cell`` for LSTM. Returns ``output`` (T, B, D*H) plus, when
+    ``state_outputs``, the final h (and c for LSTM). Dropout ``p`` applies
+    between layers in training, as in the reference (rnn-inl.h).
+    """
+    if projection_size not in (None, 0):
+        raise NotImplementedError("LSTMP projection_size is not supported")
+    mode = str(mode)
+    G = _GATES[mode]
+    D = 2 if bidirectional else 1
+    H = int(state_size)
+    L = int(num_layers)
+    sets = _split_rnn_params(parameters, L, data.shape[2], H, D, G)
+
+    x = data
+    h_finals, c_finals = [], []
+    for layer in range(L):
+        outs = []
+        for d in range(D):
+            w_ih, w_hh, b_ih, b_hh = sets[layer][d]
+            h0 = state[layer * D + d]
+            xd = jnp.flip(x, axis=0) if d == 1 else x
+            if mode == "lstm":
+                c0 = state_cell[layer * D + d]
+                ys, hT, cT = _lstm_layer(xd, w_ih, w_hh, b_ih, b_hh, h0, c0)
+                c_finals.append(cT)
+            elif mode == "gru":
+                ys, hT = _gru_layer(xd, w_ih, w_hh, b_ih, b_hh, h0)
+            else:
+                ys, hT = _rnn_layer(xd, w_ih, w_hh, b_ih, b_hh, h0,
+                                    "tanh" if mode == "rnn_tanh" else "relu")
+            if d == 1:
+                ys = jnp.flip(ys, axis=0)
+            outs.append(ys)
+            h_finals.append(hT)
+        x = outs[0] if D == 1 else jnp.concatenate(outs, axis=-1)
+        if train and p > 0.0 and layer < L - 1 and key is not None:
+            keep = jax.random.bernoulli(
+                jax.random.fold_in(key, layer), 1.0 - p, x.shape)
+            x = jnp.where(keep, x / (1.0 - p), jnp.zeros((), x.dtype))
+
+    if not state_outputs:
+        return (x,)
+    hN = jnp.stack(h_finals, axis=0)
+    if mode == "lstm":
+        return x, hN, jnp.stack(c_finals, axis=0)
+    return x, hN

@@ -1,0 +1,99 @@
+"""Transformer acceleration ops: interleaved-projection attention matmuls.
+
+Role parity: reference ``src/operator/contrib/transformer.cc`` /
+``transformer.cu`` (``_contrib_interleaved_matmul_selfatt_qk`` etc.), the
+ops GluonNLP's BERT uses to fuse multi-head attention projections into
+strided batched gemms. TPU-native: each op is a single ``jnp.einsum`` over
+the interleaved layout — XLA lowers it to one batched MXU matmul, which is
+exactly the role the reference's cuBLAS strided-batch calls play.
+
+Layout (from the reference kernels' stride math): the projected last dim of
+``queries_keys_values`` is ordered ``(heads, 3, head_dim)`` — for every head
+a contiguous [q|k|v] block — and attention batches are sequence-major,
+head-minor: attention row ``b*heads + h``.
+"""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+
+from .registry import register
+
+__all__ = [
+    "interleaved_matmul_selfatt_qk",
+    "interleaved_matmul_selfatt_valatt",
+    "interleaved_matmul_encdec_qk",
+    "interleaved_matmul_encdec_valatt",
+]
+
+
+@register("_contrib_interleaved_matmul_selfatt_qk",
+          aliases=("interleaved_matmul_selfatt_qk",))
+def interleaved_matmul_selfatt_qk(queries_keys_values, heads=1):
+    """scaled Q @ K^T from an interleaved self-attention projection.
+
+    queries_keys_values: (seq, batch, 3*heads*head_dim) with per-head
+    contiguous [q|k|v]. Returns (batch*heads, seq, seq) scores scaled by
+    1/sqrt(head_dim) (reference transformer.cu scale).
+    """
+    S, B, P = queries_keys_values.shape
+    D = P // (3 * heads)
+    qkv = queries_keys_values.reshape(S, B, heads, 3, D)
+    q, k = qkv[..., 0, :], qkv[..., 1, :]
+    scale = jnp.asarray(1.0 / math.sqrt(D), q.dtype)
+    att = jnp.einsum("qbhd,kbhd->bhqk", q * scale, k)
+    return att.reshape(B * heads, S, S)
+
+
+@register("_contrib_interleaved_matmul_selfatt_valatt",
+          aliases=("interleaved_matmul_selfatt_valatt",))
+def interleaved_matmul_selfatt_valatt(queries_keys_values, attention,
+                                      heads=1):
+    """attention @ V, re-assembled to the (seq, batch, embed) layout.
+
+    attention: (batch*heads, seq, seq); output (seq, batch, heads*head_dim).
+    """
+    S, B, P = queries_keys_values.shape
+    D = P // (3 * heads)
+    v = queries_keys_values.reshape(S, B, heads, 3, D)[..., 2, :]
+    att = attention.reshape(B, heads, S, S)
+    out = jnp.einsum("bhqk,kbhd->qbhd", att, v)
+    return out.reshape(S, B, heads * D)
+
+
+@register("_contrib_interleaved_matmul_encdec_qk",
+          aliases=("interleaved_matmul_encdec_qk",))
+def interleaved_matmul_encdec_qk(queries, keys_values, heads=1):
+    """Cross-attention scores: separate query tensor, interleaved [k|v].
+
+    queries: (q_seq, batch, heads*head_dim);
+    keys_values: (kv_seq, batch, 2*heads*head_dim).
+    Returns (batch*heads, q_seq, kv_seq) scaled by 1/sqrt(head_dim).
+    """
+    Sq, B, E = queries.shape
+    D = E // heads
+    Sk = keys_values.shape[0]
+    q = queries.reshape(Sq, B, heads, D)
+    k = keys_values.reshape(Sk, B, heads, 2, D)[..., 0, :]
+    scale = jnp.asarray(1.0 / math.sqrt(D), q.dtype)
+    att = jnp.einsum("qbhd,kbhd->bhqk", q * scale, k)
+    return att.reshape(B * heads, Sq, Sk)
+
+
+@register("_contrib_interleaved_matmul_encdec_valatt",
+          aliases=("interleaved_matmul_encdec_valatt",))
+def interleaved_matmul_encdec_valatt(keys_values, attention, heads=1):
+    """Cross-attention context: attention @ V from interleaved [k|v].
+
+    keys_values: (kv_seq, batch, 2*heads*head_dim);
+    attention: (batch*heads, q_seq, kv_seq).
+    Returns (q_seq, batch, heads*head_dim).
+    """
+    Sk, B, P = keys_values.shape
+    D = P // (2 * heads)
+    v = keys_values.reshape(Sk, B, heads, 2, D)[..., 1, :]
+    Sq = attention.shape[1]
+    att = attention.reshape(B, heads, Sq, Sk)
+    out = jnp.einsum("bhqk,kbhd->qbhd", att, v)
+    return out.reshape(Sq, B, heads * D)

@@ -144,3 +144,84 @@ def test_rnn_layer_hybridize():
     layer.hybridize()
     out = layer(x).asnumpy()
     np.testing.assert_allclose(ref, out, rtol=1e-5, atol=1e-6)
+
+
+# ---------------------------------------------------------------- fused RNN op
+# (reference src/operator/rnn-inl.h `RNN`: flat cuDNN-style parameter vector;
+#  oracle below is a plain numpy re-implementation of the same math)
+
+def _np_lstm_ref(x, w_ih, w_hh, b_ih, b_hh, h0, c0):
+    def sig(v):
+        return 1.0 / (1.0 + np.exp(-v))
+    T = x.shape[0]
+    h, c, ys = h0, c0, []
+    for t in range(T):
+        g = x[t] @ w_ih.T + b_ih + h @ w_hh.T + b_hh
+        i, f, gg, o = np.split(g, 4, axis=-1)
+        c = sig(f) * c + sig(i) * np.tanh(gg)
+        h = sig(o) * np.tanh(c)
+        ys.append(h)
+    return np.stack(ys), h, c
+
+
+def _flat_lstm_params(w_ih, w_hh, b_ih, b_hh):
+    return np.concatenate([w_ih.ravel(), w_hh.ravel(),
+                           b_ih.ravel(), b_hh.ravel()])
+
+
+def test_rnn_op_lstm_matches_numpy():
+    from mxnet_tpu.ops.rnn import rnn_param_size
+    T, B, I, H = 5, 3, 4, 6
+    rng = np.random.RandomState(0)
+    x = rng.randn(T, B, I).astype("float32")
+    w_ih = rng.randn(4 * H, I).astype("float32") * 0.3
+    w_hh = rng.randn(4 * H, H).astype("float32") * 0.3
+    b_ih = rng.randn(4 * H).astype("float32") * 0.1
+    b_hh = rng.randn(4 * H).astype("float32") * 0.1
+    flat = _flat_lstm_params(w_ih, w_hh, b_ih, b_hh)
+    assert flat.size == rnn_param_size(1, I, H, mode="lstm")
+    h0 = np.zeros((1, B, H), "float32")
+    c0 = np.zeros((1, B, H), "float32")
+    out, hN, cN = mx.nd.RNN(mx.nd.array(x), mx.nd.array(flat),
+                            mx.nd.array(h0), mx.nd.array(c0),
+                            state_size=H, num_layers=1, mode="lstm",
+                            state_outputs=True)
+    ref_y, ref_h, ref_c = _np_lstm_ref(x, w_ih, w_hh, b_ih, b_hh,
+                                       h0[0], c0[0])
+    np.testing.assert_allclose(out.asnumpy(), ref_y, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(hN.asnumpy()[0], ref_h, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(cN.asnumpy()[0], ref_c, rtol=1e-4, atol=1e-4)
+
+
+def test_rnn_op_bidirectional_shapes_and_grad():
+    from mxnet_tpu.ops.rnn import rnn_param_size
+    T, B, I, H, L = 4, 2, 3, 5, 2
+    n = rnn_param_size(L, I, H, bidirectional=True, mode="gru")
+    params = mx.nd.array(np.random.RandomState(1).randn(n).astype(
+        "float32") * 0.2)
+    x = mx.nd.array(np.random.RandomState(2).randn(T, B, I).astype("float32"))
+    h0 = mx.nd.zeros((L * 2, B, H))
+    params.attach_grad()
+    with ag.record():
+        out, hN = mx.nd.RNN(x, params, h0, state_size=H, num_layers=L,
+                            bidirectional=True, mode="gru",
+                            state_outputs=True)
+        loss = out.sum()
+    loss.backward()
+    assert out.shape == (T, B, 2 * H)
+    assert hN.shape == (L * 2, B, H)
+    g = params.grad.asnumpy()
+    assert np.isfinite(g).all() and np.abs(g).sum() > 0
+
+
+def test_rnn_op_vanilla_two_layer():
+    from mxnet_tpu.ops.rnn import rnn_param_size
+    T, B, I, H = 3, 2, 4, 4
+    n = rnn_param_size(2, I, H, mode="rnn_tanh")
+    params = mx.nd.array(np.random.RandomState(3).randn(n).astype(
+        "float32") * 0.3)
+    x = mx.nd.array(np.random.RandomState(4).randn(T, B, I).astype("float32"))
+    (out,) = mx.nd.RNN(x, params, mx.nd.zeros((2, B, H)), state_size=H,
+                       num_layers=2, mode="rnn_tanh")
+    assert out.shape == (T, B, H)
+    assert np.abs(out.asnumpy()).max() <= 1.0  # tanh-bounded
