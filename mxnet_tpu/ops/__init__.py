@@ -20,6 +20,7 @@ from . import loss_ops  # noqa: F401  (regression outputs, ROI)
 from . import image_ops  # noqa: F401
 from . import detection_ops  # noqa: F401  (contrib detection family)
 from . import transformer_ops  # noqa: F401  (interleaved attention matmuls)
+from . import quantized_ops  # noqa: F401  (INT8 quantization op family)
 from . import numpy_ops  # noqa: F401  (_npi_/_np_/_npx_ registrations;
 #                                       aliases ops above, keep last)
 

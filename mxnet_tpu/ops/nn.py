@@ -143,7 +143,8 @@ def Pooling(data, kernel=(), pool_type="max", stride=(), pad=(),
     else:
         padding = [(0, 0), (0, 0)] + [(p, p) for p in pad]
     if pool_type == "max":
-        init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) else jnp.iinfo(data.dtype).min
+        init = (-jnp.inf if jnp.issubdtype(data.dtype, jnp.floating)
+                else jnp.asarray(jnp.iinfo(data.dtype).min, data.dtype))
         return lax.reduce_window(data, init, lax.max, window, strides, padding)
     if pool_type in ("avg", "sum", "lp"):
         x = jnp.power(jnp.abs(data), p_value) if pool_type == "lp" else data

@@ -1,0 +1,307 @@
+"""INT8 quantization op family.
+
+Role parity: reference ``src/operator/quantization/`` (quantize_v2,
+dequantize, requantize, quantized_conv/fully_connected/pooling/act/
+flatten/elemwise_add/concat/batch_norm, calibrate_entropy — ~6K LoC of
+MKL-DNN/cuDNN int8 kernels). TPU-native: int8 storage with float32 (1,)
+min/max range tensors traveling alongside, and the compute ops accumulate
+``int8 x int8 -> int32`` through ``lax.dot_general`` /
+``conv_general_dilated`` with ``preferred_element_type=int32`` — the exact
+form XLA lowers onto the MXU's int8 systolic path on TPU.
+
+Range convention (matches the reference's symmetric int8 mode and
+``mxnet_tpu/contrib/quantization.py``): int8 scale = max(|min|,|max|)/127;
+uint8 is affine over [min, max] with 255 steps. int32 accumulators carry
+the product range ±(2^31-1)*s_data*s_weight.
+"""
+from __future__ import annotations
+
+import numpy as _np
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register, get_op
+
+_INT32_MAX = float(2 ** 31 - 1)
+
+
+def _maxabs(mn, mx):
+    return jnp.maximum(jnp.abs(mn), jnp.abs(mx))
+
+
+def _r1(v, dtype=jnp.float32):
+    return jnp.asarray(v, dtype).reshape(1)
+
+
+# ------------------------------------------------------------ (de)quantize
+
+@register("_contrib_quantize_v2", aliases=("quantize_v2",), n_out=3,
+          differentiable=False)
+def quantize_v2(data, min_calib_range=None, max_calib_range=None,
+                out_type="int8"):
+    """float -> int8/uint8 with attached (1,) float range tensors."""
+    if min_calib_range is None or max_calib_range is None:
+        mn = jnp.min(data).astype(jnp.float32)
+        mx = jnp.max(data).astype(jnp.float32)
+    else:
+        mn = jnp.float32(min_calib_range)
+        mx = jnp.float32(max_calib_range)
+    if out_type == "uint8":
+        scale = (mx - mn) / 255.0
+        q = jnp.clip(jnp.round((data - mn) / scale), 0, 255).astype(jnp.uint8)
+        return q, _r1(mn), _r1(mx)
+    amax = jnp.maximum(_maxabs(mn, mx), 1e-12)
+    scale = amax / 127.0
+    q = jnp.clip(jnp.round(data / scale), -127, 127).astype(jnp.int8)
+    return q, _r1(-amax), _r1(amax)
+
+
+@register("_contrib_quantize", aliases=("quantize",), n_out=3,
+          differentiable=False)
+def quantize(data, min_range, max_range, out_type="uint8"):
+    """Like quantize_v2 but takes the range as (1,) tensors (reference
+    quantize.cc signature)."""
+    mn = jnp.asarray(min_range).reshape(()).astype(jnp.float32)
+    mx = jnp.asarray(max_range).reshape(()).astype(jnp.float32)
+    if out_type == "uint8":
+        scale = (mx - mn) / 255.0
+        q = jnp.clip(jnp.round((data - mn) / scale), 0, 255).astype(jnp.uint8)
+        return q, _r1(mn), _r1(mx)
+    amax = jnp.maximum(_maxabs(mn, mx), 1e-12)
+    q = jnp.clip(jnp.round(data / (amax / 127.0)), -127, 127).astype(jnp.int8)
+    return q, _r1(-amax), _r1(amax)
+
+
+@register("_contrib_dequantize", aliases=("dequantize",),
+          differentiable=False)
+def dequantize(data, min_range, max_range, out_type="float32"):
+    mn = jnp.asarray(min_range).reshape(()).astype(jnp.float32)
+    mx = jnp.asarray(max_range).reshape(()).astype(jnp.float32)
+    if data.dtype == jnp.uint8:
+        scale = (mx - mn) / 255.0
+        return data.astype(jnp.float32) * scale + mn
+    if data.dtype == jnp.int32:
+        scale = _maxabs(mn, mx) / _INT32_MAX
+    else:
+        scale = _maxabs(mn, mx) / 127.0
+    return data.astype(jnp.float32) * scale
+
+
+@register("_contrib_requantize", aliases=("requantize",), n_out=3,
+          differentiable=False)
+def requantize(data, min_range, max_range, min_calib_range=None,
+               max_calib_range=None):
+    """int32 accumulator -> int8, optionally narrowing to a calibrated range."""
+    real = dequantize.fn(data, min_range, max_range)
+    if min_calib_range is not None and max_calib_range is not None:
+        amax = max(abs(float(min_calib_range)), abs(float(max_calib_range)))
+        amax = jnp.float32(amax)
+    else:
+        amax = jnp.maximum(jnp.max(jnp.abs(real)), 1e-12)
+    q = jnp.clip(jnp.round(real / (amax / 127.0)), -127, 127).astype(jnp.int8)
+    return q, _r1(-amax), _r1(amax)
+
+
+# --------------------------------------------------------- int8 compute ops
+
+def _i32_ranges(min_d, max_d, min_w, max_w):
+    s = (_maxabs(jnp.asarray(min_d).reshape(()),
+                 jnp.asarray(max_d).reshape(())) / 127.0) * \
+        (_maxabs(jnp.asarray(min_w).reshape(()),
+                 jnp.asarray(max_w).reshape(())) / 127.0)
+    amax = s * _INT32_MAX
+    return s, _r1(-amax), _r1(amax)
+
+
+def _bias_to_i32(bias, min_b, max_b, s_out):
+    sb = _maxabs(jnp.asarray(min_b).reshape(()),
+                 jnp.asarray(max_b).reshape(())) / 127.0
+    return jnp.round(bias.astype(jnp.float32) * (sb / s_out)).astype(jnp.int32)
+
+
+@register("_contrib_quantized_fully_connected",
+          aliases=("quantized_fully_connected",), n_out=3,
+          differentiable=False)
+def quantized_fully_connected(data, weight, bias=None, min_data=0.0,
+                              max_data=0.0, min_weight=0.0, max_weight=0.0,
+                              min_bias=0.0, max_bias=0.0, num_hidden=0,
+                              no_bias=False, flatten=True):
+    """int8 x int8 -> int32 FC on the MXU int8 path."""
+    x = data.reshape(data.shape[0], -1) if flatten else data
+    acc = lax.dot_general(x, weight,
+                          (((x.ndim - 1,), (1,)), ((), ())),
+                          preferred_element_type=jnp.int32)
+    s_out, mn, mx = _i32_ranges(min_data, max_data, min_weight, max_weight)
+    if bias is not None and not no_bias:
+        acc = acc + _bias_to_i32(bias, min_bias, max_bias, s_out)
+    return acc, mn, mx
+
+
+@register("_contrib_quantized_conv", aliases=("quantized_conv",), n_out=3,
+          differentiable=False)
+def quantized_conv(data, weight, bias=None, min_data=0.0, max_data=0.0,
+                   min_weight=0.0, max_weight=0.0, min_bias=0.0,
+                   max_bias=0.0, kernel=(), stride=(), pad=(), dilate=(),
+                   num_filter=0, no_bias=False, layout="NCHW"):
+    """int8 conv accumulating int32 (NCHW activations, OIHW weights)."""
+    nd = data.ndim - 2
+    stride = tuple(stride) or (1,) * nd
+    pad = tuple(pad) or (0,) * nd
+    dilate = tuple(dilate) or (1,) * nd
+    dn = lax.conv_dimension_numbers(
+        data.shape, weight.shape,
+        ("NCHW", "OIHW", "NCHW") if nd == 2 else ("NCW", "OIW", "NCW"))
+    acc = lax.conv_general_dilated(
+        data.astype(jnp.int8), weight.astype(jnp.int8), stride,
+        [(p, p) for p in pad], rhs_dilation=dilate, dimension_numbers=dn,
+        preferred_element_type=jnp.int32)
+    s_out, mn, mx = _i32_ranges(min_data, max_data, min_weight, max_weight)
+    if bias is not None and not no_bias:
+        b = _bias_to_i32(bias, min_bias, max_bias, s_out)
+        acc = acc + b.reshape((1, -1) + (1,) * nd)
+    return acc, mn, mx
+
+
+@register("_contrib_quantized_pooling", aliases=("quantized_pooling",),
+          n_out=3, differentiable=False)
+def quantized_pooling(data, min_data=0.0, max_data=0.0, kernel=(),
+                      pool_type="max", stride=(), pad=(),
+                      global_pool=False, **kwargs):
+    """Pooling directly on the int8 payload — ranges pass through unchanged
+    (max) or stay valid bounds (avg)."""
+    pool = get_op("Pooling")
+    if pool_type == "avg":
+        out = pool.fn(data.astype(jnp.int32), kernel=kernel,
+                      pool_type="avg", stride=stride, pad=pad,
+                      global_pool=global_pool)
+        out = jnp.clip(jnp.round(out), -127, 127).astype(data.dtype)
+    else:
+        # the generic Pooling kernel's -inf init value has no int8 analogue;
+        # widen to int32 for the reduce-window, payload is exact either way
+        out = pool.fn(data.astype(jnp.int32), kernel=kernel,
+                      pool_type="max", stride=stride, pad=pad,
+                      global_pool=global_pool).astype(data.dtype)
+    return (out, _r1(jnp.asarray(min_data).reshape(())),
+            _r1(jnp.asarray(max_data).reshape(())))
+
+
+@register("_contrib_quantized_act", aliases=("quantized_act",), n_out=3,
+          differentiable=False)
+def quantized_act(data, min_data=0.0, max_data=0.0, act_type="relu"):
+    if act_type != "relu":
+        raise NotImplementedError(
+            "quantized_act supports relu only (reference mkldnn parity)")
+    out = jnp.maximum(data, jnp.zeros((), data.dtype))
+    return (out, _r1(jnp.asarray(min_data).reshape(())),
+            _r1(jnp.asarray(max_data).reshape(())))
+
+
+@register("_contrib_quantized_flatten", aliases=("quantized_flatten",),
+          n_out=3, differentiable=False)
+def quantized_flatten(data, min_data=0.0, max_data=0.0):
+    out = data.reshape(data.shape[0], -1)
+    return (out, _r1(jnp.asarray(min_data).reshape(())),
+            _r1(jnp.asarray(max_data).reshape(())))
+
+
+@register("_contrib_quantized_elemwise_add",
+          aliases=("quantized_elemwise_add",), n_out=3,
+          differentiable=False)
+def quantized_elemwise_add(lhs, rhs, lhs_min=0.0, lhs_max=0.0,
+                           rhs_min=0.0, rhs_max=0.0):
+    """int8 + int8 -> int32 at a shared scale: both sides are rescaled into
+    the wider of the two ranges before adding."""
+    sl = _maxabs(jnp.asarray(lhs_min).reshape(()),
+                 jnp.asarray(lhs_max).reshape(())) / 127.0
+    sr = _maxabs(jnp.asarray(rhs_min).reshape(()),
+                 jnp.asarray(rhs_max).reshape(())) / 127.0
+    # int32 payload at scale s_out/2^22 keeps 8 guard bits against overflow
+    s_out = jnp.maximum(sl, sr) / (1 << 22)
+    acc = (jnp.round(lhs.astype(jnp.float32) * (sl / s_out)).astype(jnp.int32)
+           + jnp.round(rhs.astype(jnp.float32) * (sr / s_out)).astype(
+               jnp.int32))
+    amax = s_out * _INT32_MAX
+    return acc, _r1(-amax), _r1(amax)
+
+
+@register("_contrib_quantized_concat", aliases=("quantized_concat",),
+          n_out=0, differentiable=False)
+def quantized_concat(*args, num_args=0, dim=1):
+    """Concat int8 inputs after rescaling every payload to the widest range.
+
+    Call layout mirrors the reference: ``num_args`` data tensors followed by
+    their (min, max) pairs interleaved per input.
+    """
+    n = int(num_args) or len(args) // 3
+    data, mins, maxs = args[:n], args[n::2][:n], args[n + 1::2][:n]
+    scales = [_maxabs(jnp.asarray(mn).reshape(()),
+                      jnp.asarray(mx).reshape(())) / 127.0
+              for mn, mx in zip(mins, maxs)]
+    s_out = scales[0]
+    for s in scales[1:]:
+        s_out = jnp.maximum(s_out, s)
+    parts = [jnp.clip(jnp.round(d.astype(jnp.float32) * (s / s_out)),
+                      -127, 127).astype(jnp.int8)
+             for d, s in zip(data, scales)]
+    amax = s_out * 127.0
+    return jnp.concatenate(parts, axis=dim), _r1(-amax), _r1(amax)
+
+
+@register("_contrib_quantized_batch_norm", aliases=("quantized_batch_norm",),
+          n_out=3, differentiable=False)
+def quantized_batch_norm(data, gamma, beta, moving_mean, moving_var,
+                         min_data=0.0, max_data=0.0, eps=1e-3,
+                         min_calib_range=None, max_calib_range=None,
+                         **kwargs):
+    """Inference BN folded to per-channel scale/shift in float, re-quantized
+    to int8 (reference mkldnn_quantized_batch_norm)."""
+    s_in = _maxabs(jnp.asarray(min_data).reshape(()),
+                   jnp.asarray(max_data).reshape(())) / 127.0
+    x = data.astype(jnp.float32) * s_in
+    inv = gamma / jnp.sqrt(moving_var + eps)
+    shape = (1, -1) + (1,) * (data.ndim - 2)
+    y = (x - moving_mean.reshape(shape)) * inv.reshape(shape) + \
+        beta.reshape(shape)
+    if min_calib_range is not None and max_calib_range is not None:
+        amax = jnp.float32(max(abs(float(min_calib_range)),
+                               abs(float(max_calib_range))))
+    else:
+        amax = jnp.maximum(jnp.max(jnp.abs(y)), 1e-12)
+    q = jnp.clip(jnp.round(y / (amax / 127.0)), -127, 127).astype(jnp.int8)
+    return q, _r1(-amax), _r1(amax)
+
+
+@register("_contrib_calibrate_entropy", aliases=("calibrate_entropy",),
+          n_out=2, differentiable=False)
+def calibrate_entropy(hist, hist_edges, num_quantized_bins=255):
+    """KL-divergence threshold search over an activation histogram
+    (reference calibrate.cc / the python _LayerOutputCollector path).
+    Host-side numpy: calibration is offline, never inside a jitted step."""
+    hist = _np.asarray(hist, dtype=_np.float64)
+    edges = _np.asarray(hist_edges, dtype=_np.float64)
+    num_bins = hist.size
+    centers = (edges[:-1] + edges[1:]) / 2.0
+    best_t, best_kl = float(edges[-1]), _np.inf
+    start = num_quantized_bins // 2
+    for i in range(start, num_bins + 1, 8):
+        t = centers[min(i, num_bins - 1)]
+        p = hist[:i].copy()
+        outliers = hist[i:].sum()
+        if p.size == 0 or p.sum() + outliers == 0:
+            continue
+        p[-1] += outliers
+        # quantize p into num_quantized_bins then expand back
+        factor = max(1, p.size // num_quantized_bins)
+        q = _np.zeros_like(p)
+        for j in range(0, p.size, factor):
+            chunk = p[j:j + factor]
+            nz = (chunk > 0).sum()
+            if nz:
+                q[j:j + factor] = _np.where(chunk > 0, chunk.sum() / nz, 0)
+        pm, qm = p / max(p.sum(), 1e-12), q / max(q.sum(), 1e-12)
+        mask = (pm > 0) & (qm > 0)
+        kl = float((pm[mask] * _np.log(pm[mask] / qm[mask])).sum())
+        if kl < best_kl:
+            best_kl, best_t = kl, float(abs(t))
+    return (jnp.asarray([-best_t], jnp.float32),
+            jnp.asarray([best_t], jnp.float32))

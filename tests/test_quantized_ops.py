@@ -1,0 +1,144 @@
+"""INT8 quantization op family — semantics from reference
+`src/operator/quantization/` and `tests/python/quantization/test_quantization.py`:
+quantize/dequantize round-trips, int8 compute ops carrying (1,) range
+tensors, requantize narrowing, and entropy calibration."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def _q(x):
+    """Symmetric int8 quantization oracle matching the op convention."""
+    amax = max(np.abs(x).max(), 1e-12)
+    s = amax / 127.0
+    return np.clip(np.round(x / s), -127, 127).astype(np.int8), s, amax
+
+
+def test_quantize_v2_dequantize_roundtrip():
+    x = np.random.RandomState(0).randn(4, 7).astype("float32") * 3
+    q, mn, mx_ = mx.nd.contrib.quantize_v2(mx.nd.array(x), out_type="int8")
+    assert q.asnumpy().dtype == np.int8
+    ref_q, s, amax = _q(x)
+    np.testing.assert_array_equal(q.asnumpy(), ref_q)
+    assert abs(float(mx_.asnumpy()[0]) - amax) < 1e-5
+    back = mx.nd.contrib.dequantize(q, mn, mx_)
+    np.testing.assert_allclose(back.asnumpy(), x, atol=s * 0.51)
+
+
+def test_quantize_uint8_affine():
+    x = np.random.RandomState(1).rand(3, 5).astype("float32") * 2 + 1
+    q, mn, mx_ = mx.nd.contrib.quantize_v2(mx.nd.array(x), out_type="uint8")
+    assert q.asnumpy().dtype == np.uint8
+    back = mx.nd.contrib.dequantize(q, mn, mx_).asnumpy()
+    step = (x.max() - x.min()) / 255.0
+    np.testing.assert_allclose(back, x, atol=step * 0.51 + 1e-6)
+
+
+def test_quantized_fully_connected_matches_float():
+    rng = np.random.RandomState(2)
+    x = rng.randn(4, 8).astype("float32")
+    w = rng.randn(6, 8).astype("float32")
+    b = rng.randn(6).astype("float32") * 0.1
+    qx, xmn, xmx = mx.nd.contrib.quantize_v2(mx.nd.array(x))
+    qw, wmn, wmx = mx.nd.contrib.quantize_v2(mx.nd.array(w))
+    qb, bmn, bmx = mx.nd.contrib.quantize_v2(mx.nd.array(b))
+    out, omn, omx = mx.nd.contrib.quantized_fully_connected(
+        qx, qw, qb, xmn, xmx, wmn, wmx, bmn, bmx, num_hidden=6)
+    assert out.asnumpy().dtype == np.int32
+    real = mx.nd.contrib.dequantize(out, omn, omx).asnumpy()
+    ref = x @ w.T + b
+    # int8 in both operands: ~1% relative error budget
+    assert np.abs(real - ref).max() < 0.05 * np.abs(ref).max() + 0.05
+
+
+def test_quantized_conv_matches_float():
+    rng = np.random.RandomState(3)
+    x = rng.randn(2, 3, 8, 8).astype("float32")
+    w = rng.randn(5, 3, 3, 3).astype("float32")
+    qx, xmn, xmx = mx.nd.contrib.quantize_v2(mx.nd.array(x))
+    qw, wmn, wmx = mx.nd.contrib.quantize_v2(mx.nd.array(w))
+    out, omn, omx = mx.nd.contrib.quantized_conv(
+        qx, qw, None, xmn, xmx, wmn, wmx, kernel=(3, 3), stride=(1, 1),
+        pad=(1, 1), num_filter=5, no_bias=True)
+    real = mx.nd.contrib.dequantize(out, omn, omx).asnumpy()
+    ref = mx.nd.Convolution(mx.nd.array(x), mx.nd.array(w), no_bias=True,
+                            kernel=(3, 3), pad=(1, 1), stride=(1, 1),
+                            num_filter=5).asnumpy()
+    assert np.abs(real - ref).max() < 0.05 * np.abs(ref).max() + 0.05
+
+
+def test_requantize_narrows_to_int8():
+    rng = np.random.RandomState(4)
+    x = rng.randn(4, 8).astype("float32")
+    w = rng.randn(6, 8).astype("float32")
+    qx, xmn, xmx = mx.nd.contrib.quantize_v2(mx.nd.array(x))
+    qw, wmn, wmx = mx.nd.contrib.quantize_v2(mx.nd.array(w))
+    acc, amn, amx = mx.nd.contrib.quantized_fully_connected(
+        qx, qw, None, xmn, xmx, wmn, wmx, no_bias=True, num_hidden=6)
+    q8, qmn, qmx = mx.nd.contrib.requantize(acc, amn, amx)
+    assert q8.asnumpy().dtype == np.int8
+    real = mx.nd.contrib.dequantize(q8, qmn, qmx).asnumpy()
+    ref = x @ w.T
+    assert np.abs(real - ref).max() < 0.06 * np.abs(ref).max() + 0.06
+
+
+def test_quantized_pooling_and_act_passthrough_ranges():
+    x = (np.random.RandomState(5).randn(1, 2, 4, 4) * 50).astype("int8")
+    mn, mx_ = mx.nd.array([-1.2]), mx.nd.array([1.2])
+    out, omn, omx = mx.nd.contrib.quantized_pooling(
+        mx.nd.array(x), mn, mx_, kernel=(2, 2), stride=(2, 2),
+        pool_type="max")
+    assert out.shape == (1, 2, 2, 2)
+    np.testing.assert_allclose(omx.asnumpy(), [1.2])
+    a, _, _ = mx.nd.contrib.quantized_act(out, omn, omx)
+    assert (a.asnumpy() >= 0).all()
+
+
+def test_quantized_elemwise_add_and_concat():
+    rng = np.random.RandomState(6)
+    a = rng.randn(3, 4).astype("float32")
+    b = rng.randn(3, 4).astype("float32") * 2
+    qa, amn, amx = mx.nd.contrib.quantize_v2(mx.nd.array(a))
+    qb, bmn, bmx = mx.nd.contrib.quantize_v2(mx.nd.array(b))
+    s, smn, smx = mx.nd.contrib.quantized_elemwise_add(
+        qa, qb, amn, amx, bmn, bmx)
+    real = mx.nd.contrib.dequantize(s, smn, smx).asnumpy()
+    np.testing.assert_allclose(real, a + b, atol=0.05)
+
+    c, cmn, cmx = mx.nd.contrib.quantized_concat(
+        qa, qb, amn, amx, bmn, bmx, num_args=2, dim=1)
+    assert c.shape == (3, 8)
+    real = mx.nd.contrib.dequantize(c, cmn, cmx).asnumpy()
+    np.testing.assert_allclose(real, np.concatenate([a, b], 1), atol=0.05)
+
+
+def test_quantized_batch_norm():
+    rng = np.random.RandomState(7)
+    x = rng.randn(2, 3, 4, 4).astype("float32")
+    gamma = np.abs(rng.randn(3)).astype("float32") + 0.5
+    beta = rng.randn(3).astype("float32")
+    mean = rng.randn(3).astype("float32") * 0.1
+    var = np.abs(rng.randn(3)).astype("float32") + 0.5
+    qx, xmn, xmx = mx.nd.contrib.quantize_v2(mx.nd.array(x))
+    q, qmn, qmx = mx.nd.contrib.quantized_batch_norm(
+        qx, mx.nd.array(gamma), mx.nd.array(beta), mx.nd.array(mean),
+        mx.nd.array(var), xmn, xmx, eps=1e-3)
+    real = mx.nd.contrib.dequantize(q, qmn, qmx).asnumpy()
+    sh = (1, 3, 1, 1)
+    ref = (x - mean.reshape(sh)) / np.sqrt(var.reshape(sh) + 1e-3) * \
+        gamma.reshape(sh) + beta.reshape(sh)
+    assert np.abs(real - ref).max() < 0.08 * np.abs(ref).max() + 0.08
+
+
+def test_calibrate_entropy_reasonable_threshold():
+    rng = np.random.RandomState(8)
+    acts = rng.randn(100000).astype("float32")
+    hist, edges = np.histogram(np.abs(acts), bins=512, range=(0, 8))
+    mn, mx_ = mx.nd.contrib.calibrate_entropy(
+        mx.nd.array(hist.astype("float32")), mx.nd.array(
+            edges.astype("float32")))
+    t = float(mx_.asnumpy()[0])
+    # KL threshold for a unit gaussian should clip well inside the tail
+    assert 1.0 < t < 8.0
+    assert float(mn.asnumpy()[0]) == -t
