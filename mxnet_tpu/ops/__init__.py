@@ -21,6 +21,7 @@ from . import image_ops  # noqa: F401
 from . import detection_ops  # noqa: F401  (contrib detection family)
 from . import transformer_ops  # noqa: F401  (interleaved attention matmuls)
 from . import quantized_ops  # noqa: F401  (INT8 quantization op family)
+from . import spatial_ops  # noqa: F401  (grid/sampler/STN, SVM, FFT, corr)
 from . import numpy_ops  # noqa: F401  (_npi_/_np_/_npx_ registrations;
 #                                       aliases ops above, keep last)
 

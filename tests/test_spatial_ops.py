@@ -1,0 +1,195 @@
+"""Spatial transform / signal ops — semantics from reference
+`src/operator/{grid_generator,bilinear_sampler,spatial_transformer,crop,
+svm_output,correlation}-inl.h` and `src/operator/contrib/{fft,ifft,
+count_sketch,sync_batch_norm}-inl.h`, oracles re-derived in numpy."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd as ag
+
+
+def test_grid_generator_identity_affine():
+    # identity affine [1,0,0, 0,1,0] must produce the target grid itself
+    theta = mx.nd.array(np.array([[1, 0, 0, 0, 1, 0]], "float32"))
+    grid = mx.nd.GridGenerator(theta, transform_type="affine",
+                               target_shape=(4, 5)).asnumpy()
+    assert grid.shape == (1, 2, 4, 5)
+    np.testing.assert_allclose(grid[0, 0, 0], np.linspace(-1, 1, 5),
+                               atol=1e-6)
+    np.testing.assert_allclose(grid[0, 1, :, 0], np.linspace(-1, 1, 4),
+                               atol=1e-6)
+
+
+def test_bilinear_sampler_identity_and_shift():
+    x = np.arange(2 * 1 * 4 * 4, dtype="float32").reshape(2, 1, 4, 4)
+    theta = mx.nd.array(np.tile([1, 0, 0, 0, 1, 0], (2, 1)).astype(
+        "float32"))
+    grid = mx.nd.GridGenerator(theta, transform_type="affine",
+                               target_shape=(4, 4))
+    out = mx.nd.BilinearSampler(mx.nd.array(x), grid).asnumpy()
+    np.testing.assert_allclose(out, x, atol=1e-5)
+    # half-pixel x-shift: columns interpolate between neighbours
+    shift = np.tile([1, 0, 2.0 / 3.0, 0, 1, 0], (2, 1)).astype("float32")
+    grid2 = mx.nd.GridGenerator(mx.nd.array(shift),
+                                transform_type="affine",
+                                target_shape=(4, 4))
+    out2 = mx.nd.BilinearSampler(mx.nd.array(x), grid2).asnumpy()
+    np.testing.assert_allclose(out2[:, :, :, 0], x[:, :, :, 1], atol=1e-4)
+    assert np.allclose(out2[:, :, :, 3], 0.0)  # sampled out of range -> 0
+
+
+def test_bilinear_sampler_grad_flows_to_data_and_grid():
+    x = mx.nd.array(np.random.RandomState(0).rand(1, 2, 5, 5).astype(
+        "float32"))
+    theta = mx.nd.array(np.array([[0.9, 0.1, 0.05, -0.1, 0.8, 0.0]],
+                                 "float32"))
+    x.attach_grad()
+    theta.attach_grad()
+    with ag.record():
+        grid = mx.nd.GridGenerator(theta, transform_type="affine",
+                                   target_shape=(5, 5))
+        out = mx.nd.BilinearSampler(x, grid)
+        loss = (out * out).sum()
+    loss.backward()
+    assert np.abs(x.grad.asnumpy()).sum() > 0
+    assert np.abs(theta.grad.asnumpy()).sum() > 0
+
+
+def test_spatial_transformer_matches_composition():
+    x = mx.nd.array(np.random.RandomState(1).rand(2, 3, 6, 6).astype(
+        "float32"))
+    loc = mx.nd.array(np.random.RandomState(2).randn(2, 6).astype(
+        "float32") * 0.1 + np.tile([1, 0, 0, 0, 1, 0], (2, 1)))
+    st = mx.nd.SpatialTransformer(x, loc, target_shape=(4, 4))
+    grid = mx.nd.GridGenerator(loc, transform_type="affine",
+                               target_shape=(4, 4))
+    ref = mx.nd.BilinearSampler(x, grid)
+    np.testing.assert_allclose(st.asnumpy(), ref.asnumpy(), atol=1e-6)
+
+
+def test_grid_generator_warp_zero_flow_is_identity_grid():
+    flow = mx.nd.zeros((1, 2, 3, 4))
+    grid = mx.nd.GridGenerator(flow, transform_type="warp").asnumpy()
+    np.testing.assert_allclose(grid[0, 0, 0], np.linspace(-1, 1, 4),
+                               atol=1e-6)
+    np.testing.assert_allclose(grid[0, 1, :, 0], np.linspace(-1, 1, 3),
+                               atol=1e-6)
+
+
+def test_crop():
+    x = mx.nd.array(np.arange(1 * 1 * 6 * 6, dtype="float32").reshape(
+        1, 1, 6, 6))
+    out = mx.nd.Crop(x, h_w=(4, 4), center_crop=True).asnumpy()
+    np.testing.assert_array_equal(out, x.asnumpy()[:, :, 1:5, 1:5])
+    like = mx.nd.zeros((1, 1, 2, 3))
+    out2 = mx.nd.Crop(x, like, offset=(1, 2), num_args=2).asnumpy()
+    np.testing.assert_array_equal(out2, x.asnumpy()[:, :, 1:3, 2:5])
+
+
+def test_svm_output_hinge_grad():
+    z = np.array([[2.0, -0.5, 0.2], [-1.5, 0.3, 0.8]], "float32")
+    label = np.array([0, 2], "float32")
+    d = mx.nd.array(z)
+    d.attach_grad()
+    with ag.record():
+        out = mx.nd.SVMOutput(d, mx.nd.array(label), margin=1.0,
+                              regularization_coefficient=0.5,
+                              use_linear=True)
+    out.backward()
+    np.testing.assert_allclose(out.asnumpy(), z)
+    g = d.grad.asnumpy()
+    # sample 0, true class z=2.0 > margin -> no pull; class 1 z=-0.5,
+    # margin > 0.5 -> push down; class 2 z=0.2, margin > -0.2 -> push
+    np.testing.assert_allclose(g[0], [0.0, 0.5, 0.5])
+    # sample 1, true class 2: z=0.8 < margin -> pull up (-C)
+    np.testing.assert_allclose(g[1], [0.0, 0.5, -0.5])
+
+
+def test_correlation_matches_numpy_oracle():
+    rng = np.random.RandomState(3)
+    x1 = rng.randn(2, 4, 8, 8).astype("float32")
+    x2 = rng.randn(2, 4, 8, 8).astype("float32")
+    md, pad = 2, 2
+    out = mx.nd.Correlation(mx.nd.array(x1), mx.nd.array(x2), kernel_size=1,
+                            max_displacement=md, stride1=1, stride2=1,
+                            pad_size=pad).asnumpy()
+    D = 2 * md + 1
+    assert out.shape == (2, D * D, 8, 8)
+    p1 = np.pad(x1, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    p2 = np.pad(x2, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    border = md
+    ref = np.zeros_like(out)
+    for q, (dy, dx) in enumerate((dy, dx) for dy in range(-md, md + 1)
+                                 for dx in range(-md, md + 1)):
+        for i in range(8):
+            for j in range(8):
+                y, x = border + i, border + j
+                ref[:, q, i, j] = (p1[:, :, y, x] *
+                                   p2[:, :, y + dy, x + dx]).mean(axis=1)
+    np.testing.assert_allclose(out, ref, atol=1e-5)
+
+
+def test_fft_ifft_roundtrip_unnormalized():
+    rng = np.random.RandomState(4)
+    x = rng.randn(3, 8).astype("float32")
+    F = mx.nd.contrib.fft(mx.nd.array(x))
+    assert F.shape == (3, 16)
+    spec = np.fft.fft(x, axis=-1)
+    np.testing.assert_allclose(F.asnumpy()[:, 0::2], spec.real, atol=1e-4)
+    np.testing.assert_allclose(F.asnumpy()[:, 1::2], spec.imag, atol=1e-4)
+    back = mx.nd.contrib.ifft(F).asnumpy()
+    np.testing.assert_allclose(back, x * 8, atol=1e-3)  # cuFFT-style scale
+
+
+def test_count_sketch_scatter():
+    data = np.array([[1.0, 2.0, 3.0, 4.0]], "float32")
+    h = np.array([[0, 1, 1, 2]], "float32")
+    s = np.array([[1, -1, 1, 1]], "float32")
+    out = mx.nd.contrib.count_sketch(mx.nd.array(data), mx.nd.array(h),
+                                     mx.nd.array(s), out_dim=3).asnumpy()
+    np.testing.assert_allclose(out, [[1.0, 1.0, 4.0]])
+
+
+def test_sync_batch_norm_single_device_matches_bn():
+    rng = np.random.RandomState(5)
+    x = rng.randn(4, 3, 5, 5).astype("float32")
+    gamma = np.ones(3, "float32")
+    beta = np.zeros(3, "float32")
+    (out,) = mx.nd.contrib.SyncBatchNorm(
+        mx.nd.array(x), mx.nd.array(gamma), mx.nd.array(beta),
+        mx.nd.zeros((3,)), mx.nd.ones((3,)), eps=1e-3)
+    mean = x.mean(axis=(0, 2, 3), keepdims=True)
+    var = x.var(axis=(0, 2, 3), keepdims=True)
+    ref = (x - mean) / np.sqrt(var + 1e-3)
+    np.testing.assert_allclose(out.asnumpy(), ref, atol=1e-4)
+
+
+def test_sync_batch_norm_syncs_across_mesh_axis():
+    """Under shard_map over a dp axis the stats must be global: outputs for
+    identical global data must match single-device BN regardless of the
+    per-device split."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+    from mxnet_tpu.ops.spatial_ops import SyncBatchNorm
+
+    devs = jax.devices()[:4]
+    mesh = Mesh(np.array(devs), ("dp",))
+    rng = np.random.RandomState(6)
+    x = jnp.asarray(rng.randn(8, 3, 4, 4).astype("float32"))
+    gamma, beta = jnp.ones(3), jnp.zeros(3)
+    mm, mv = jnp.zeros(3), jnp.ones(3)
+
+    def f(xs):
+        (o,) = SyncBatchNorm.fn(xs, gamma, beta, mm, mv, eps=1e-3,
+                                comm_axis="dp")
+        return o
+
+    out = shard_map(f, mesh=mesh, in_specs=P("dp"), out_specs=P("dp"))(x)
+    xn = np.asarray(x)
+    mean = xn.mean(axis=(0, 2, 3), keepdims=True)
+    var = xn.var(axis=(0, 2, 3), keepdims=True)
+    ref = (xn - mean) / np.sqrt(var + 1e-3)
+    np.testing.assert_allclose(np.asarray(out), ref, atol=1e-4)
