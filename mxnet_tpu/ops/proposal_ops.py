@@ -1,0 +1,410 @@
+"""Region-proposal / SSD-training / deformable op family.
+
+Role parity: reference ``src/operator/contrib/multibox_target``/
+``multibox_detection`` (SSD anchor matching + decoding, -inl.h kernels),
+``contrib/proposal``/``multi_proposal`` (Faster-RCNN RPN proposal
+generation), ``contrib/psroi_pooling``, ``contrib/deformable_convolution``
+(+ ``nn/deformable_im2col``), ``contrib/deformable_psroi_pooling``, and
+``contrib/rroi_align``.
+
+TPU-first notes: everything is static-shape — proposal top-k counts are
+compile-time constants, suppressed entries are masked (-1 / zero rows)
+rather than compacted, and the greedy NMS is the fori_loop kernel shared
+with ``box_nms``. Deformable sampling is expressed as K*K bilinear gathers
++ 1x1 matmuls so the FLOPs still land on the MXU.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .detection_ops import _iou_corner, box_nms, roi_align
+from .spatial_ops import _sample_one
+from .registry import register
+
+__all__ = ["MultiBoxTarget", "MultiBoxDetection", "Proposal",
+           "MultiProposal", "PSROIPooling", "DeformableConvolution",
+           "DeformablePSROIPooling", "RROIAlign"]
+
+
+def _corners_to_center(b):
+    w = b[..., 2] - b[..., 0]
+    h = b[..., 3] - b[..., 1]
+    return (b[..., 0] + w / 2, b[..., 1] + h / 2, w, h)
+
+
+# ------------------------------------------------------------- SSD training
+
+@register("_contrib_MultiBoxTarget", aliases=("MultiBoxTarget",), n_out=3)
+def MultiBoxTarget(anchor, label, cls_pred, overlap_threshold=0.5,
+                   ignore_label=-1.0, negative_mining_ratio=-1.0,
+                   negative_mining_thresh=0.5, minimum_negative_samples=0,
+                   variances=(0.1, 0.1, 0.2, 0.2)):
+    """SSD target assignment (reference contrib/multibox_target-inl.h).
+
+    anchor (1, N, 4 corner), label (B, M, 5) rows [cls, x1, y1, x2, y2]
+    (padded rows cls = -1), cls_pred (B, num_cls+1, N). Returns
+    (box_target (B, N*4), box_mask (B, N*4), cls_target (B, N)).
+    Matching = per-gt best anchor (bipartite stage) union anchors whose best
+    IoU clears ``overlap_threshold``; optional hard-negative mining keeps
+    ``negative_mining_ratio`` negatives per positive ranked by max
+    non-background confidence.
+    """
+    A = anchor.reshape(-1, 4)
+    N = A.shape[0]
+    acx, acy, aw, ah = _corners_to_center(A)
+    v0, v1, v2, v3 = (float(v) for v in variances)
+
+    def one(lab, pred):
+        gt_valid = lab[:, 0] >= 0
+        gt_boxes = lab[:, 1:5]
+        iou = _iou_corner(A, gt_boxes)                     # (N, M)
+        iou = jnp.where(gt_valid[None, :], iou, -1.0)
+        best_gt = jnp.argmax(iou, axis=1)                  # per anchor
+        best_iou = jnp.max(iou, axis=1)
+        matched = best_iou > overlap_threshold
+        # bipartite stage: each valid gt claims its best anchor
+        best_anchor = jnp.argmax(iou, axis=0)              # (M,)
+        forced = jnp.zeros((N,), bool)
+        forced_gt = best_gt
+        M = lab.shape[0]
+        for m in range(M):  # static, M is the (small) label pad length
+            a_m = best_anchor[m]
+            take = gt_valid[m]
+            forced = forced.at[a_m].set(forced[a_m] | take)
+            forced_gt = forced_gt.at[a_m].set(
+                jnp.where(take, m, forced_gt[a_m]))
+        matched = matched | forced
+        match_id = jnp.where(forced, forced_gt, best_gt)
+
+        g = gt_boxes[match_id]
+        gcx, gcy, gw, gh = _corners_to_center(g)
+        eps = 1e-8
+        t = jnp.stack([(gcx - acx) / (aw + eps) / v0,
+                       (gcy - acy) / (ah + eps) / v1,
+                       jnp.log(jnp.maximum(gw / (aw + eps), eps)) / v2,
+                       jnp.log(jnp.maximum(gh / (ah + eps), eps)) / v3],
+                      axis=-1)
+        box_target = jnp.where(matched[:, None], t, 0.0).reshape(-1)
+        box_mask = jnp.where(matched[:, None],
+                             jnp.ones_like(t), 0.0).reshape(-1)
+        cls = jnp.where(matched, lab[match_id, 0] + 1.0, 0.0)
+        if negative_mining_ratio > 0:
+            neg_conf = jnp.max(pred[1:], axis=0)           # (N,)
+            neg_score = jnp.where(matched, -jnp.inf,
+                                  jnp.where(neg_conf > negative_mining_thresh,
+                                            neg_conf, -jnp.inf))
+            order = jnp.argsort(-neg_score)
+            rank = jnp.zeros((N,), jnp.int32).at[order].set(
+                jnp.arange(N, dtype=jnp.int32))
+            num_pos = jnp.sum(matched)
+            num_neg = jnp.maximum(
+                (negative_mining_ratio * num_pos).astype(jnp.int32),
+                minimum_negative_samples)
+            keep_neg = (rank < num_neg) & jnp.isfinite(neg_score)
+            cls = jnp.where(matched, cls,
+                            jnp.where(keep_neg, 0.0, float(ignore_label)))
+        return box_target, box_mask, cls
+
+    bt, bm, ct = jax.vmap(one)(label, cls_pred)
+    return bt, bm, ct
+
+
+@register("_contrib_MultiBoxDetection", aliases=("MultiBoxDetection",))
+def MultiBoxDetection(cls_prob, loc_pred, anchor, clip=True, threshold=0.01,
+                      background_id=0, nms_threshold=0.5,
+                      force_suppress=False,
+                      variances=(0.1, 0.1, 0.2, 0.2), nms_topk=-1):
+    """SSD decode + per-class NMS (reference contrib/multibox_detection).
+
+    cls_prob (B, num_cls+1, N), loc_pred (B, N*4), anchor (1, N, 4).
+    Returns (B, N, 6) rows [cls_id, score, x1, y1, x2, y2], -1 = suppressed.
+    """
+    B, _, N = cls_prob.shape
+    A = anchor.reshape(-1, 4)
+    acx, acy, aw, ah = _corners_to_center(A)
+    v0, v1, v2, v3 = (float(v) for v in variances)
+    winner = jnp.argmax(cls_prob, axis=1)                    # (B, N)
+    score = jnp.max(cls_prob, axis=1)
+    # output ids are foreground-indexed: background wins -> invalid row
+    cls_id = (winner - (winner > background_id)).astype(cls_prob.dtype)
+    score = jnp.where(winner == background_id, -1.0, score)
+    p = loc_pred.reshape(B, N, 4)
+    cx = p[..., 0] * v0 * aw + acx
+    cy = p[..., 1] * v1 * ah + acy
+    w = jnp.exp(p[..., 2] * v2) * aw
+    h = jnp.exp(p[..., 3] * v3) * ah
+    boxes = jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2], -1)
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    det = jnp.concatenate([cls_id[..., None], score[..., None], boxes], -1)
+    det = jnp.where(score[..., None] > threshold, det, -1.0)
+    return box_nms.fn(det, overlap_thresh=nms_threshold,
+                      valid_thresh=threshold, topk=nms_topk, coord_start=2,
+                      score_index=1, id_index=0, background_id=-1,
+                      force_suppress=force_suppress)
+
+
+# ----------------------------------------------------------------- RPN ops
+
+def _gen_base_anchors(base_size, ratios, scales, dtype):
+    """Faster-RCNN anchor enumeration, ratio-major then scale (reference
+    contrib/proposal-inl.h GenerateAnchors)."""
+    out = []
+    cx = cy = (base_size - 1) / 2.0
+    area = float(base_size * base_size)
+    for r in ratios:
+        ws = round((area / r) ** 0.5)
+        hs = round(ws * r)
+        for s in scales:
+            w, h = ws * s, hs * s
+            out.append([cx - (w - 1) / 2, cy - (h - 1) / 2,
+                        cx + (w - 1) / 2, cy + (h - 1) / 2])
+    return jnp.asarray(out, dtype)
+
+
+def _proposal_one(score, deltas, im_info, base, feature_stride,
+                  pre_nms, post_nms, nms_thresh, min_size):
+    """score (A, H, W) foreground probs; deltas (A*4, H, W); returns
+    (post_nms, 4) boxes + (post_nms,) scores (zero rows when suppressed)."""
+    An, H, W = score.shape
+    dt = score.dtype
+    sy = jnp.arange(H, dtype=dt) * feature_stride
+    sx = jnp.arange(W, dtype=dt) * feature_stride
+    shift = jnp.stack(jnp.broadcast_arrays(
+        sx[None, :], sy[:, None], sx[None, :], sy[:, None]), -1)  # (H, W, 4)
+    anchors = base[:, None, None, :] + shift[None]               # (A, H, W, 4)
+    acx, acy, aw, ah = _corners_to_center(anchors)
+    d = deltas.reshape(An, 4, H, W)
+    cx = d[:, 0] * aw + acx
+    cy = d[:, 1] * ah + acy
+    w = jnp.exp(d[:, 2]) * aw
+    h = jnp.exp(d[:, 3]) * ah
+    x1 = jnp.clip(cx - (w - 1) / 2, 0, im_info[1] - 1)
+    y1 = jnp.clip(cy - (h - 1) / 2, 0, im_info[0] - 1)
+    x2 = jnp.clip(cx + (w - 1) / 2, 0, im_info[1] - 1)
+    y2 = jnp.clip(cy + (h - 1) / 2, 0, im_info[0] - 1)
+    ms = min_size * im_info[2]
+    ok = ((x2 - x1 + 1) >= ms) & ((y2 - y1 + 1) >= ms)
+    flat_s = jnp.where(ok, score, -jnp.inf).reshape(-1)
+    flat_b = jnp.stack([x1, y1, x2, y2], -1).reshape(-1, 4)
+    k1 = min(pre_nms, flat_s.shape[0])
+    top_s, idx = lax.top_k(flat_s, k1)
+    top_b = flat_b[idx]
+    det = jnp.concatenate([jnp.zeros((k1, 1), dt), top_s[:, None], top_b],
+                          -1)
+    det = jnp.where(jnp.isfinite(top_s)[:, None], det, -1.0)
+    kept = box_nms.fn(det[None], overlap_thresh=nms_thresh,
+                      valid_thresh=-1e30, topk=-1, coord_start=2,
+                      score_index=1, id_index=-1)[0]
+    ks = jnp.where(kept[:, 1] > -1, kept[:, 1], -jnp.inf)
+    k2 = min(post_nms, k1)
+    fin_s, fidx = lax.top_k(ks, k2)
+    fin_b = kept[fidx, 2:6]
+    good = jnp.isfinite(fin_s)
+    return (jnp.where(good[:, None], fin_b, 0.0),
+            jnp.where(good, fin_s, 0.0))
+
+
+@register("_contrib_Proposal", aliases=("Proposal",), n_out=0)
+def Proposal(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=6000,
+             rpn_post_nms_top_n=300, threshold=0.7, rpn_min_size=16,
+             scales=(4, 8, 16, 32), ratios=(0.5, 1, 2), feature_stride=16,
+             output_score=False, iou_loss=False):
+    """RPN proposal generation, batch 1 (reference contrib/proposal.cc).
+    Returns rois (post_nms, 5) [0, x1, y1, x2, y2] (+ scores (post_nms, 1))."""
+    if iou_loss:
+        raise NotImplementedError("iou_loss decoding is not supported")
+    Anum = len(scales) * len(ratios)
+    base = _gen_base_anchors(feature_stride, ratios, scales, cls_prob.dtype)
+    boxes, scores = _proposal_one(
+        cls_prob[0, Anum:], bbox_pred[0], im_info[0], base,
+        float(feature_stride), int(rpn_pre_nms_top_n),
+        int(rpn_post_nms_top_n), float(threshold), float(rpn_min_size))
+    rois = jnp.concatenate([jnp.zeros((boxes.shape[0], 1), boxes.dtype),
+                            boxes], -1)
+    if output_score:
+        return rois, scores[:, None]
+    return (rois,)
+
+
+@register("_contrib_MultiProposal", aliases=("MultiProposal",), n_out=0)
+def MultiProposal(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=6000,
+                  rpn_post_nms_top_n=300, threshold=0.7, rpn_min_size=16,
+                  scales=(4, 8, 16, 32), ratios=(0.5, 1, 2),
+                  feature_stride=16, output_score=False, iou_loss=False):
+    """Batched Proposal (reference contrib/multi_proposal.cc): rois
+    (B*post_nms, 5) with the batch index in column 0."""
+    if iou_loss:
+        raise NotImplementedError("iou_loss decoding is not supported")
+    B = cls_prob.shape[0]
+    Anum = len(scales) * len(ratios)
+    base = _gen_base_anchors(feature_stride, ratios, scales, cls_prob.dtype)
+
+    def one(score, deltas, info):
+        return _proposal_one(score, deltas, info, base,
+                             float(feature_stride), int(rpn_pre_nms_top_n),
+                             int(rpn_post_nms_top_n), float(threshold),
+                             float(rpn_min_size))
+
+    boxes, scores = jax.vmap(one)(cls_prob[:, Anum:], bbox_pred, im_info)
+    n = boxes.shape[1]
+    bidx = jnp.repeat(jnp.arange(B, dtype=boxes.dtype), n)[:, None]
+    rois = jnp.concatenate([bidx, boxes.reshape(B * n, 4)], -1)
+    if output_score:
+        return rois, scores.reshape(B * n, 1)
+    return (rois,)
+
+
+# --------------------------------------------------- PS / deformable pooling
+
+@register("_contrib_PSROIPooling", aliases=("PSROIPooling",))
+def PSROIPooling(data, rois, spatial_scale=1.0, output_dim=0, pooled_size=7,
+                 group_size=0):
+    """Position-sensitive ROI pooling (reference contrib/psroi_pooling).
+    Deviation: bins are sampled with the ROIAlign bilinear 2x2 grid instead
+    of integer-bin averaging — static shapes, and strictly more accurate."""
+    g = int(group_size) or int(pooled_size)
+    return roi_align.fn(data, rois, pooled_size=(g, g),
+                        spatial_scale=float(spatial_scale), sample_ratio=2,
+                        position_sensitive=True)
+
+
+@register("_contrib_RROIAlign", aliases=("RROIAlign",))
+def RROIAlign(data, rois, pooled_size=(7, 7), spatial_scale=1.0,
+              sampling_ratio=2):
+    """Rotated ROIAlign (reference contrib/rroi_align.cc): rois (R, 6) =
+    [batch_idx, cx, cy, w, h, theta_degrees]; the bin grid is rotated by
+    theta about the ROI center before bilinear sampling."""
+    ph, pw = (pooled_size if isinstance(pooled_size, (tuple, list))
+              else (pooled_size, pooled_size))
+    sr = max(int(sampling_ratio), 1)
+
+    def one(roi):
+        bidx = roi[0].astype(jnp.int32)
+        cx = roi[1] * spatial_scale
+        cy = roi[2] * spatial_scale
+        w = jnp.maximum(roi[3] * spatial_scale, 1e-3)
+        h = jnp.maximum(roi[4] * spatial_scale, 1e-3)
+        th = roi[5] * (jnp.pi / 180.0)
+        yy = (jnp.arange(ph * sr, dtype=data.dtype) + 0.5) / (ph * sr) - 0.5
+        xx = (jnp.arange(pw * sr, dtype=data.dtype) + 0.5) / (pw * sr) - 0.5
+        gy, gx = jnp.meshgrid(yy * h, xx * w, indexing="ij")
+        ct, st = jnp.cos(th), jnp.sin(th)
+        sx = cx + gx * ct - gy * st
+        sy = cy + gx * st + gy * ct
+        val = _sample_one(data[bidx], sx, sy)       # (C, ph*sr, pw*sr)
+        C = val.shape[0]
+        return val.reshape(C, ph, sr, pw, sr).mean(axis=(2, 4))
+
+    return jax.vmap(one)(rois)
+
+
+@register("_contrib_DeformablePSROIPooling",
+          aliases=("DeformablePSROIPooling",), n_out=0)
+def DeformablePSROIPooling(data, rois, trans=None, spatial_scale=1.0,
+                           output_dim=0, group_size=1, pooled_size=7,
+                           part_size=0, sample_per_part=2, trans_std=0.1,
+                           no_trans=False):
+    """Deformable position-sensitive ROI pooling (reference
+    contrib/deformable_psroi_pooling.cc): each output bin's sampling window
+    is shifted by a learned normalized offset ``trans`` (R, 2*cls, p, p)
+    scaled by ``trans_std`` and the ROI extent."""
+    p = int(pooled_size)
+    g = int(group_size) or p
+    sr = max(int(sample_per_part), 1)
+    C = data.shape[1]
+    cdim = C // (g * g)
+
+    def one(roi, tr):
+        bidx = roi[0].astype(jnp.int32)
+        x1 = roi[1] * spatial_scale
+        y1 = roi[2] * spatial_scale
+        w = jnp.maximum(roi[3] * spatial_scale - x1, 0.1)
+        h = jnp.maximum(roi[4] * spatial_scale - y1, 0.1)
+        bw, bh = w / p, h / p
+        iy = jnp.arange(p, dtype=data.dtype)
+        ix = jnp.arange(p, dtype=data.dtype)
+        if tr is None:
+            offy = jnp.zeros((p, p), data.dtype)
+            offx = jnp.zeros((p, p), data.dtype)
+        else:
+            # class-agnostic offsets (cls dim 0), resized p <= part_size
+            pt = tr.shape[-1]
+            yi = jnp.clip((iy * pt / p).astype(jnp.int32), 0, pt - 1)
+            xi = jnp.clip((ix * pt / p).astype(jnp.int32), 0, pt - 1)
+            offx = tr[0][yi[:, None], xi[None, :]] * trans_std * w
+            offy = tr[1][yi[:, None], xi[None, :]] * trans_std * h
+        sy = (jnp.arange(sr, dtype=data.dtype) + 0.5) / sr
+        sx = (jnp.arange(sr, dtype=data.dtype) + 0.5) / sr
+        ys = y1 + (iy[:, None, None, None] + sy[None, None, :, None]) * bh \
+            + offy[:, :, None, None]                      # (p, p, sr, 1)
+        xs = x1 + (ix[None, :, None, None] + sx[None, None, None, :]) * bw \
+            + offx[:, :, None, None]                      # (p, p, 1, sr)
+        ys = jnp.broadcast_to(ys, (p, p, sr, sr)).reshape(p, p * sr * sr)
+        xs = jnp.broadcast_to(xs, (p, p, sr, sr)).reshape(p, p * sr * sr)
+        val = _sample_one(data[bidx], xs, ys)             # (C, p, p*sr*sr)
+        val = val.reshape(C, p, p, sr * sr).mean(-1)      # (C, p, p)
+        grp = val.reshape(cdim, g * g, p, p)
+        bin_idx = (jnp.arange(p)[:, None] % g) * g + (jnp.arange(p)[None, :]
+                                                      % g)
+        sel = jnp.take_along_axis(
+            grp, bin_idx[None, None].repeat(cdim, 0), axis=1)[:, 0]
+        return sel
+
+    if no_trans or trans is None:
+        out = jax.vmap(lambda r: one(r, None))(rois)
+    else:
+        out = jax.vmap(one)(rois, trans)
+    return (out,)
+
+
+# ------------------------------------------------- deformable convolution
+
+@register("_contrib_DeformableConvolution",
+          aliases=("DeformableConvolution",))
+def DeformableConvolution(data, offset, weight, bias=None, kernel=(3, 3),
+                          stride=(1, 1), pad=(0, 0), dilate=(1, 1),
+                          num_filter=0, num_group=1, num_deformable_group=1,
+                          no_bias=False, **_ignored):
+    """Deformable conv v1 (reference contrib/deformable_convolution.cc):
+    every kernel tap samples the input at a learned fractional offset.
+
+    Expressed TPU-style as K*K bilinear gathers (piecewise-linear in the
+    offsets, so JAX autodiff reproduces the reference's offset gradients)
+    followed by one (C*K*K) x O matmul on the MXU.
+    """
+    B, C, H, W = data.shape
+    O = weight.shape[0]
+    KH, KW = int(kernel[0]), int(kernel[1])
+    sh, sw = int(stride[0]), int(stride[1])
+    ph_, pw_ = int(pad[0]), int(pad[1])
+    dh, dw = int(dilate[0]), int(dilate[1])
+    dg = int(num_deformable_group)
+    if num_group != 1:
+        raise NotImplementedError("num_group > 1 not supported")
+    Ho = (H + 2 * ph_ - (dh * (KH - 1) + 1)) // sh + 1
+    Wo = (W + 2 * pw_ - (dw * (KW - 1) + 1)) // sw + 1
+    off = offset.reshape(B, dg, KH * KW, 2, Ho, Wo)
+    gy = jnp.arange(Ho, dtype=data.dtype) * sh - ph_
+    gx = jnp.arange(Wo, dtype=data.dtype) * sw - pw_
+    base_y, base_x = jnp.meshgrid(gy, gx, indexing="ij")
+    cols = []
+    cg = C // dg
+    for ky in range(KH):
+        for kx in range(KW):
+            tap = ky * KW + kx
+            parts = []
+            for g in range(dg):
+                ys = base_y + ky * dh + off[:, g, tap, 0]
+                xs = base_x + kx * dw + off[:, g, tap, 1]
+                sub = data[:, g * cg:(g + 1) * cg]
+                parts.append(jax.vmap(_sample_one)(sub, xs, ys))
+            cols.append(jnp.concatenate(parts, axis=1))   # (B, C, Ho, Wo)
+    col = jnp.stack(cols, axis=2)                         # (B, C, K*K, Ho, Wo)
+    wmat = weight.reshape(O, C, KH * KW)
+    out = jnp.einsum("bckhw,ock->bohw", col, wmat)
+    if bias is not None and not no_bias:
+        out = out + bias.reshape(1, -1, 1, 1)
+    return out

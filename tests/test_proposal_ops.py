@@ -1,0 +1,200 @@
+"""RPN/SSD/deformable op family — semantics from reference
+`src/operator/contrib/{multibox_target,multibox_detection,proposal,
+multi_proposal,psroi_pooling,deformable_convolution,rroi_align}` and the
+cases in `tests/python/unittest/test_operator.py` (test_multibox_*,
+test_deformable_convolution)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd as ag
+
+
+def test_multibox_target_matches_obvious_assignment():
+    # two anchors, one gt that overlaps anchor 0 heavily
+    anchors = np.array([[[0.1, 0.1, 0.5, 0.5], [0.6, 0.6, 0.9, 0.9]]],
+                       "float32")
+    label = np.array([[[2.0, 0.1, 0.1, 0.5, 0.5],
+                       [-1.0, 0, 0, 0, 0]]], "float32")  # one padded row
+    cls_pred = np.zeros((1, 4, 2), "float32")
+    bt, bm, ct = mx.nd.contrib.MultiBoxTarget(
+        mx.nd.array(anchors), mx.nd.array(label), mx.nd.array(cls_pred))
+    ct = ct.asnumpy()
+    assert ct[0, 0] == 3.0  # gt class 2 -> target 3 (background shifted)
+    assert ct[0, 1] == 0.0  # unmatched -> background
+    bm = bm.asnumpy().reshape(1, 2, 4)
+    assert bm[0, 0].sum() == 4 and bm[0, 1].sum() == 0
+    # perfectly-aligned anchor: offsets must be ~0
+    bt = bt.asnumpy().reshape(1, 2, 4)
+    np.testing.assert_allclose(bt[0, 0], 0.0, atol=1e-5)
+
+
+def test_multibox_target_bipartite_forces_low_iou_match():
+    # gt overlaps neither anchor above threshold; bipartite stage must still
+    # claim the best anchor
+    anchors = np.array([[[0.0, 0.0, 0.2, 0.2], [0.5, 0.5, 0.7, 0.7]]],
+                       "float32")
+    label = np.array([[[0.0, 0.45, 0.45, 0.65, 0.65]]], "float32")
+    cls_pred = np.zeros((1, 2, 2), "float32")
+    _, _, ct = mx.nd.contrib.MultiBoxTarget(
+        mx.nd.array(anchors), mx.nd.array(label), mx.nd.array(cls_pred),
+        overlap_threshold=0.9)
+    np.testing.assert_array_equal(ct.asnumpy(), [[0.0, 1.0]])
+
+
+def test_multibox_target_negative_mining():
+    anchors = np.tile(np.array([[0.1, 0.1, 0.5, 0.5]], "float32"),
+                      (6, 1))[None]
+    anchors[0, 0] = [0.1, 0.1, 0.5, 0.5]
+    anchors[0, 1:] = np.array([[0.6, 0.6, 0.9, 0.9]] * 5)
+    label = np.array([[[1.0, 0.1, 0.1, 0.5, 0.5]]], "float32")
+    cls_pred = np.zeros((1, 3, 6), "float32")
+    cls_pred[0, 1, 2] = 5.0  # one confidently-wrong negative
+    _, _, ct = mx.nd.contrib.MultiBoxTarget(
+        mx.nd.array(anchors), mx.nd.array(label), mx.nd.array(cls_pred),
+        negative_mining_ratio=1.0, negative_mining_thresh=0.1,
+        ignore_label=-1.0)
+    ct = ct.asnumpy()[0]
+    assert ct[0] == 2.0           # positive
+    assert ct[2] == 0.0           # mined hard negative stays background
+    assert (ct[3:] == -1.0).all()  # the rest ignored
+
+
+def test_multibox_detection_decodes_and_nms():
+    anchors = np.array([[[0.1, 0.1, 0.5, 0.5], [0.12, 0.12, 0.52, 0.52],
+                         [0.6, 0.6, 0.9, 0.9]]], "float32")
+    cls_prob = np.zeros((1, 3, 3), "float32")
+    cls_prob[0, :, 0] = [0.1, 0.8, 0.1]   # class 0
+    cls_prob[0, :, 1] = [0.2, 0.7, 0.1]   # class 0, overlapping -> suppressed
+    cls_prob[0, :, 2] = [0.1, 0.2, 0.7]   # class 1
+    loc = np.zeros((1, 12), "float32")
+    out = mx.nd.contrib.MultiBoxDetection(
+        mx.nd.array(cls_prob), mx.nd.array(loc), mx.nd.array(anchors),
+        nms_threshold=0.5).asnumpy()[0]
+    kept = out[out[:, 0] >= 0]
+    assert kept.shape[0] == 2
+    ids = sorted(kept[:, 0].tolist())
+    assert ids == [0.0, 1.0]
+    best = kept[np.argmax(kept[:, 1])]
+    np.testing.assert_allclose(best[2:6], [0.1, 0.1, 0.5, 0.5], atol=1e-5)
+
+
+def test_proposal_shapes_and_clipping():
+    rng = np.random.RandomState(0)
+    A = 3 * 4  # ratios x scales
+    H = W = 4
+    cls = rng.rand(1, 2 * A, H, W).astype("float32")
+    bbox = (rng.randn(1, 4 * A, H, W) * 0.1).astype("float32")
+    im_info = np.array([[64.0, 64.0, 1.0]], "float32")
+    (rois,) = mx.nd.contrib.Proposal(
+        mx.nd.array(cls), mx.nd.array(bbox), mx.nd.array(im_info),
+        rpn_pre_nms_top_n=50, rpn_post_nms_top_n=10, feature_stride=16)
+    r = rois.asnumpy()
+    assert r.shape == (10, 5)
+    assert (r[:, 0] == 0).all()
+    assert (r[:, 1:] >= 0).all() and (r[:, 1:] <= 63).all()
+    live = r[r[:, 3] > r[:, 1]]
+    assert live.shape[0] >= 1
+
+
+def test_multi_proposal_batch_indices():
+    rng = np.random.RandomState(1)
+    A = 12
+    cls = rng.rand(2, 2 * A, 3, 3).astype("float32")
+    bbox = (rng.randn(2, 4 * A, 3, 3) * 0.1).astype("float32")
+    im_info = np.tile([48.0, 48.0, 1.0], (2, 1)).astype("float32")
+    rois, scores = mx.nd.contrib.MultiProposal(
+        mx.nd.array(cls), mx.nd.array(bbox), mx.nd.array(im_info),
+        rpn_pre_nms_top_n=40, rpn_post_nms_top_n=8, output_score=True)
+    r = rois.asnumpy()
+    assert r.shape == (16, 5) and scores.shape == (16, 1)
+    assert (r[:8, 0] == 0).all() and (r[8:, 0] == 1).all()
+
+
+def test_psroi_pooling_selects_bin_channels():
+    # data where channel value == its bin index: output bin (i,j) must read
+    # from channel group i*g+j
+    g, cdim = 2, 3
+    C = cdim * g * g
+    data = np.zeros((1, C, 8, 8), "float32")
+    for c in range(C):
+        data[0, c] = c % (g * g)
+    rois = np.array([[0, 0, 0, 7, 7]], "float32")
+    out = mx.nd.contrib.PSROIPooling(
+        mx.nd.array(data), mx.nd.array(rois), spatial_scale=1.0,
+        output_dim=cdim, pooled_size=g).asnumpy()
+    assert out.shape == (1, cdim, g, g)
+    for i in range(g):
+        for j in range(g):
+            np.testing.assert_allclose(out[0, :, i, j], i * g + j,
+                                       atol=1e-5)
+
+
+def test_deformable_conv_zero_offset_matches_convolution():
+    rng = np.random.RandomState(2)
+    x = rng.randn(2, 3, 7, 7).astype("float32")
+    w = rng.randn(4, 3, 3, 3).astype("float32")
+    off = np.zeros((2, 2 * 9, 5, 5), "float32")
+    out = mx.nd.contrib.DeformableConvolution(
+        mx.nd.array(x), mx.nd.array(off), mx.nd.array(w), no_bias=True,
+        kernel=(3, 3), num_filter=4).asnumpy()
+    ref = mx.nd.Convolution(mx.nd.array(x), mx.nd.array(w), no_bias=True,
+                            kernel=(3, 3), num_filter=4).asnumpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_deformable_conv_integer_offset_shifts_sampling():
+    x = np.zeros((1, 1, 5, 5), "float32")
+    x[0, 0, 2, 3] = 1.0
+    w = np.ones((1, 1, 1, 1), "float32")
+    # offset (dy=0, dx=+1): 1x1 kernel reads one pixel to the right
+    off = np.zeros((1, 2, 5, 5), "float32")
+    off[0, 1] = 1.0
+    out = mx.nd.contrib.DeformableConvolution(
+        mx.nd.array(x), mx.nd.array(off), mx.nd.array(w), no_bias=True,
+        kernel=(1, 1), num_filter=1).asnumpy()
+    assert out[0, 0, 2, 2] == 1.0 and out[0, 0, 2, 3] == 0.0
+
+
+def test_deformable_conv_offset_gradient_flows():
+    rng = np.random.RandomState(3)
+    x = mx.nd.array(rng.rand(1, 2, 6, 6).astype("float32"))
+    w = mx.nd.array(rng.randn(3, 2, 3, 3).astype("float32"))
+    off = mx.nd.array((rng.rand(1, 18, 4, 4) * 0.5).astype("float32"))
+    off.attach_grad()
+    with ag.record():
+        out = mx.nd.contrib.DeformableConvolution(
+            x, off, w, no_bias=True, kernel=(3, 3), num_filter=3)
+        loss = (out * out).sum()
+    loss.backward()
+    assert np.abs(off.grad.asnumpy()).sum() > 0
+
+
+def test_rroi_align_zero_angle_matches_axis_aligned():
+    rng = np.random.RandomState(4)
+    data = rng.rand(1, 2, 10, 10).astype("float32")
+    # rotated roi centered at (5,5), w=h=6, angle 0
+    rrois = np.array([[0, 5.0, 5.0, 6.0, 6.0, 0.0]], "float32")
+    out0 = mx.nd.contrib.RROIAlign(mx.nd.array(data), mx.nd.array(rrois),
+                                   pooled_size=(3, 3)).asnumpy()
+    out90 = mx.nd.contrib.RROIAlign(
+        mx.nd.array(data),
+        mx.nd.array(np.array([[0, 5.0, 5.0, 6.0, 6.0, 90.0]], "float32")),
+        pooled_size=(3, 3)).asnumpy()
+    assert out0.shape == (1, 2, 3, 3)
+    # a 90 degree rotation permutes the sampled grid, not its value set
+    np.testing.assert_allclose(sorted(out0.ravel()), sorted(out90.ravel()),
+                               atol=1e-4)
+
+
+def test_deformable_psroi_pooling_no_trans():
+    g, cdim = 2, 2
+    C = cdim * g * g
+    rng = np.random.RandomState(5)
+    data = rng.rand(1, C, 8, 8).astype("float32")
+    rois = np.array([[0, 1, 1, 7, 7]], "float32")
+    (out,) = mx.nd.contrib.DeformablePSROIPooling(
+        mx.nd.array(data), mx.nd.array(rois), None, spatial_scale=1.0,
+        output_dim=cdim, group_size=g, pooled_size=g, no_trans=True)
+    assert out.shape == (1, cdim, g, g)
+    assert np.isfinite(out.asnumpy()).all()
