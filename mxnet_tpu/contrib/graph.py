@@ -1,0 +1,99 @@
+"""Graph ops over CSR adjacency matrices.
+
+Role parity: reference ``src/operator/contrib/dgl_graph.cc`` (edge_id,
+dgl_adjacency, dgl_subgraph — the DGL v0.x integration ops) and
+``contrib/nnz.cc`` (getnnz). These are host-side graph *preparation*
+utilities in the reference too (CPU-only FComputeEx kernels feeding the
+sampler pipeline), so the TPU build keeps them eager on host numpy over
+the CSR payloads — they never appear inside a jitted step.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..ndarray.ndarray import NDArray
+from ..ndarray.sparse import CSRNDArray
+
+__all__ = ["edge_id", "getnnz", "dgl_adjacency", "dgl_subgraph"]
+
+
+def _csr_parts(csr):
+    if not isinstance(csr, CSRNDArray):
+        raise TypeError("expected a CSRNDArray, got %r" % type(csr))
+    d, i, p = csr._payload()
+    return (np.asarray(d), np.asarray(i, dtype=np.int64),
+            np.asarray(p, dtype=np.int64))
+
+
+def edge_id(data, u, v):
+    """Edge data value for each (u[i], v[i]) pair, -1 when absent
+    (reference dgl_graph.cc _contrib_edge_id)."""
+    d, idx, ptr = _csr_parts(data)
+    uu = np.asarray(u.asnumpy() if isinstance(u, NDArray) else u,
+                    dtype=np.int64)
+    vv = np.asarray(v.asnumpy() if isinstance(v, NDArray) else v,
+                    dtype=np.int64)
+    out = np.full(uu.shape, -1.0, dtype=np.float32)
+    for k, (a, b) in enumerate(zip(uu.ravel(), vv.ravel())):
+        cols = idx[ptr[a]:ptr[a + 1]]
+        hit = np.nonzero(cols == b)[0]
+        if hit.size:
+            out.ravel()[k] = d[ptr[a] + hit[0]]
+    return NDArray(out)
+
+
+def getnnz(data, axis=None):
+    """Stored-value count of a CSR matrix, total or per row/column
+    (reference contrib/nnz.cc)."""
+    d, idx, ptr = _csr_parts(data)
+    if axis is None:
+        return NDArray(np.asarray(len(d), dtype=np.int64))
+    if axis == 1:
+        return NDArray(np.diff(ptr).astype(np.int64))
+    if axis == 0:
+        counts = np.zeros(data.shape[1], dtype=np.int64)
+        np.add.at(counts, idx, 1)
+        return NDArray(counts)
+    raise ValueError("axis must be None, 0 or 1")
+
+
+def dgl_adjacency(data):
+    """Adjacency CSR with all-ones values and the same sparsity pattern
+    (reference dgl_graph.cc _contrib_dgl_adjacency)."""
+    d, idx, ptr = _csr_parts(data)
+    return CSRNDArray(np.ones_like(np.asarray(d), dtype=np.float32),
+                      idx, ptr, data.shape)
+
+
+def dgl_subgraph(graph, *vids, return_mapping=False):
+    """Vertex-induced subgraphs of a CSR graph (reference dgl_graph.cc
+    _contrib_dgl_subgraph): for each vertex-id array, the rows/cols
+    restricted to those vertices, renumbered to the induced order. With
+    ``return_mapping`` also yields same-pattern CSRs whose values are the
+    originating edge positions in the parent graph."""
+    d, idx, ptr = _csr_parts(graph)
+    outs, maps = [], []
+    for vid in vids:
+        v = np.asarray(vid.asnumpy() if isinstance(vid, NDArray) else vid,
+                       dtype=np.int64).ravel()
+        v = v[v >= 0]
+        renum = -np.ones(graph.shape[0], dtype=np.int64)
+        renum[v] = np.arange(v.size)
+        sub_data, sub_idx, sub_map = [], [], []
+        sub_ptr = [0]
+        for r in v:
+            cols = idx[ptr[r]:ptr[r + 1]]
+            keep = renum[cols] >= 0
+            sub_idx.extend(renum[cols[keep]])
+            sub_data.extend(d[ptr[r]:ptr[r + 1]][keep])
+            sub_map.extend((ptr[r] + np.nonzero(keep)[0]).tolist())
+            sub_ptr.append(len(sub_idx))
+        shape = (v.size, v.size)
+        outs.append(CSRNDArray(np.asarray(sub_data, dtype=np.float32),
+                               np.asarray(sub_idx, dtype=np.int64),
+                               np.asarray(sub_ptr, dtype=np.int64), shape))
+        maps.append(CSRNDArray(np.asarray(sub_map, dtype=np.float32),
+                               np.asarray(sub_idx, dtype=np.int64),
+                               np.asarray(sub_ptr, dtype=np.int64), shape))
+    res = outs + (maps if return_mapping else [])
+    return res[0] if len(res) == 1 else tuple(res)

@@ -1,5 +1,7 @@
 """mx.nd.contrib namespace (reference `python/mxnet/ndarray/contrib.py`)."""
 from ..ops.contrib_ops import foreach, while_loop, cond  # noqa: F401
+from ..contrib.graph import (edge_id, getnnz, dgl_adjacency,  # noqa: F401
+                             dgl_subgraph)
 from ..ops.registry import get_op as _get_op
 
 

@@ -1,0 +1,119 @@
+"""Miscellaneous contrib operators.
+
+Role parity: reference ``src/operator/contrib/quadratic_op.cc`` (the
+tutorial op), ``contrib/index_copy.cc``, ``contrib/index_array.cc``,
+``contrib/optimizer_op.cc`` (group_adagrad_update), and
+``contrib/hawkes_ll.cc`` (univariate Hawkes process log-likelihood with
+exponential kernel — here a ``lax.scan`` over the event sequence instead
+of the reference's per-thread CUDA loop).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register, register_alias
+
+__all__ = ["quadratic", "index_copy", "index_array",
+           "group_adagrad_update", "hawkesll"]
+
+
+@register("_contrib_quadratic", aliases=("quadratic",))
+def quadratic(data, a=0.0, b=0.0, c=0.0):
+    """f(x) = a*x^2 + b*x + c (reference contrib/quadratic_op.cc — MXNet's
+    custom-op tutorial operator)."""
+    return a * data * data + b * data + c
+
+
+@register("_contrib_index_copy", aliases=("index_copy",))
+def index_copy(old_tensor, index_vector, new_tensor):
+    """Copy rows of ``new_tensor`` into ``old_tensor`` at ``index_vector``
+    positions (reference contrib/index_copy.cc)."""
+    return old_tensor.at[index_vector.astype(jnp.int32)].set(new_tensor)
+
+
+@register("_contrib_index_array", aliases=("index_array",),
+          differentiable=False)
+def index_array(data, axes=None):
+    """Per-element coordinate array: output shape ``data.shape + (len(axes)
+    or ndim,)`` of int64 indices (reference contrib/index_array.cc)."""
+    nd = data.ndim
+    sel = tuple(range(nd)) if axes is None else tuple(int(a) for a in axes)
+    coords = [lax.broadcasted_iota(jnp.int64, data.shape, ax) for ax in sel]
+    return jnp.stack(coords, axis=-1)
+
+
+@register("_contrib_group_adagrad_update",
+          aliases=("group_adagrad_update",), n_out=2,
+          differentiable=False)
+def group_adagrad_update(weight, grad, history, lr=0.01, rescale_grad=1.0,
+                         clip_gradient=-1.0, epsilon=1e-5):
+    """Row-wise (grouped) AdaGrad (reference contrib/optimizer_op.cc:63):
+    history += mean(grad^2, axis=1, keepdims=True);
+    weight -= lr * grad / sqrt(history + eps)."""
+    g = grad * rescale_grad
+    if clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    axes = tuple(range(1, g.ndim))
+    hist = history + jnp.mean(jnp.square(g), axis=axes, keepdims=True)
+    w = weight - lr * g / jnp.sqrt(hist + epsilon)
+    return w, hist
+
+
+@register("_contrib_hawkesll", aliases=("hawkesll",), n_out=2)
+def hawkesll(lda, alpha, beta, state, lags, marks, valid_length, max_time):
+    """Univariate multi-mark Hawkes log-likelihood (reference
+    contrib/hawkes_ll.cc): exponential kernel
+    lambda_k*(t) = lda_k + alpha_k * beta_k * s_k(t), ragged (N, T)
+    event sequences scanned with lax.scan.
+
+    Returns (loglik (N,), s_k(max_time) (N, K)).
+    """
+    K = lda.shape[-1]
+
+    def one(lda_n, s0, lag_n, mark_n, vl, T):
+        Tn = lag_n.shape[0]
+
+        def step(carry, inp):
+            s, t, ll, comp = carry
+            j, lag, mark = inp
+            valid = (j < vl)
+            dec = jnp.exp(-beta * lag)
+            s2 = jnp.where(valid, s * dec, s)
+            t2 = jnp.where(valid, t + lag, t)
+            lam = lda_n + alpha * beta * s2
+            onehot = jax.nn.one_hot(mark, K, dtype=s.dtype)
+            ll2 = ll + jnp.where(
+                valid, jnp.log(jnp.maximum((lam * onehot).sum(), 1e-30)),
+                0.0)
+            # compensator contribution of this event on (t_j, T]
+            comp2 = comp + jnp.where(
+                valid, onehot * alpha * (1.0 - jnp.exp(-beta * (T - t2))),
+                0.0)
+            s3 = jnp.where(valid, s2 + onehot, s2)
+            return (s3, t2, ll2, comp2), None
+
+        init = (s0, jnp.zeros((), lda_n.dtype), jnp.zeros((), lda_n.dtype),
+                jnp.zeros((K,), lda_n.dtype))
+        (s, t_last, ll, comp), _ = lax.scan(
+            step, init,
+            (jnp.arange(Tn, dtype=jnp.int32), lag_n,
+             mark_n.astype(jnp.int32)))
+        # initial-state compensator + background rate over (0, T]
+        comp_total = (lda_n * T).sum() + comp.sum() + \
+            (alpha * s0 * (1.0 - jnp.exp(-beta * T))).sum()
+        # decay memory out to T for the returned state
+        s_T = s * jnp.exp(-beta * jnp.maximum(T - t_last, 0.0))
+        return ll - comp_total, s_T
+
+    ll, s_out = jax.vmap(one)(lda, state, lags, marks,
+                              valid_length.astype(jnp.int32), max_time)
+    return ll, s_out
+
+
+# SparseEmbedding: same math as Embedding; the row-sparse gradient storage
+# optimization is a GPU-memory concern the TPU build handles densely
+# (SURVEY §5.9 sanctions the dense fallback; reference
+# src/operator/tensor/indexing_op.cc _contrib_SparseEmbedding).
+register_alias("Embedding", "_contrib_SparseEmbedding", "SparseEmbedding")

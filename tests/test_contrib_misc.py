@@ -1,0 +1,153 @@
+"""Misc contrib ops — semantics from reference
+`src/operator/contrib/{quadratic_op,index_copy,index_array,optimizer_op,
+hawkes_ll}.cc` and `contrib/dgl_graph.cc`; Hawkes oracle is a direct numpy
+re-derivation of the exponential-kernel likelihood."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd as ag
+
+
+def test_quadratic_and_grad():
+    x = mx.nd.array(np.array([1.0, 2.0, -3.0], "float32"))
+    x.attach_grad()
+    with ag.record():
+        y = mx.nd.contrib.quadratic(x, a=2.0, b=1.0, c=-1.0)
+    y.backward()
+    np.testing.assert_allclose(y.asnumpy(), [2.0, 9.0, 14.0])
+    np.testing.assert_allclose(x.grad.asnumpy(), [5.0, 9.0, -11.0])
+
+
+def test_index_copy():
+    old = mx.nd.zeros((5, 3))
+    new = mx.nd.array(np.ones((2, 3), "float32") * 7)
+    idx = mx.nd.array(np.array([1, 3], "float32"))
+    out = mx.nd.contrib.index_copy(old, idx, new).asnumpy()
+    assert (out[[1, 3]] == 7).all() and (out[[0, 2, 4]] == 0).all()
+
+
+def test_index_array():
+    x = mx.nd.zeros((2, 3))
+    out = mx.nd.contrib.index_array(x).asnumpy()
+    assert out.shape == (2, 3, 2)
+    np.testing.assert_array_equal(out[1, 2], [1, 2])
+    out0 = mx.nd.contrib.index_array(x, axes=(1,)).asnumpy()
+    np.testing.assert_array_equal(out0[..., 0], [[0, 1, 2], [0, 1, 2]])
+
+
+def test_group_adagrad_update():
+    rng = np.random.RandomState(0)
+    w = rng.randn(4, 3).astype("float32")
+    g = rng.randn(4, 3).astype("float32")
+    h = np.zeros((4, 1), "float32")
+    w2, h2 = mx.nd.contrib.group_adagrad_update(
+        mx.nd.array(w), mx.nd.array(g), mx.nd.array(h), lr=0.1)
+    ref_h = h + (g * g).mean(axis=1, keepdims=True)
+    ref_w = w - 0.1 * g / np.sqrt(ref_h + 1e-5)
+    np.testing.assert_allclose(h2.asnumpy(), ref_h, rtol=1e-5)
+    np.testing.assert_allclose(w2.asnumpy(), ref_w, rtol=1e-5)
+
+
+def _hawkes_ref(lda, alpha, beta, s0, lags, marks, vl, T):
+    """Direct numpy evaluation of the Hawkes LL for one sample."""
+    K = lda.shape[0]
+    s = s0.copy().astype(np.float64)
+    t = 0.0
+    ll = 0.0
+    comp = np.zeros(K)
+    for j in range(int(vl)):
+        s = s * np.exp(-beta * lags[j])
+        t += lags[j]
+        k = int(marks[j])
+        lam = lda[k] + alpha[k] * beta[k] * s[k]
+        ll += np.log(lam)
+        comp[k] += alpha[k] * (1.0 - np.exp(-beta[k] * (T - t)))
+        s[k] += 1.0
+    comp_total = (lda * T).sum() + comp.sum() + \
+        (alpha * s0 * (1.0 - np.exp(-beta * T))).sum()
+    s_T = s * np.exp(-beta * max(T - t, 0.0))
+    return ll - comp_total, s_T
+
+
+def test_hawkesll_matches_numpy():
+    N, T_len, K = 2, 4, 3
+    rng = np.random.RandomState(1)
+    lda = np.tile([1.5, 2.0, 3.0], (N, 1)).astype("float32")
+    alpha = np.array([0.2, 0.3, 0.4], "float32")
+    beta = np.array([1.0, 2.0, 3.0], "float32")
+    state = rng.rand(N, K).astype("float32")
+    lags = rng.rand(N, T_len).astype("float32")
+    marks = rng.randint(0, K, (N, T_len)).astype("float32")
+    vl = np.array([3, 4], "float32")
+    max_t = np.array([10.0, 12.0], "float32")
+    ll, s_out = mx.nd.contrib.hawkesll(
+        mx.nd.array(lda), mx.nd.array(alpha), mx.nd.array(beta),
+        mx.nd.array(state), mx.nd.array(lags), mx.nd.array(marks),
+        mx.nd.array(vl), mx.nd.array(max_t))
+    for n in range(N):
+        ref_ll, ref_s = _hawkes_ref(lda[n].astype(np.float64), alpha, beta,
+                                    state[n], lags[n], marks[n], vl[n],
+                                    max_t[n])
+        assert abs(float(ll.asnumpy()[n]) - ref_ll) < 1e-3
+        np.testing.assert_allclose(s_out.asnumpy()[n], ref_s, atol=1e-4)
+
+
+def test_hawkesll_grad_flows():
+    lda = mx.nd.array(np.ones((1, 2), "float32"))
+    alpha = mx.nd.array(np.array([0.3, 0.2], "float32"))
+    beta = mx.nd.array(np.array([1.0, 1.5], "float32"))
+    lda.attach_grad()
+    alpha.attach_grad()
+    with ag.record():
+        ll, _ = mx.nd.contrib.hawkesll(
+            lda, alpha, beta, mx.nd.zeros((1, 2)),
+            mx.nd.array(np.array([[0.5, 0.7, 0.3]], "float32")),
+            mx.nd.array(np.array([[0, 1, 0]], "float32")),
+            mx.nd.array(np.array([3.0], "float32")),
+            mx.nd.array(np.array([5.0], "float32")))
+    ll.backward()
+    assert np.abs(lda.grad.asnumpy()).sum() > 0
+    assert np.abs(alpha.grad.asnumpy()).sum() > 0
+
+
+def test_sparse_embedding_alias():
+    w = mx.nd.array(np.random.RandomState(2).rand(10, 4).astype("float32"))
+    x = mx.nd.array(np.array([1, 3], "float32"))
+    out = mx.nd.contrib.SparseEmbedding(x, w, input_dim=10, output_dim=4)
+    np.testing.assert_allclose(out.asnumpy(), w.asnumpy()[[1, 3]])
+
+
+# ------------------------------------------------------- CSR graph helpers
+
+def _toy_csr():
+    import mxnet_tpu.ndarray.sparse as sp
+    # 4-vertex graph, edge values are edge ids 1..5
+    dense = np.array([[0, 1, 0, 2],
+                      [0, 0, 3, 0],
+                      [4, 0, 0, 0],
+                      [0, 0, 5, 0]], "float32")
+    return sp.csr_matrix(dense), dense
+
+
+def test_edge_id_and_getnnz():
+    csr, dense = _toy_csr()
+    u = mx.nd.array(np.array([0, 0, 1, 2], "float32"))
+    v = mx.nd.array(np.array([1, 2, 2, 0], "float32"))
+    out = mx.nd.contrib.edge_id(csr, u, v).asnumpy()
+    np.testing.assert_allclose(out, [1.0, -1.0, 3.0, 4.0])
+    assert int(mx.nd.contrib.getnnz(csr).asnumpy()) == 5
+    np.testing.assert_array_equal(
+        mx.nd.contrib.getnnz(csr, axis=1).asnumpy(), [2, 1, 1, 1])
+
+
+def test_dgl_adjacency_and_subgraph():
+    csr, dense = _toy_csr()
+    adj = mx.nd.contrib.dgl_adjacency(csr)
+    assert (adj.asnumpy() == (dense != 0)).all()
+    sub = mx.nd.contrib.dgl_subgraph(csr, mx.nd.array(
+        np.array([0, 3, 2], "float32")))
+    # induced graph on {0, 3, 2} renumbered [0->0, 3->1, 2->2]:
+    # edges kept: 0->3 (val 2), 3->2 (val 5), 2->0 (val 4)
+    ref = np.array([[0, 2, 0], [0, 0, 5], [4, 0, 0]], "float32")
+    np.testing.assert_array_equal(sub.asnumpy(), ref)
