@@ -132,6 +132,38 @@ class ndarray(_NDArrayBase):
         return result
 
 
+    # ---- numpy-style reduction / manipulation METHODS (reference
+    # multiarray.py gives mx.np.ndarray the full numpy method surface;
+    # each delegates to the module function so tape recording is shared)
+    def _method(name):
+        def m(self, *args, **kwargs):
+            return globals()[name](self, *args, **kwargs)
+        m.__name__ = name
+        return m
+
+    for _mname in ("sum", "mean", "std", "var", "prod", "max", "min",
+                   "argmax", "argmin", "cumsum", "cumprod", "all", "any",
+                   "clip", "round", "take", "repeat", "squeeze", "ravel",
+                   "flatten", "swapaxes", "trace", "diagonal", "nonzero",
+                   "searchsorted", "dot"):
+        locals()[_mname] = _method(_mname)
+    del _method, _mname
+
+    def transpose(self, *axes):
+        if len(axes) == 1 and isinstance(axes[0], (tuple, list)):
+            axes = tuple(axes[0])
+        ax = axes if axes else None
+        return _wrap_record("transpose",
+                            lambda v: _jnp.transpose(v, ax), self)
+
+    @property
+    def T(self):
+        return self.transpose()
+
+    def copy(self):  # numpy method form (module-level copy() also exists)
+        return _wrap_record("copy", lambda v: v + 0, self)
+
+
 def _as_np(arr):
     if isinstance(arr, tuple):
         return tuple(_as_np(a) for a in arr)
@@ -254,20 +286,20 @@ def _make_binary(name):
 
     def fn(a, b, *args, **kwargs):
         kwargs.pop("out", None)
-        arrs = []
-        if isinstance(a, _NDArrayBase):
-            arrs.append(a)
-        if isinstance(b, _NDArrayBase):
-            arrs.append(b)
+        extra = tuple(_unwrap(x) for x in args)
         av = _unwrap(a)
         bv = _unwrap(b)
         if isinstance(a, _NDArrayBase) and isinstance(b, _NDArrayBase):
-            return _wrap_record(name, lambda x, y: jfn(x, y, **kwargs), a, b)
+            return _wrap_record(name,
+                                lambda x, y: jfn(x, y, *extra, **kwargs),
+                                a, b)
         if isinstance(a, _NDArrayBase):
-            return _wrap_record(name, lambda x: jfn(x, bv, **kwargs), a)
+            return _wrap_record(name,
+                                lambda x: jfn(x, bv, *extra, **kwargs), a)
         if isinstance(b, _NDArrayBase):
-            return _wrap_record(name, lambda y: jfn(av, y, **kwargs), b)
-        return ndarray(jfn(av, bv, **kwargs))
+            return _wrap_record(name,
+                                lambda y: jfn(av, y, *extra, **kwargs), b)
+        return ndarray(jfn(av, bv, *extra, **kwargs))
     fn.__name__ = name
     return fn
 
@@ -278,7 +310,10 @@ _UNARY = ["abs", "absolute", "sign", "sqrt", "cbrt", "square", "exp",
           "arccosh", "arctanh", "floor", "ceil", "trunc", "rint", "fix",
           "negative", "reciprocal", "degrees", "radians", "isnan", "isinf",
           "isfinite", "logical_not", "sort", "argsort", "copy", "conj",
-          "real", "imag", "angle", "exp2", "positive", "invert"]
+          "real", "imag", "angle", "exp2", "positive", "invert",
+          "signbit", "sinc", "i0", "isposinf", "isneginf", "iscomplex",
+          "isreal", "bitwise_not", "conjugate", "fabs", "spacing",
+          "argwhere", "flatnonzero"]
 
 _BINARY = ["add", "subtract", "multiply", "divide", "true_divide", "mod",
            "remainder", "power", "float_power", "maximum", "minimum",
@@ -287,12 +322,16 @@ _BINARY = ["add", "subtract", "multiply", "divide", "true_divide", "mod",
            "less_equal", "logical_and", "logical_or", "logical_xor",
            "bitwise_and", "bitwise_or", "bitwise_xor", "left_shift",
            "right_shift", "matmul", "dot", "outer", "inner", "cross",
-           "kron", "gcd", "lcm", "heaviside", "ldexp"]
+           "kron", "gcd", "lcm", "heaviside", "ldexp", "floor_divide",
+           "nextafter", "logaddexp2", "polyval", "convolve", "correlate",
+           "isclose", "take_along_axis"]
 
 for _n in _UNARY:
-    globals()[_n] = _make_unary(_n)
+    if hasattr(_jnp, _n):
+        globals()[_n] = _make_unary(_n)
 for _n in _BINARY:
-    globals()[_n] = _make_binary(_n)
+    if hasattr(_jnp, _n):
+        globals()[_n] = _make_binary(_n)
 
 
 def _make_axis_fn(name):
@@ -320,7 +359,12 @@ _AXIS_FNS = ["sum", "mean", "std", "var", "prod", "max", "min", "amax",
              "tril", "triu", "nonzero", "count_nonzero", "searchsorted",
              "partition", "argpartition", "pad", "average", "nan_to_num",
              "take", "compress", "delete", "insert", "append", "resize",
-             "trim_zeros", "ediff1d", "bincount", "digitize", "histogram"]
+             "trim_zeros", "ediff1d", "bincount", "digitize", "histogram",
+             "nanstd", "nanvar", "nanmin", "nanmax", "nanargmin",
+             "nanargmax", "nanprod", "nancumsum", "nancumprod",
+             "nanmedian", "nanquantile", "nanpercentile", "ptp",
+             "gradient", "cov", "corrcoef", "unwrap", "interp",
+             "unravel_index", "histogram_bin_edges"]
 
 for _n in _AXIS_FNS:
     if hasattr(_jnp, _n):
@@ -490,6 +534,153 @@ def set_printoptions(*args, **kwargs):
 
 def genfromtxt(*args, **kwargs):
     return array(_onp.genfromtxt(*args, **kwargs))
+
+
+def flatten(a, order="C"):
+    return _wrap_record("flatten", lambda v: _jnp.ravel(v), a)
+
+
+def ndim(a):
+    return _unwrap(a).ndim if hasattr(_unwrap(a), "ndim") else \
+        _onp.ndim(_unwrap(a))
+
+
+def shape(a):
+    return tuple(_unwrap(a).shape)
+
+
+def size(a, axis=None):
+    s = shape(a)
+    if axis is None:
+        n = 1
+        for d in s:
+            n *= d
+        return n
+    return s[axis]
+
+
+def isin(element, test_elements, assume_unique=False, invert=False):
+    return _wrap_record(
+        "isin", lambda v: _jnp.isin(v, _unwrap(test_elements),
+                                    invert=invert), element)
+
+
+def in1d(ar1, ar2, assume_unique=False, invert=False):
+    return isin(ar1, ar2, invert=invert).reshape(-1)
+
+
+def intersect1d(ar1, ar2, assume_unique=False, return_indices=False):
+    out = _onp.intersect1d(_to_host(ar1), _to_host(ar2), assume_unique,
+                           return_indices)
+    if return_indices:
+        return tuple(ndarray(o) for o in out)
+    return ndarray(out)
+
+
+def union1d(ar1, ar2):
+    return ndarray(_onp.union1d(_to_host(ar1), _to_host(ar2)))
+
+
+def setdiff1d(ar1, ar2, assume_unique=False):
+    return ndarray(_onp.setdiff1d(_to_host(ar1), _to_host(ar2),
+                                  assume_unique))
+
+
+def setxor1d(ar1, ar2, assume_unique=False):
+    return ndarray(_onp.setxor1d(_to_host(ar1), _to_host(ar2),
+                                 assume_unique))
+
+
+def _to_host(a):
+    return (a.asnumpy() if isinstance(a, _NDArrayBase)
+            else _onp.asarray(a))
+
+
+def tri(N, M=None, k=0, dtype=None, ctx=None):
+    return ndarray(_jnp.tri(N, M, k,
+                            dtype=dtype_np(dtype) if dtype else None))
+
+
+def tril_indices(n, k=0, m=None):
+    r, c = _jnp.tril_indices(n, k, m)
+    return ndarray(r), ndarray(c)
+
+
+def triu_indices(n, k=0, m=None):
+    r, c = _jnp.triu_indices(n, k, m)
+    return ndarray(r), ndarray(c)
+
+
+def diag_indices(n, ndim=2):
+    return tuple(ndarray(i) for i in _jnp.diag_indices(n, ndim))
+
+
+def vander(x, N=None, increasing=False):
+    return _wrap_record("vander",
+                        lambda v: _jnp.vander(v, N, increasing), x)
+
+
+def bartlett(M, dtype=None, ctx=None):
+    return ndarray(_jnp.bartlett(M).astype(dtype_np(dtype or "float32")),
+                   ctx=ctx)
+
+
+def kaiser(M, beta, dtype=None, ctx=None):
+    return ndarray(_jnp.kaiser(M, beta).astype(dtype_np(dtype or "float32")),
+                   ctx=ctx)
+
+
+def put_along_axis(arr, indices, values, axis):
+    """In-place along-axis scatter (numpy semantics: mutates ``arr``)."""
+    new = _jnp.put_along_axis(_unwrap(arr), _unwrap(indices),
+                              _unwrap(values), axis, inplace=False)
+    arr._data = new
+    return None
+
+
+def fromfunction(function, shape, dtype=float, ctx=None, **kwargs):
+    return array(_onp.fromfunction(function, shape, dtype=dtype, **kwargs))
+
+
+def frombuffer(buffer, dtype=float, count=-1, offset=0):
+    return array(_onp.frombuffer(buffer, dtype, count, offset))
+
+
+def asarray(a, dtype=None, ctx=None):
+    if isinstance(a, ndarray) and dtype is None:
+        return a
+    return array(a, dtype=dtype, ctx=ctx)
+
+
+ascontiguousarray = asarray
+
+
+def copyto(dst, src, casting="same_kind", where=True):
+    """numpy.copyto onto an mx.np target (mutates ``dst``)."""
+    sv = _unwrap(src)
+    dv = _unwrap(dst)
+    out = _jnp.where(_unwrap(where), _jnp.broadcast_to(
+        _jnp.asarray(sv, dv.dtype), dv.shape), dv)
+    dst._data = out
+    return None
+
+
+def divmod(x1, x2):  # noqa: A001 - numpy-compatible shadowing
+    return floor_divide(x1, x2), mod(x1, x2)  # noqa: F821
+
+
+def modf(x):
+    return _wrap_record("modf", lambda v: tuple(_jnp.modf(v)), x)
+
+
+def frexp(x):
+    return _wrap_record("frexp", lambda v: tuple(_jnp.frexp(v)), x)
+
+
+def dsplit(ary, indices_or_sections):
+    return _wrap_record(
+        "dsplit",
+        lambda v: tuple(_jnp.dsplit(v, indices_or_sections)), ary)
 
 
 from . import random  # noqa: E402,F401

@@ -190,3 +190,96 @@ def test_ufunc_out_contract():
     r = onp.add(a, a, out=c)
     assert r is c
     onp.testing.assert_allclose(c.asnumpy(), [2.0, 4.0])
+
+
+# ---- expanded numpy surface (reference python/mxnet/numpy/multiarray.py
+#      method zoo + function namespace breadth)
+
+def test_np_ndarray_methods():
+    a = np.array([[1.0, 2.0, 3.0], [4.0, 5.0, 6.0]])
+    assert a.sum().item() == 21.0
+    assert a.mean(axis=0).shape == (3,)
+    assert a.max().item() == 6.0 and a.argmin().item() == 0
+    assert a.T.shape == (3, 2)
+    assert a.transpose(1, 0).shape == (3, 2)
+    assert a.flatten().shape == (6,)
+    assert a.cumsum(axis=1).asnumpy()[1].tolist() == [4.0, 9.0, 15.0]
+    assert a.clip(2.0, 5.0).asnumpy().max() == 5.0
+    assert a.prod().item() == 720.0
+    assert a.std().item() == pytest.approx(onp.std(a.asnumpy()))
+
+
+def test_np_methods_record_on_tape():
+    from mxnet_tpu import autograd as ag
+    a = np.array([[1.0, 2.0], [3.0, 4.0]])
+    a.attach_grad()
+    with ag.record():
+        loss = (a * a).sum()
+    loss.backward()
+    onp.testing.assert_allclose(a.grad.asnumpy(), 2 * a.asnumpy())
+
+
+def test_np_nan_family_and_ptp():
+    a = np.array([[1.0, onp.nan, 3.0]])
+    assert np.nanmax(a).item() == 3.0
+    assert np.nanargmax(a).item() == 2
+    assert np.nansum(a).item() == 4.0
+    assert float(np.ptp(np.array([2.0, 9.0, 4.0]))) == 7.0
+
+
+def test_np_set_ops():
+    a = np.array([1.0, 2.0, 3.0])
+    b = np.array([2.0, 3.0, 4.0])
+    assert np.intersect1d(a, b).asnumpy().tolist() == [2.0, 3.0]
+    assert np.union1d(a, b).asnumpy().tolist() == [1.0, 2.0, 3.0, 4.0]
+    assert np.setdiff1d(a, b).asnumpy().tolist() == [1.0]
+    mask = np.isin(a, b)
+    assert mask.asnumpy().tolist() == [False, True, True]
+
+
+def test_np_gradient_interp_cov():
+    g = np.gradient(np.array([1.0, 2.0, 4.0, 7.0]))
+    onp.testing.assert_allclose(g.asnumpy(), [1.0, 1.5, 2.5, 3.0])
+    y = np.interp(np.array([1.5]), np.array([1.0, 2.0]),
+                  np.array([10.0, 20.0]))
+    assert y.item() == pytest.approx(15.0)
+    c = np.cov(np.array([[1.0, 2.0, 3.0], [1.0, 2.0, 3.0]]))
+    assert c.shape == (2, 2)
+
+
+def test_np_take_put_along_axis():
+    a = np.array([[10.0, 30.0, 20.0]])
+    idx = np.argsort(a, axis=1)
+    s = np.take_along_axis(a, idx, axis=1)
+    assert s.asnumpy().tolist() == [[10.0, 20.0, 30.0]]
+    np.put_along_axis(a, np.array([[0]]).astype("int32"),
+                      np.array([[99.0]]), 1)
+    assert a.asnumpy()[0, 0] == 99.0
+
+
+def test_np_windows_and_grids():
+    assert np.bartlett(5).shape == (5,)
+    assert np.kaiser(5, 14.0).shape == (5,)
+    assert np.vander(np.array([1.0, 2.0]), 3).shape == (2, 3)
+    r, c = np.triu_indices(3)
+    assert len(r.asnumpy()) == 6
+    t = np.tri(3, k=0)
+    assert t.asnumpy()[0, 1] == 0.0 and t.asnumpy()[1, 0] == 1.0
+
+
+def test_np_divmod_modf_frexp():
+    q, r = np.divmod(np.array([7.0, 8.0]), 3.0)
+    assert q.asnumpy().tolist() == [2.0, 2.0]
+    assert r.asnumpy().tolist() == [1.0, 2.0]
+    fr, ip = np.modf(np.array([1.5, -2.25]))
+    assert fr.asnumpy().tolist() == [0.5, -0.25]
+    m, e = np.frexp(np.array([8.0]))
+    assert m.item() == 0.5 and e.item() == 4
+
+
+def test_np_copyto_and_asarray():
+    a = np.zeros((3,))
+    np.copyto(a, np.array([1.0, 2.0, 3.0]))
+    assert a.asnumpy().tolist() == [1.0, 2.0, 3.0]
+    b = np.asarray(a)
+    assert b is a
