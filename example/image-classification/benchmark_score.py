@@ -1,0 +1,105 @@
+"""Inference throughput benchmark across the model zoo.
+
+CLI parity with the reference `example/image-classification/benchmark_score.py`
+(the script behind BASELINE.md's inference tables, reference perf.md:194).
+TPU-native: each model's forward is functionalized once, jitted as a single
+XLA program, and timed with a device->host sync bounding each measurement.
+
+Usage:
+  python benchmark_score.py [--model resnet-50] [--batch-size 1,32,64]
+                            [--dtype bfloat16] [--image-shape 3,224,224]
+"""
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+    # this host's TPU plugin captures JAX_PLATFORMS at interpreter start;
+    # only jax.config reliably forces the CPU platform (conftest recipe)
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+import mxnet_tpu as mx
+from mxnet_tpu.gluon.model_zoo import vision
+from mxnet_tpu.parallel.functional import functionalize
+
+# reference benchmark_score.py model list (its get_symbol zoo), mapped to
+# the Gluon model zoo constructors
+MODELS = {
+    "alexnet": vision.alexnet,
+    "vgg-16": lambda: vision.get_vgg(16),
+    "inception-v3": vision.inception_v3,
+    "resnet-50": vision.resnet50_v1,
+    "resnet-152": vision.resnet152_v1,
+    "squeezenet": vision.squeezenet1_0,
+    "mobilenet": vision.mobilenet1_0,
+    "mobilenet-v2": vision.mobilenet_v2_1_0,
+    "densenet-121": vision.densenet121,
+}
+
+
+def score(model_name, batch, image_shape, dtype, repeat=3, iters=20):
+    import jax
+    import jax.numpy as jnp
+
+    mx.random.seed(0)
+    np.random.seed(0)
+    net = MODELS[model_name]()
+    net.initialize(mx.init.Xavier())
+    c, h, w = image_shape
+    net(mx.nd.zeros((1, c, h, w)))
+    if dtype == "bfloat16":
+        net.cast("bfloat16")
+    pure, params = functionalize(net, train=False)
+    pvals = [p.data()._data for p in params]
+    key = jax.random.PRNGKey(0)
+
+    @jax.jit
+    def many(x):
+        def body(carry, _):
+            (out,), _aux = pure(key, pvals, carry)
+            # feed a hash of the output back in so XLA cannot dead-code or
+            # overlap iterations; shapes stay constant
+            return carry + 0 * jnp.mean(out).astype(carry.dtype), ()
+        final, _ = jax.lax.scan(body, x, None, length=iters)
+        return final
+
+    x = jnp.asarray(np.random.rand(batch, c, h, w),
+                    jnp.bfloat16 if dtype == "bfloat16" else jnp.float32)
+    np.asarray(many(x))  # compile + warm
+    best = 0.0
+    for _ in range(repeat):
+        t0 = time.time()
+        np.asarray(many(x))  # D2H sync bounds the span
+        dt = time.time() - t0
+        best = max(best, batch * iters / dt)
+    return best
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="all",
+                    help="model name or 'all' (%s)" % ",".join(MODELS))
+    ap.add_argument("--batch-size", default="1,32",
+                    help="comma-separated batch sizes")
+    ap.add_argument("--dtype", default="bfloat16",
+                    choices=["float32", "bfloat16"])
+    ap.add_argument("--image-shape", default="3,224,224")
+    args = ap.parse_args()
+
+    shape = tuple(int(v) for v in args.image_shape.split(","))
+    names = list(MODELS) if args.model == "all" else args.model.split(",")
+    for name in names:
+        for b in (int(v) for v in args.batch_size.split(",")):
+            img_s = score(name, b, shape, args.dtype)
+            print("model: %s, dtype: %s, batch: %d, images/sec: %.2f"
+                  % (name, args.dtype, b, img_s), flush=True)
+
+
+if __name__ == "__main__":
+    main()
