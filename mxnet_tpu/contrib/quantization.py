@@ -133,33 +133,149 @@ def quantize_model(sym, arg_params, aux_params, data_names=("data",),
     return sym, qargs, aux_params
 
 
+def _int8_blocks():
+    """Lazily-built int8 inference Blocks over the quantized op family
+    (reference's int8 graph rewrite, `quantize_graph_pass.cc`, done here as
+    a Gluon block swap). The int8 x int8 -> int32 matmul/conv rides the MXU
+    int8 path on TPU; ranges travel as (1,) tensors exactly like the
+    reference's min/max outputs."""
+    from ..gluon.block import Block
+    from ..ops.registry import get_op
+
+    _quant = get_op("_contrib_quantize_v2")
+    _fc = get_op("_contrib_quantized_fully_connected")
+    _conv = get_op("_contrib_quantized_conv")
+    _deq = get_op("_contrib_dequantize")
+
+    class _Int8Layer(Block):
+        def __init__(self, weight, bias, act):
+            super().__init__()
+            w = weight.astype(np.float32)
+            amax = max(float(np.abs(w).max()), 1e-12)
+            q = np.clip(np.round(w / (amax / 127.0)), -127,
+                        127).astype(np.int8)
+            self._wq = NDArray(jnp.asarray(q))
+            self._wmn = NDArray(jnp.asarray([-amax], jnp.float32))
+            self._wmx = NDArray(jnp.asarray([amax], jnp.float32))
+            self._b = None if bias is None else NDArray(
+                jnp.asarray(bias.astype(np.float32)))
+            self._act = act
+            self._calibrating = False
+            self._range = None  # (min, max) after calibration
+
+        def _quantize_in(self, x):
+            if self._calibrating:
+                xn = x.asnumpy()
+                lo, hi = float(xn.min()), float(xn.max())
+                if self._range is None:
+                    self._range = [lo, hi]
+                else:
+                    self._range = [min(self._range[0], lo),
+                                   max(self._range[1], hi)]
+            if self._range is not None and not self._calibrating:
+                return _quant(x, min_calib_range=self._range[0],
+                              max_calib_range=self._range[1])
+            return _quant(x)
+
+    class _Int8Dense(_Int8Layer):
+        def __init__(self, dense):
+            super().__init__(dense.weight.data().asnumpy(),
+                             None if dense.bias is None
+                             else dense.bias.data().asnumpy(), dense.act)
+            self._units = dense._units
+            self._flatten = dense._flatten
+
+        def forward(self, x):
+            qx, xmn, xmx = self._quantize_in(x)
+            acc, omn, omx = _fc(qx, self._wq, None, xmn, xmx, self._wmn,
+                                self._wmx, no_bias=True,
+                                num_hidden=self._units,
+                                flatten=self._flatten)
+            y = _deq(acc, omn, omx)
+            if self._b is not None:
+                y = y + self._b
+            return y if self._act is None else self._act(y)
+
+    class _Int8Conv(_Int8Layer):
+        def __init__(self, conv):
+            super().__init__(conv.weight.data().asnumpy(),
+                             None if conv.bias is None
+                             else conv.bias.data().asnumpy(),
+                             getattr(conv, "act", None))
+            self._kwargs = dict(conv._kwargs)
+
+        def forward(self, x):
+            qx, xmn, xmx = self._quantize_in(x)
+            k = self._kwargs
+            acc, omn, omx = _conv(qx, self._wq, None, xmn, xmx, self._wmn,
+                                  self._wmx, kernel=k["kernel"],
+                                  stride=k["stride"], pad=k["pad"],
+                                  dilate=k["dilate"],
+                                  num_filter=k["num_filter"], no_bias=True)
+            y = _deq(acc, omn, omx)
+            if self._b is not None:
+                y = y + self._b.reshape((1, -1) + (1,) * (len(y.shape) - 2))
+            return y if self._act is None else self._act(y)
+
+    return _Int8Dense, _Int8Conv
+
+
 def quantize_net(network, quantized_dtype="int8", quantize_mode="full",
                  exclude_layers=None, exclude_layers_match=None,
                  calib_data=None, data_shapes=None, calib_mode="none",
                  num_calib_examples=None, ctx=None, logger=logging):
-    """Gluon-path quantization (reference quantization.py:700
-    quantize_net): int8 weight quantization applied in place to Dense/Conv
-    parameters (per-channel scales)."""
+    """Gluon-path post-training quantization (reference quantization.py:700
+    quantize_net): Dense/Conv2D blocks are swapped for int8 blocks that run
+    ``quantize_v2 -> int8 matmul/conv (int32 accumulate) -> dequantize``.
+    With ``calib_data`` the activation ranges are frozen from calibration
+    forwards (``calib_mode='naive'``); otherwise quantization is dynamic
+    per batch. Unsupported layers (grouped convs, exclusions) stay float."""
     from ..gluon import nn as gnn
+    _Int8Dense, _Int8Conv = _int8_blocks()
     count = 0
     exclude = set(exclude_layers or [])
+    match = tuple(exclude_layers_match or ())
+    swapped = []
+
+    def _excluded(name):
+        return name in exclude or any(m in name for m in match)
 
     def visit(block):
         nonlocal count
-        for child in block._children.values():
-            visit(child)
-        if isinstance(block, (gnn.Dense, gnn.Conv1D, gnn.Conv2D,
-                              gnn.Conv3D)) and block.name not in exclude:
-            p = block.weight
-            if p._data is None:
-                return
-            arr = p.data().asnumpy()
-            q, s = quantize_params({"w": arr})
-            deq = q["w"].astype(np.float32) * \
-                s["w"].reshape((-1,) + (1,) * (arr.ndim - 1))
-            p.set_data(NDArray(jnp.asarray(deq.astype(arr.dtype))))
-            count += 1
+        for key, child in list(block._children.items()):
+            qb = None
+            if _excluded(child.name):
+                pass
+            elif isinstance(child, gnn.Dense) and \
+                    child.weight._data is not None:
+                qb = _Int8Dense(child)
+            elif isinstance(child, gnn.Conv2D) and \
+                    child.weight._data is not None and \
+                    child._kwargs.get("num_group", 1) == 1:
+                qb = _Int8Conv(child)
+            if qb is not None:
+                block._children[key] = qb
+                if getattr(block, key, None) is child:
+                    object.__setattr__(block, key, qb)
+                swapped.append(qb)
+                count += 1
+            else:
+                visit(child)
 
     visit(network)
-    logger.info("quantize_net: %d layers int8-quantized", count)
+    if calib_data is not None and calib_mode != "none":
+        for qb in swapped:
+            qb._calibrating = True
+        seen = 0
+        for batch in calib_data:
+            x = batch[0] if isinstance(batch, (tuple, list)) else batch
+            network(x if isinstance(x, NDArray) else NDArray(
+                jnp.asarray(np.asarray(x))))
+            seen += x.shape[0]
+            if num_calib_examples and seen >= num_calib_examples:
+                break
+        for qb in swapped:
+            qb._calibrating = False
+        logger.info("calibrated %d layers on %d examples", count, seen)
+    logger.info("quantize_net: %d layers swapped to int8", count)
     return network

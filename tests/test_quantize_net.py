@@ -1,0 +1,67 @@
+"""quantize_net int8 inference path — semantics from reference
+`python/mxnet/contrib/quantization.py` quantize_net +
+`tests/python/quantization/test_quantization.py`: quantized network must
+track the float network within int8 tolerance, with static ranges after
+calibration."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.contrib.quantization import quantize_net
+
+
+def _mlp():
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(16, activation="relu"))
+    net.add(gluon.nn.Dense(8))
+    net.initialize(mx.init.Xavier())
+    return net
+
+
+def test_quantize_net_dense_matches_float():
+    net = _mlp()
+    x = mx.nd.array(np.random.RandomState(0).randn(4, 10).astype("float32"))
+    ref = net(x).asnumpy()
+    qnet = quantize_net(net)
+    out = qnet(x).asnumpy()
+    scale = np.abs(ref).max()
+    assert np.abs(out - ref).max() < 0.05 * scale + 0.05
+
+
+def test_quantize_net_runs_int8_ops():
+    """The swapped blocks must hold int8 weights, not dequantized floats."""
+    net = _mlp()
+    net(mx.nd.zeros((1, 10)))  # resolve deferred shapes
+    quantize_net(net)
+    blocks = list(net._children.values())
+    assert all(b._wq.asnumpy().dtype == np.int8 for b in blocks)
+
+
+def test_quantize_net_conv_and_calibration():
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Conv2D(8, kernel_size=3, padding=1))
+    net.add(gluon.nn.Activation("relu"))
+    net.add(gluon.nn.Dense(4))
+    net.initialize(mx.init.Xavier())
+    rng = np.random.RandomState(1)
+    x = mx.nd.array(rng.randn(2, 3, 8, 8).astype("float32"))
+    ref = net(x).asnumpy()
+    calib = [mx.nd.array(rng.randn(2, 3, 8, 8).astype("float32"))
+             for _ in range(3)] + [x]
+    qnet = quantize_net(net, calib_data=calib, calib_mode="naive")
+    # ranges frozen after calibration
+    conv = next(iter(net._children.values()))
+    assert conv._range is not None and not conv._calibrating
+    out = qnet(x).asnumpy()
+    scale = np.abs(ref).max()
+    assert np.abs(out - ref).max() < 0.08 * scale + 0.08
+
+
+def test_quantize_net_exclude_layers():
+    net = _mlp()
+    net(mx.nd.zeros((1, 10)))
+    names = [b.name for b in net._children.values()]
+    quantize_net(net, exclude_layers=[names[0]])
+    blocks = list(net._children.values())
+    assert isinstance(blocks[0], gluon.nn.Dense)      # kept float
+    assert not isinstance(blocks[1], gluon.nn.Dense)  # swapped
