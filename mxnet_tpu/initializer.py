@@ -266,6 +266,11 @@ class LSTMBias(Initializer):
         v[num_hidden:2 * num_hidden] = self.forget_bias
         arr[:] = v
 
+    # the tensor this initializer targets IS a bias, so direct calls on a
+    # "*_bias" name must hit the same logic (the Parameter path arrives via
+    # attrs["__init__"] -> _init_weight, reference initializer.py:517)
+    _init_bias = _init_weight
+
 
 class Mixed:
     """Apply different initializers by name regex (reference Mixed)."""

@@ -1,0 +1,246 @@
+"""Aux frontend depth tests: metrics, gluon.data, io iterators,
+lr schedulers, initializers, recordio — semantics from reference
+`tests/python/unittest/{test_metric,test_gluon_data,test_io,test_init}.py`
+and `python/mxnet/lr_scheduler.py` docstrings."""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+
+
+# ------------------------------------------------------------------ metrics
+
+def test_accuracy_and_topk():
+    acc = mx.metric.Accuracy()
+    pred = mx.nd.array(np.array([[0.1, 0.9], [0.8, 0.2], [0.3, 0.7]],
+                                "float32"))
+    label = mx.nd.array(np.array([1, 0, 0], "float32"))
+    acc.update([label], [pred])
+    name, val = acc.get()
+    assert name == "accuracy" and val == pytest.approx(2.0 / 3.0)
+
+    topk = mx.metric.TopKAccuracy(top_k=2)
+    topk.update([label], [pred])
+    assert topk.get()[1] == 1.0
+
+
+def test_mse_mae_rmse_crossentropy_perplexity():
+    pred = mx.nd.array(np.array([[0.25, 0.75], [0.6, 0.4]], "float32"))
+    label = mx.nd.array(np.array([1, 0], "float32"))
+    for cls, ref in [(mx.metric.CrossEntropy, None),
+                     (mx.metric.Perplexity, None)]:
+        m = cls() if cls is mx.metric.CrossEntropy else cls(ignore_label=None)
+        m.update([label], [pred])
+        v = m.get()[1]
+        assert np.isfinite(v)
+    ce = -np.log([0.75, 0.6]).mean()
+    m = mx.metric.CrossEntropy()
+    m.update([label], [pred])
+    assert m.get()[1] == pytest.approx(ce, rel=1e-5)
+
+    y = mx.nd.array(np.array([1.0, 2.0, 3.0], "float32"))
+    yhat = mx.nd.array(np.array([1.5, 2.0, 2.0], "float32"))
+    for cls, ref in [(mx.metric.MAE, 0.5), (mx.metric.MSE, 1.25 / 3),
+                     (mx.metric.RMSE, np.sqrt(1.25 / 3))]:
+        m = cls()
+        m.update([y], [yhat])
+        assert m.get()[1] == pytest.approx(ref, rel=1e-5)
+
+
+def test_f1_and_composite_and_custom():
+    pred = mx.nd.array(np.array([[0.2, 0.8], [0.9, 0.1], [0.4, 0.6]],
+                                "float32"))
+    label = mx.nd.array(np.array([1, 0, 0], "float32"))
+    f1 = mx.metric.F1()
+    f1.update([label], [pred])
+    # tp=1 fp=1 fn=0 -> precision .5 recall 1 -> f1 = 2/3
+    assert f1.get()[1] == pytest.approx(2.0 / 3.0)
+
+    comp = mx.metric.CompositeEvalMetric()
+    comp.add(mx.metric.Accuracy())
+    comp.add(mx.metric.F1())
+    comp.update([label], [pred])
+    names, vals = comp.get()
+    assert len(names) == 2 and len(vals) == 2
+
+    cm = mx.metric.CustomMetric(lambda l, p: float(np.mean(l)),
+                                name="labelmean")
+    cm.update([label], [pred])
+    assert cm.get()[1] == pytest.approx(1.0 / 3.0)
+
+
+def test_metric_create_and_reset():
+    m = mx.metric.create("acc")
+    pred = mx.nd.array(np.array([[0.1, 0.9]], "float32"))
+    m.update([mx.nd.array(np.array([1.0], "float32"))], [pred])
+    assert m.get()[1] == 1.0
+    m.reset()
+    assert np.isnan(m.get()[1]) or m.get()[1] == 0.0
+
+
+# -------------------------------------------------------------- gluon.data
+
+def test_array_dataset_and_dataloader():
+    x = np.arange(20, dtype="float32").reshape(10, 2)
+    y = np.arange(10, dtype="float32")
+    ds = gluon.data.ArrayDataset(mx.nd.array(x), mx.nd.array(y))
+    assert len(ds) == 10
+    xi, yi = ds[3]
+    assert xi.shape == (2,) and float(np.asarray(yi)) == 3.0
+
+    dl = gluon.data.DataLoader(ds, batch_size=4, last_batch="keep")
+    batches = list(dl)
+    assert len(batches) == 3
+    assert batches[0][0].shape == (4, 2)
+    assert batches[2][0].shape == (2, 2)
+
+    dl2 = gluon.data.DataLoader(ds, batch_size=4, last_batch="discard",
+                                shuffle=True)
+    bs = list(dl2)
+    assert len(bs) == 2
+    seen = np.sort(np.concatenate([b[1].asnumpy() for b in bs]))
+    assert len(seen) == 8 and len(np.unique(seen)) == 8
+
+
+def test_samplers():
+    seq = list(gluon.data.SequentialSampler(5))
+    assert seq == [0, 1, 2, 3, 4]
+    rnd = list(gluon.data.RandomSampler(5))
+    assert sorted(rnd) == [0, 1, 2, 3, 4]
+    bs = list(gluon.data.BatchSampler(gluon.data.SequentialSampler(5), 2,
+                                      "keep"))
+    assert bs == [[0, 1], [2, 3], [4]]
+
+
+def test_transforms_compose():
+    from mxnet_tpu.gluon.data.vision import transforms as T
+    img = mx.nd.array((np.random.RandomState(0).rand(8, 8, 3) * 255)
+                      .astype("float32"))
+    pipe = T.Compose([T.ToTensor(),
+                      T.Normalize(mean=(0.5, 0.5, 0.5),
+                                  std=(0.25, 0.25, 0.25))])
+    out = pipe(img)
+    assert out.shape == (3, 8, 8)
+    raw = img.asnumpy().transpose(2, 0, 1) / 255.0
+    np.testing.assert_allclose(out.asnumpy(), (raw - 0.5) / 0.25,
+                               atol=1e-5)
+    cc = T.CenterCrop(4)(img)
+    assert cc.shape[:2] == (4, 4)
+    rs = T.Resize(16)(img)
+    assert rs.shape[:2] == (16, 16)
+
+
+# ------------------------------------------------------------------ io
+
+def test_ndarray_iter_pad_and_reset():
+    x = np.arange(10, dtype="float32").reshape(5, 2)
+    y = np.arange(5, dtype="float32")
+    it = mx.io.NDArrayIter(x, y, batch_size=2, last_batch_handle="pad")
+    batches = list(it)
+    assert len(batches) == 3
+    assert batches[0].data[0].shape == (2, 2)
+    assert batches[2].pad == 1  # padded final batch
+    it.reset()
+    again = list(it)
+    assert len(again) == 3
+    np.testing.assert_allclose(batches[0].data[0].asnumpy(),
+                               again[0].data[0].asnumpy())
+
+
+def test_ndarray_iter_provide_data_label():
+    it = mx.io.NDArrayIter(np.zeros((4, 3), "float32"),
+                           np.zeros((4,), "float32"), batch_size=2)
+    (dname, dshape) = it.provide_data[0][:2]
+    (lname, lshape) = it.provide_label[0][:2]
+    assert dname == "data" and tuple(dshape) == (2, 3)
+    assert lname == "softmax_label" and tuple(lshape) == (2,)
+
+
+# ------------------------------------------------------------ lr schedulers
+
+def test_lr_schedulers():
+    fs = mx.lr_scheduler.FactorScheduler(step=10, factor=0.5,
+                                         base_lr=1.0)
+    # reference FactorScheduler reduces when num_update > step
+    assert fs(0) == 1.0 and fs(10) == 1.0
+    assert fs(11) == pytest.approx(0.5)
+    assert fs(21) == pytest.approx(0.25)
+
+    mf = mx.lr_scheduler.MultiFactorScheduler(step=[5, 15], factor=0.1,
+                                              base_lr=1.0)
+    assert mf(4) == 1.0
+    assert mf(6) == pytest.approx(0.1)
+    assert mf(16) == pytest.approx(0.01)
+
+    ps = mx.lr_scheduler.PolyScheduler(max_update=100, base_lr=1.0, pwr=1)
+    assert ps(0) == pytest.approx(1.0)
+    assert ps(50) == pytest.approx(0.5, abs=0.02)
+
+    cs = mx.lr_scheduler.CosineScheduler(max_update=100, base_lr=1.0,
+                                         final_lr=0.0)
+    assert cs(0) == pytest.approx(1.0)
+    assert cs(100) == pytest.approx(0.0, abs=1e-6)
+
+
+# ------------------------------------------------------------ initializers
+
+def test_initializer_zoo():
+    shapes = {}
+    for init, check in [
+        (mx.init.Zero(), lambda a: (a == 0).all()),
+        (mx.init.One(), lambda a: (a == 1).all()),
+        (mx.init.Constant(3.0), lambda a: (a == 3.0).all()),
+        (mx.init.Uniform(0.1), lambda a: (np.abs(a) <= 0.1).all()),
+        (mx.init.Normal(0.01), lambda a: np.abs(a).std() < 0.05),
+        (mx.init.Xavier(), lambda a: np.isfinite(a).all()),
+        (mx.init.MSRAPrelu(), lambda a: np.isfinite(a).all()),
+    ]:
+        arr = mx.nd.zeros((8, 16))
+        init(mx.init.InitDesc("test_weight"), arr)
+        assert check(arr.asnumpy()), type(init).__name__
+
+    # orthogonal: W @ W.T == scale^2 * I for square (default scale 1.414)
+    arr = mx.nd.zeros((16, 16))
+    mx.init.Orthogonal(scale=1.0)(mx.init.InitDesc("w"), arr)
+    a = arr.asnumpy()
+    np.testing.assert_allclose(a @ a.T, np.eye(16), atol=1e-3)
+
+    # LSTMBias sets forget-gate biases to 1
+    arr = mx.nd.zeros((32,))  # 4 gates x 8 hidden
+    mx.init.LSTMBias(forget_bias=1.0)(mx.init.InitDesc("lstm_bias"), arr)
+    b = arr.asnumpy()
+    assert (b[8:16] == 1.0).all() and b.sum() == 8.0
+
+
+# --------------------------------------------------------------- recordio
+
+def test_recordio_roundtrip(tmp_path):
+    from mxnet_tpu import recordio
+    path = str(tmp_path / "test.rec")
+    w = recordio.MXRecordIO(path, "w")
+    payloads = [b"hello", b"world" * 100, b""]
+    for p in payloads:
+        w.write(p)
+    w.close()
+    r = recordio.MXRecordIO(path, "r")
+    got = [r.read() for _ in payloads]
+    assert got == payloads
+    r.close()
+
+
+def test_indexed_recordio_and_pack(tmp_path):
+    from mxnet_tpu import recordio
+    path = str(tmp_path / "test.rec")
+    idx = str(tmp_path / "test.idx")
+    w = recordio.MXIndexedRecordIO(idx, path, "w")
+    for i in range(5):
+        header = recordio.IRHeader(0, float(i), i, 0)
+        w.write_idx(i, recordio.pack(header, b"payload%d" % i))
+    w.close()
+    r = recordio.MXIndexedRecordIO(idx, path, "r")
+    h, payload = recordio.unpack(r.read_idx(3))
+    assert h.label == 3.0 and payload == b"payload3"
+    r.close()
