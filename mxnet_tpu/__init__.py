@@ -26,6 +26,7 @@ from .ndarray import NDArray
 from . import random
 from . import autograd
 from . import _tape
+from . import operator  # eager: registers the `Custom` op (custom-op bridge)
 
 # Heavier subsystems are imported lazily via __getattr__ to keep import fast.
 _LAZY = {

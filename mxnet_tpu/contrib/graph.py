@@ -14,7 +14,9 @@ import numpy as np
 from ..ndarray.ndarray import NDArray
 from ..ndarray.sparse import CSRNDArray
 
-__all__ = ["edge_id", "getnnz", "dgl_adjacency", "dgl_subgraph"]
+__all__ = ["edge_id", "getnnz", "dgl_adjacency", "dgl_subgraph",
+           "dgl_csr_neighbor_uniform_sample",
+           "dgl_csr_neighbor_non_uniform_sample", "dgl_graph_compact"]
 
 
 def _csr_parts(csr):
@@ -97,3 +99,120 @@ def dgl_subgraph(graph, *vids, return_mapping=False):
                                np.asarray(sub_ptr, dtype=np.int64), shape))
     res = outs + (maps if return_mapping else [])
     return res[0] if len(res) == 1 else tuple(res)
+
+
+def _neighbor_sample(graph, seeds, num_hops, num_neighbor,
+                     max_num_vertices, prob=None, rng=None):
+    d, idx, ptr = _csr_parts(graph)
+    rng = rng or np.random
+    pv = None if prob is None else np.asarray(
+        prob.asnumpy() if isinstance(prob, NDArray) else prob,
+        dtype=np.float64)
+    outs = []
+    for seed in seeds:
+        sv = np.asarray(seed.asnumpy() if isinstance(seed, NDArray)
+                        else seed, dtype=np.int64).ravel()
+        layer = {int(v): 0 for v in sv}
+        frontier = list(layer)
+        edges = []
+        for hop in range(1, num_hops + 1):
+            nxt = []
+            for v in frontier:
+                cols = idx[ptr[v]:ptr[v + 1]]
+                vals = d[ptr[v]:ptr[v + 1]]
+                if cols.size == 0:
+                    continue
+                k = min(int(num_neighbor), cols.size)
+                if pv is None:
+                    pick = rng.choice(cols.size, size=k, replace=False)
+                else:
+                    w = pv[cols]
+                    w = w / w.sum() if w.sum() > 0 else None
+                    pick = rng.choice(cols.size, size=k, replace=False,
+                                      p=w)
+                for j in pick:
+                    nb = int(cols[j])
+                    edges.append((v, nb, vals[j]))
+                    if nb not in layer and len(layer) < max_num_vertices:
+                        layer[nb] = hop
+                        nxt.append(nb)
+            frontier = nxt
+        verts = np.array(sorted(layer), dtype=np.int64)
+        n = verts.size
+        vset = set(verts.tolist())
+        varr = np.zeros(max_num_vertices + 1, np.int64)
+        varr[:n] = verts
+        varr[-1] = n
+        larr = np.zeros(max_num_vertices, np.int64)
+        larr[:n] = [layer[int(v)] for v in verts]
+        # sampled-edge CSR in ORIGINAL vertex numbering, graph-shaped
+        rows = {}
+        for (s, t, val) in edges:
+            if s in vset and t in vset:
+                rows.setdefault(s, {})[t] = val
+        sd, si = [], []
+        sp = [0]
+        for r in range(graph.shape[0]):
+            cols = sorted(rows.get(r, {}))
+            si.extend(cols)
+            sd.extend(rows[r][c] for c in cols)
+            sp.append(len(si))
+        outs.append((NDArray(varr),
+                     CSRNDArray(np.asarray(sd, dtype=np.float32),
+                                np.asarray(si, dtype=np.int64),
+                                np.asarray(sp, dtype=np.int64),
+                                graph.shape),
+                     NDArray(larr)))
+    flat = [o[0] for o in outs] + [o[1] for o in outs] + \
+        [o[2] for o in outs]
+    return tuple(flat)
+
+
+def dgl_csr_neighbor_uniform_sample(csr, *seeds, num_args=0, num_hops=1,
+                                    num_neighbor=2, max_num_vertices=100):
+    """Uniform neighbor sampling for DGL (reference dgl_graph.cc): per seed
+    array returns (vertices[max+1] with the count in the last slot, the
+    sampled-edge CSR in original numbering, per-vertex hop layers)."""
+    return _neighbor_sample(csr, seeds, int(num_hops), int(num_neighbor),
+                            int(max_num_vertices))
+
+
+def dgl_csr_neighbor_non_uniform_sample(csr, probability, *seeds,
+                                        num_args=0, num_hops=1,
+                                        num_neighbor=2,
+                                        max_num_vertices=100):
+    """Probability-weighted variant of the neighbor sampler."""
+    return _neighbor_sample(csr, seeds, int(num_hops), int(num_neighbor),
+                            int(max_num_vertices), prob=probability)
+
+
+def dgl_graph_compact(*args, graph_sizes=(), return_mapping=False):
+    """Strip the empty tail rows/columns a sampler-produced CSR carries and
+    renumber to the sampled-vertex order (reference dgl_graph.cc
+    _contrib_dgl_graph_compact). ``args`` = sampled CSRs followed by their
+    vertex arrays; ``graph_sizes`` = actual vertex counts."""
+    n = len(args) // 2
+    graphs, vids = args[:n], args[n:]
+    if not isinstance(graph_sizes, (tuple, list)):
+        graph_sizes = (graph_sizes,)
+    outs = []
+    for g, v, size in zip(graphs, vids, graph_sizes):
+        d, idx, ptr = _csr_parts(g)
+        verts = np.asarray(v.asnumpy() if isinstance(v, NDArray) else v,
+                           dtype=np.int64).ravel()[:int(size)]
+        renum = -np.ones(g.shape[0], dtype=np.int64)
+        renum[verts] = np.arange(verts.size)
+        sd, si = [], []
+        sp = [0]
+        for r in verts:
+            cols = idx[ptr[r]:ptr[r + 1]]
+            keep = renum[cols] >= 0
+            order = np.argsort(renum[cols[keep]])
+            si.extend(renum[cols[keep]][order])
+            sd.extend(d[ptr[r]:ptr[r + 1]][keep][order])
+            sp.append(len(si))
+        outs.append(CSRNDArray(np.asarray(sd, dtype=np.float32),
+                               np.asarray(si, dtype=np.int64),
+                               np.asarray(sp, dtype=np.int64),
+                               (verts.size, verts.size)))
+    return outs[0] if len(outs) == 1 else tuple(outs)

@@ -1,7 +1,10 @@
 """mx.nd.contrib namespace (reference `python/mxnet/ndarray/contrib.py`)."""
 from ..ops.contrib_ops import foreach, while_loop, cond  # noqa: F401
 from ..contrib.graph import (edge_id, getnnz, dgl_adjacency,  # noqa: F401
-                             dgl_subgraph)
+                             dgl_subgraph,
+                             dgl_csr_neighbor_uniform_sample,
+                             dgl_csr_neighbor_non_uniform_sample,
+                             dgl_graph_compact)
 from ..ops.registry import get_op as _get_op
 
 

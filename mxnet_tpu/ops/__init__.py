@@ -27,6 +27,18 @@ from . import contrib_misc  # noqa: F401  (quadratic/index/hawkes etc)
 from . import numpy_ops  # noqa: F401  (_npi_/_np_/_npx_ registrations;
 #                                       aliases ops above, keep last)
 
+# remaining reference registration names that are pure aliases here:
+# CTCLoss (reference ctc_loss.cc registers both), *_v1 legacy conv/pool
+# (reference convolution_v1.cc — same math, older layout constraints), and
+# the control-flow trio (reference control_flow.cc:1089-1255) whose
+# callable-subgraph arguments pass through invoke untouched.
+registry.register_alias("_ctc_loss", "CTCLoss")
+registry.register_alias("Convolution", "Convolution_v1")
+registry.register_alias("Pooling", "Pooling_v1")
+register("_foreach", n_out=0)(contrib_ops.foreach)
+register("_while_loop", n_out=0)(contrib_ops.while_loop)
+register("_cond", n_out=0)(contrib_ops.cond)
+
 
 def populate_namespace(target, names=None):
     """Inject registered ops into a module/dict namespace (mx.nd codegen)."""

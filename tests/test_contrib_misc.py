@@ -151,3 +151,48 @@ def test_dgl_adjacency_and_subgraph():
     # edges kept: 0->3 (val 2), 3->2 (val 5), 2->0 (val 4)
     ref = np.array([[0, 2, 0], [0, 0, 5], [4, 0, 0]], "float32")
     np.testing.assert_array_equal(sub.asnumpy(), ref)
+
+
+def test_dgl_neighbor_sample_and_compact():
+    """reference dgl_graph.cc docstring example: 5-vertex complete graph,
+    2 uniform neighbors per seed, then compaction drops empty tails."""
+    import mxnet_tpu.ndarray.sparse as sp
+    dense = np.zeros((5, 5), "float32")
+    v = 1.0
+    for i in range(5):
+        for j in range(5):
+            if i != j:
+                dense[i, j] = v
+                v += 1
+    g = sp.csr_matrix(dense)
+    seed = mx.nd.array(np.arange(5, dtype="float32"))
+    verts, subg, layers = mx.nd.contrib.dgl_csr_neighbor_uniform_sample(
+        g, seed, num_args=2, num_hops=1, num_neighbor=2,
+        max_num_vertices=5)
+    vn = verts.asnumpy()
+    assert vn[-1] == 5 and sorted(vn[:5]) == [0, 1, 2, 3, 4]
+    sub = subg.asnumpy()
+    assert (sub != 0).sum() == 10  # 2 neighbors x 5 seeds
+    # every sampled edge value comes from the parent graph
+    assert set(sub[sub != 0].tolist()) <= set(dense[dense != 0].tolist())
+    assert (layers.asnumpy() == 0).all()  # seeds all at hop 0
+
+    comp = mx.nd.contrib.dgl_graph_compact(
+        subg, verts, graph_sizes=int(vn[-1]))
+    assert comp.shape == (5, 5)
+    assert (comp.asnumpy() != 0).sum() == 10
+
+
+def test_dgl_non_uniform_sample_respects_probability():
+    import mxnet_tpu.ndarray.sparse as sp
+    # star graph: vertex 0 -> 1..4; zero probability on vertices 3, 4
+    dense = np.zeros((5, 5), "float32")
+    dense[0, 1:] = [1, 2, 3, 4]
+    g = sp.csr_matrix(dense)
+    prob = mx.nd.array(np.array([1, 1, 1, 0, 0], "float32"))
+    verts, subg, _ = mx.nd.contrib.dgl_csr_neighbor_non_uniform_sample(
+        g, prob, mx.nd.array(np.array([0.0], "float32")), num_args=3,
+        num_hops=1, num_neighbor=2, max_num_vertices=5)
+    sub = subg.asnumpy()
+    assert sub[0, 3] == 0 and sub[0, 4] == 0  # zero-prob never sampled
+    assert (sub[0] != 0).sum() == 2
