@@ -17,10 +17,20 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
 import numpy as np
+
+if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+    # this host's TPU plugin captures JAX_PLATFORMS at interpreter start;
+    # only jax.config reliably forces the CPU platform (conftest recipe)
+    import jax
+    jax.config.update("jax_platforms", "cpu")
 
 
 DEFAULT_OPS = ["relu", "sigmoid", "tanh", "exp", "softmax", "log_softmax",
