@@ -107,3 +107,35 @@ class DummyIter:
 
     def __iter__(self):
         return iter(self._batches)
+
+
+def same(a, b):
+    """Exact array equality (reference test_utils.py same)."""
+    return np.array_equal(np.asarray(a.asnumpy() if hasattr(a, "asnumpy")
+                                     else a),
+                          np.asarray(b.asnumpy() if hasattr(b, "asnumpy")
+                                     else b))
+
+
+def rand_shape_2d(dim0=10, dim1=10):
+    """Random 2D shape (reference test_utils.py rand_shape_2d)."""
+    return (np.random.randint(1, dim0 + 1), np.random.randint(1, dim1 + 1))
+
+
+def rand_shape_3d(dim0=10, dim1=10, dim2=10):
+    return (np.random.randint(1, dim0 + 1), np.random.randint(1, dim1 + 1),
+            np.random.randint(1, dim2 + 1))
+
+
+def rand_shape_nd(num_dim, dim=10):
+    return tuple(np.random.randint(1, dim + 1, size=num_dim).tolist())
+
+
+def list_gpus():
+    """Enumerate accelerator ordinals (reference test_utils.py list_gpus —
+    here, TPU chips; empty on a CPU-only host)."""
+    import jax
+    try:
+        return [d.id for d in jax.devices() if d.platform != "cpu"]
+    except RuntimeError:
+        return []
