@@ -1,0 +1,19 @@
+"""Two-stage detector slice (RPN -> Proposal -> ROIAlign -> head) —
+mirrors the reference `example/rcnn/` pipeline on synthetic scenes."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                "example", "rcnn"))
+
+from train_frcnn import train, evaluate  # noqa: E402
+
+
+def test_frcnn_trains_and_proposes():
+    net, first, last = train(steps=50, log=lambda *a: None)
+    assert last < first * 0.2, "loss did not converge (%.3f -> %.3f)" \
+        % (first, last)
+    miou, acc = evaluate(net)
+    assert miou > 0.4, "proposals miss the object (mean best IoU %.3f)" \
+        % miou
+    assert acc >= 0.75, "head classification accuracy %.2f" % acc
