@@ -37,7 +37,7 @@ def main():
     from mxnet_tpu.gluon.model_zoo import vision
 
     batch = int(os.environ.get("BENCH_BATCH", "32"))
-    fused = int(os.environ.get("BENCH_FUSED", "64"))
+    fused = int(os.environ.get("BENCH_FUSED", "128"))
     repeat = int(os.environ.get("BENCH_REPEAT", "3"))
     image = int(os.environ.get("BENCH_IMAGE", "224"))
 
