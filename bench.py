@@ -11,7 +11,7 @@ amortizes host->device dispatch latency.
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": "img/s", "vs_baseline": N}
 
-Env knobs: BENCH_BATCH (32), BENCH_FUSED (steps per compiled span, 64),
+Env knobs: BENCH_BATCH (32), BENCH_FUSED (steps per compiled span, 128),
 BENCH_REPEAT (timed spans, 3), BENCH_IMAGE (224).
 """
 import json
