@@ -1,6 +1,7 @@
 """Symbol API (reference ``python/mxnet/symbol/``)."""
 from .symbol import (Symbol, var, Variable, Group, AttrScope, load,
                      load_json, zeros, ones, arange)
+from . import contrib  # noqa: F401  (mx.sym.contrib namespace)
 from .symbol import _populate_ops as _pop
 
 _pop(globals())

@@ -1,0 +1,17 @@
+"""mx.sym.contrib namespace (reference `python/mxnet/symbol/contrib.py`):
+contrib operators composed symbolically, plus the control-flow trio —
+`foreach`/`while_loop`/`cond` take Python callables over Symbols and trace
+them into the graph (the reference builds nnvm subgraph attributes;
+here the callable simply composes into the jitted program at bind time).
+"""
+from ..ops.registry import get_op as _get_op
+from ..ops.contrib_ops import foreach, while_loop, cond  # noqa: F401
+from .symbol import _sym_op
+
+
+def __getattr__(name):
+    if _get_op("_contrib_" + name) is not None:
+        return _sym_op("_contrib_" + name)
+    if _get_op(name) is not None:
+        return _sym_op(name)
+    raise AttributeError("no contrib symbol operator %r" % name)
