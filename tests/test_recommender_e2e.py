@@ -1,0 +1,25 @@
+"""Matrix-factorization recommender slice — mirrors reference
+`example/recommenders`: embedding factors recover a low-rank matrix."""
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                "example", "recommenders"))
+
+from matrix_fact import train  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+
+
+def test_mf_recovers_low_rank_matrix():
+    net, ratings, first, last = train(steps=150, log=lambda *a: None)
+    assert last < first * 0.05
+    nu, ni = ratings.shape
+    uu, ii = np.meshgrid(np.arange(nu), np.arange(ni), indexing="ij")
+    pred = net(mx.nd.array(uu.ravel().astype("float32")),
+               mx.nd.array(ii.ravel().astype("float32"))).asnumpy()
+    rmse = float(np.sqrt(np.mean((pred - ratings.ravel()) ** 2)))
+    assert rmse < 0.15 * ratings.std(), "RMSE %.4f vs std %.3f" % (
+        rmse, ratings.std())
