@@ -461,6 +461,14 @@ def topk(data, axis=-1, k=1, ret_typ="indices", is_ascend=False, dtype="float32"
         return vals
     if ret_typ == "both":
         return vals, idx.astype(dtype_np(dtype))
+    if ret_typ == "mask":
+        # 0/1 mask over the INPUT shape marking top-k positions
+        # (reference ordering_op-inl.h kReturnMask)
+        ax = axis if axis >= 0 else data.ndim + axis
+        onehot = jax.nn.one_hot(jnp.moveaxis(idx, ax, -1),
+                                data.shape[ax], dtype=data.dtype)
+        mask = onehot.sum(axis=-2)          # merge the k picks
+        return jnp.moveaxis(mask, -1, ax)
     return idx.astype(dtype_np(dtype))
 
 

@@ -1,0 +1,145 @@
+"""Dtype- and edge-case sweeps over the core op corpus — modeled on the
+breadth of reference `tests/python/unittest/test_operator.py` (dtype
+parametrization, take modes, sequence ops, degenerate shapes)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+FLOAT_DTYPES = ["float16", "float32", "float64"]
+INT_DTYPES = ["int32", "int64", "uint8", "int8"]
+
+
+@pytest.mark.parametrize("dtype", FLOAT_DTYPES + ["int32", "int64"])
+def test_elementwise_binary_dtypes(dtype):
+    a = np.array([[1, 2], [3, 4]], dtype)
+    b = np.array([[4, 3], [2, 1]], dtype)
+    for op, ref in [(mx.nd.broadcast_add, a + b),
+                    (mx.nd.broadcast_mul, a * b),
+                    (mx.nd.broadcast_maximum, np.maximum(a, b)),
+                    (mx.nd.broadcast_sub, a - b)]:
+        out = op(mx.nd.array(a, dtype=dtype), mx.nd.array(b, dtype=dtype))
+        assert str(out.dtype).endswith(dtype) or out.asnumpy().dtype == ref.dtype
+        np.testing.assert_allclose(np.asarray(out.asnumpy(), "float64"),
+                                   np.asarray(ref, "float64"), rtol=1e-3)
+
+
+@pytest.mark.parametrize("dtype", FLOAT_DTYPES)
+def test_reduce_keepdims_axes(dtype):
+    x = np.random.RandomState(0).rand(2, 3, 4).astype(dtype)
+    for axis in [0, 1, 2, (0, 2), None]:
+        for keepdims in [True, False]:
+            out = mx.nd.sum(mx.nd.array(x, dtype=dtype), axis=axis,
+                            keepdims=keepdims).asnumpy()
+            ref = np.sum(x, axis=axis, keepdims=keepdims)
+            np.testing.assert_allclose(np.asarray(out, "float64"),
+                                       np.asarray(ref, "float64"),
+                                       rtol=2e-2 if dtype == "float16"
+                                       else 1e-5)
+
+
+def test_take_modes():
+    x = np.arange(12, dtype="float32").reshape(4, 3)
+    idx = np.array([-1, 0, 3, 5], "float32")
+    # clip mode (default)
+    out = mx.nd.take(mx.nd.array(x), mx.nd.array(idx), mode="clip")
+    ref = x[np.clip(idx.astype(int), 0, 3)]
+    np.testing.assert_allclose(out.asnumpy(), ref)
+    # wrap mode
+    out = mx.nd.take(mx.nd.array(x), mx.nd.array(idx), mode="wrap")
+    ref = x[idx.astype(int) % 4]
+    np.testing.assert_allclose(out.asnumpy(), ref)
+
+
+def test_gather_scatter_roundtrip():
+    x = np.random.RandomState(1).rand(3, 4).astype("float32")
+    idx = np.array([[0, 2, 1], [1, 3, 0]], "float32")  # (2, M) for 2D
+    got = mx.nd.gather_nd(mx.nd.array(x), mx.nd.array(idx)).asnumpy()
+    ref = x[idx[0].astype(int), idx[1].astype(int)]
+    np.testing.assert_allclose(got, ref)
+    back = mx.nd.scatter_nd(mx.nd.array(ref), mx.nd.array(idx),
+                            shape=(3, 4)).asnumpy()
+    expect = np.zeros((3, 4), "float32")
+    expect[idx[0].astype(int), idx[1].astype(int)] = ref
+    np.testing.assert_allclose(back, expect)
+
+
+def test_one_hot_dtype_and_values():
+    out = mx.nd.one_hot(mx.nd.array(np.array([0, 2], "float32")), 3,
+                        on_value=5.0, off_value=-1.0)
+    np.testing.assert_allclose(out.asnumpy(),
+                               [[5, -1, -1], [-1, -1, 5]])
+
+
+def test_pick_modes():
+    x = np.array([[1.0, 2.0, 3.0], [4.0, 5.0, 6.0]], "float32")
+    idx = np.array([1, 2], "float32")
+    out = mx.nd.pick(mx.nd.array(x), mx.nd.array(idx), axis=1)
+    np.testing.assert_allclose(out.asnumpy(), [2.0, 6.0])
+    out = mx.nd.pick(mx.nd.array(x), mx.nd.array(idx), axis=1,
+                     keepdims=True)
+    assert out.shape == (2, 1)
+
+
+def test_sequence_ops():
+    # (T, B, ...) layout, use_sequence_length
+    x = np.arange(2 * 3 * 2, dtype="float32").reshape(3, 2, 2)
+    slen = np.array([2, 3], "float32")
+    m = mx.nd.SequenceMask(mx.nd.array(x), mx.nd.array(slen),
+                           use_sequence_length=True, value=-1.0).asnumpy()
+    assert (m[2, 0] == -1.0).all()          # beyond len 2 masked
+    np.testing.assert_allclose(m[2, 1], x[2, 1])  # len-3 col untouched
+    last = mx.nd.SequenceLast(mx.nd.array(x), mx.nd.array(slen),
+                              use_sequence_length=True).asnumpy()
+    np.testing.assert_allclose(last[0], x[1, 0])
+    np.testing.assert_allclose(last[1], x[2, 1])
+    rev = mx.nd.SequenceReverse(mx.nd.array(x), mx.nd.array(slen),
+                                use_sequence_length=True).asnumpy()
+    np.testing.assert_allclose(rev[0, 0], x[1, 0])
+    np.testing.assert_allclose(rev[0, 1], x[2, 1])
+
+
+def test_space_depth_roundtrip():
+    x = np.random.RandomState(2).rand(1, 4, 2, 2).astype("float32")
+    y = mx.nd.depth_to_space(mx.nd.array(x), 2)
+    assert y.shape == (1, 1, 4, 4)
+    back = mx.nd.space_to_depth(y, 2).asnumpy()
+    np.testing.assert_allclose(back, x)
+
+
+def test_topk_variants():
+    x = np.array([[3.0, 1.0, 2.0], [0.0, 5.0, 4.0]], "float32")
+    v = mx.nd.topk(mx.nd.array(x), k=2, ret_typ="value").asnumpy()
+    np.testing.assert_allclose(v, [[3.0, 2.0], [5.0, 4.0]])
+    i = mx.nd.topk(mx.nd.array(x), k=1, ret_typ="indices").asnumpy()
+    np.testing.assert_allclose(i.ravel(), [0, 1])
+    b = mx.nd.topk(mx.nd.array(x), k=2, ret_typ="mask").asnumpy()
+    np.testing.assert_allclose(b, [[1, 0, 1], [0, 1, 1]])
+
+
+def test_degenerate_shapes():
+    # size-1 dims and scalars flow through core ops
+    x = mx.nd.array(np.ones((1, 1), "float32"))
+    assert float(mx.nd.sum(x).asnumpy()) == 1.0
+    s = mx.nd.array(np.float32(3.0).reshape(()))
+    assert s.shape == ()
+    assert float((s * 2).asnumpy()) == 6.0
+    # broadcasting against size-1 axes
+    a = mx.nd.array(np.ones((2, 1, 3), "float32"))
+    b = mx.nd.array(np.ones((1, 4, 1), "float32"))
+    assert mx.nd.broadcast_add(a, b).shape == (2, 4, 3)
+
+
+@pytest.mark.parametrize("dtype", ["float16", "bfloat16"])
+def test_low_precision_matmul(dtype):
+    a = np.random.RandomState(3).rand(8, 8).astype("float32")
+    am = mx.nd.array(a).astype(dtype)
+    out = mx.nd.dot(am, am).astype("float32").asnumpy()
+    np.testing.assert_allclose(out, a @ a, rtol=0.06, atol=0.06)
+
+
+def test_cast_integer_float_boundaries():
+    x = mx.nd.array(np.array([1.7, -1.7, 255.4], "float32"))
+    assert mx.nd.cast(x, "int32").asnumpy().tolist() == [1, -1, 255]
+    u = mx.nd.cast(mx.nd.array(np.array([300.0], "float32")), "uint8")
+    assert u.asnumpy().dtype == np.uint8
