@@ -112,6 +112,16 @@ def hawkesll(lda, alpha, beta, state, lags, marks, valid_length, max_time):
     return ll, s_out
 
 
+@register("_contrib_moe_ffn", aliases=("moe_ffn",), n_out=2)
+def moe_ffn_op(data, gate_w, w1, w2, capacity_factor=1.25):
+    """Switch-style top-1 MoE FFN (beyond-reference; parallel/moe.py holds
+    the math + the expert-parallel ``moe_ffn_sharded`` variant). Returns
+    (output, load-balancing aux loss)."""
+    from ..parallel.moe import moe_ffn as _impl
+    return _impl(data, gate_w, w1, w2,
+                 capacity_factor=float(capacity_factor))
+
+
 # SparseEmbedding: same math as Embedding; the row-sparse gradient storage
 # optimization is a GPU-memory concern the TPU build handles densely
 # (SURVEY §5.9 sanctions the dense fallback; reference
