@@ -109,8 +109,14 @@ class Module(BaseModule):
     @property
     def output_shapes(self):
         assert self.binded
-        return [(n, tuple(o.shape)) for n, o in
-                zip(self._output_names, self._exec.outputs)]
+        if self._exec.outputs:  # populated after the first forward
+            return [(n, tuple(o.shape)) for n, o in
+                    zip(self._output_names, self._exec.outputs)]
+        # before any forward: static inference from the bound input shapes
+        # (reference GraphExecutor knows shapes at bind time)
+        shape_kwargs = dict(self._data_shapes + self._label_shapes)
+        _, out_shapes, _ = self._symbol.infer_shape(**shape_kwargs)
+        return list(zip(self._output_names, map(tuple, out_shapes)))
 
     # ---- params -----------------------------------------------------------
     def get_params(self):

@@ -143,3 +143,57 @@ def test_bucketing_module():
     mod.update()
     out = mod.get_outputs()[0]
     assert out.shape == (4, 4)
+
+
+def test_sequential_module_chains_and_trains():
+    """reference module/sequential_module.py: feature module -> head module
+    trained end-to-end through the chain."""
+    feat = mx.sym.Activation(mx.sym.FullyConnected(
+        mx.sym.var("data"), num_hidden=16, name="fc_feat"), act_type="relu")
+    head_in = mx.sym.var("feat_data")
+    head = mx.sym.SoftmaxOutput(mx.sym.FullyConnected(
+        head_in, num_hidden=2, name="fc_head"), mx.sym.var("softmax_label"),
+        name="softmax")
+
+    m1 = mx.mod.Module(feat, data_names=("data",), label_names=())
+    m2 = mx.mod.Module(head, data_names=("feat_data",),
+                       label_names=("softmax_label",))
+    seq = mx.mod.SequentialModule()
+    seq.add(m1).add(m2, take_labels=True)
+    seq.bind(data_shapes=[("data", (8, 4))],
+             label_shapes=[("softmax_label", (8,))])
+    seq.init_params(mx.init.Xavier())
+    # SoftmaxOutput injects SUM-normalized gradients (reference
+    # normalization='null'), so keep the rate small to avoid oscillation
+    seq.init_optimizer(optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.05),))
+
+    rng = np.random.RandomState(0)
+    x = rng.rand(8, 4).astype("float32")
+    y = (x.sum(axis=1) > 2.0).astype("float32")
+    batch = mx.io.DataBatch(data=[mx.nd.array(x)],
+                            label=[mx.nd.array(y)])
+    losses = []
+    for _ in range(100):
+        seq.forward(batch, is_train=True)
+        probs = seq.get_outputs()[0].asnumpy()
+        losses.append(-np.log(np.maximum(
+            probs[np.arange(8), y.astype(int)], 1e-9)).mean())
+    # train
+        seq.backward()
+        seq.update()
+    assert losses[-1] < losses[0] * 0.7, (losses[0], losses[-1])
+
+
+def test_python_module_compute():
+    class Mean(mx.mod.PythonModule):
+        def compute(self, data, labels=None):
+            return [data[0].mean(axis=1)]
+
+    m = Mean(data_names=("data",), label_names=None)
+    m.bind(data_shapes=[("data", (2, 3))])
+    batch = mx.io.DataBatch(
+        data=[mx.nd.array(np.arange(6, dtype="float32").reshape(2, 3))],
+        label=None)
+    m.forward(batch)
+    np.testing.assert_allclose(m.get_outputs()[0].asnumpy(), [1.0, 4.0])
