@@ -122,14 +122,19 @@ def _neighbor_sample(graph, seeds, num_hops, num_neighbor,
                 vals = d[ptr[v]:ptr[v + 1]]
                 if cols.size == 0:
                     continue
-                k = min(int(num_neighbor), cols.size)
                 if pv is None:
+                    k = min(int(num_neighbor), cols.size)
                     pick = rng.choice(cols.size, size=k, replace=False)
                 else:
                     w = pv[cols]
-                    w = w / w.sum() if w.sum() > 0 else None
+                    nz = int((w > 0).sum())
+                    if nz == 0:
+                        continue
+                    # without-replacement draws need >= k positive-prob
+                    # entries or np.random.choice raises
+                    k = min(int(num_neighbor), nz)
                     pick = rng.choice(cols.size, size=k, replace=False,
-                                      p=w)
+                                      p=w / w.sum())
                 for j in pick:
                     nb = int(cols[j])
                     edges.append((v, nb, vals[j]))

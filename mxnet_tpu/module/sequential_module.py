@@ -84,8 +84,13 @@ class SequentialModule(BaseModule):
                    inputs_need_grad=(i > 0))
             if not last:
                 nxt = self._modules[i + 1]
-                cur_shapes = [(nxt.data_names[0], s)
-                              for (_n, s) in m.output_shapes]
+                outs = m.output_shapes
+                assert len(outs) <= len(nxt.data_names), (
+                    "module %d produces %d outputs but module %d declares "
+                    "%d data inputs" % (i, len(outs), i + 1,
+                                        len(nxt.data_names)))
+                cur_shapes = [(dn, s) for dn, (_n, s)
+                              in zip(nxt.data_names, outs)]
         self.binded = True
 
     def init_optimizer(self, kvstore="local", optimizer="sgd",

@@ -262,13 +262,25 @@ def MultiProposal(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=6000,
 @register("_contrib_PSROIPooling", aliases=("PSROIPooling",))
 def PSROIPooling(data, rois, spatial_scale=1.0, output_dim=0, pooled_size=7,
                  group_size=0):
-    """Position-sensitive ROI pooling (reference contrib/psroi_pooling).
+    """Position-sensitive ROI pooling (reference contrib/psroi_pooling):
+    output bin (i, j) of a pooled p x p grid reads channel group
+    (floor(i*g/p), floor(j*g/p)) of the g x g score maps.
     Deviation: bins are sampled with the ROIAlign bilinear 2x2 grid instead
     of integer-bin averaging — static shapes, and strictly more accurate."""
-    g = int(group_size) or int(pooled_size)
-    return roi_align.fn(data, rois, pooled_size=(g, g),
-                        spatial_scale=float(spatial_scale), sample_ratio=2,
-                        position_sensitive=True)
+    p = int(pooled_size)
+    g = int(group_size) or p
+    C = data.shape[1]
+    cdim = C // (g * g)
+    pooled = roi_align.fn(data, rois, pooled_size=(p, p),
+                          spatial_scale=float(spatial_scale),
+                          sample_ratio=2, position_sensitive=False)
+    grp = pooled.reshape(pooled.shape[0], cdim, g * g, p, p)
+    gi = (jnp.arange(p) * g) // p                       # (p,)
+    bin_idx = gi[:, None] * g + gi[None, :]             # (p, p)
+    sel = jnp.take_along_axis(
+        grp, jnp.broadcast_to(bin_idx[None, None, None],
+                              (pooled.shape[0], cdim, 1, p, p)), axis=2)
+    return sel[:, :, 0]
 
 
 @register("_contrib_RROIAlign", aliases=("RROIAlign",))
@@ -344,11 +356,17 @@ def DeformablePSROIPooling(data, rois, trans=None, spatial_scale=1.0,
             + offx[:, :, None, None]                      # (p, p, 1, sr)
         ys = jnp.broadcast_to(ys, (p, p, sr, sr)).reshape(p, p * sr * sr)
         xs = jnp.broadcast_to(xs, (p, p, sr, sr)).reshape(p, p * sr * sr)
+        # ROIAlign convention: clamp sample coords into the feature map
+        # instead of zero-padding at the border
+        ys = jnp.clip(ys, 0.0, data.shape[2] - 1.0)
+        xs = jnp.clip(xs, 0.0, data.shape[3] - 1.0)
         val = _sample_one(data[bidx], xs, ys)             # (C, p, p*sr*sr)
         val = val.reshape(C, p, p, sr * sr).mean(-1)      # (C, p, p)
         grp = val.reshape(cdim, g * g, p, p)
-        bin_idx = (jnp.arange(p)[:, None] % g) * g + (jnp.arange(p)[None, :]
-                                                      % g)
+        # bin (i, j) reads score-map group (i*g//p, j*g//p) — reference
+        # deformable_psroi_pooling-inl.h gh/gw floor mapping
+        gi = (jnp.arange(p) * g) // p
+        bin_idx = gi[:, None] * g + gi[None, :]
         sel = jnp.take_along_axis(
             grp, bin_idx[None, None].repeat(cdim, 0), axis=1)[:, 0]
         return sel

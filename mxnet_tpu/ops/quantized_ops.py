@@ -47,7 +47,7 @@ def quantize_v2(data, min_calib_range=None, max_calib_range=None,
         mn = jnp.float32(min_calib_range)
         mx = jnp.float32(max_calib_range)
     if out_type == "uint8":
-        scale = (mx - mn) / 255.0
+        scale = jnp.maximum((mx - mn) / 255.0, 1e-12)
         q = jnp.clip(jnp.round((data - mn) / scale), 0, 255).astype(jnp.uint8)
         return q, _r1(mn), _r1(mx)
     amax = jnp.maximum(_maxabs(mn, mx), 1e-12)
@@ -64,7 +64,7 @@ def quantize(data, min_range, max_range, out_type="uint8"):
     mn = jnp.asarray(min_range).reshape(()).astype(jnp.float32)
     mx = jnp.asarray(max_range).reshape(()).astype(jnp.float32)
     if out_type == "uint8":
-        scale = (mx - mn) / 255.0
+        scale = jnp.maximum((mx - mn) / 255.0, 1e-12)
         q = jnp.clip(jnp.round((data - mn) / scale), 0, 255).astype(jnp.uint8)
         return q, _r1(mn), _r1(mx)
     amax = jnp.maximum(_maxabs(mn, mx), 1e-12)
@@ -78,7 +78,7 @@ def dequantize(data, min_range, max_range, out_type="float32"):
     mn = jnp.asarray(min_range).reshape(()).astype(jnp.float32)
     mx = jnp.asarray(max_range).reshape(()).astype(jnp.float32)
     if data.dtype == jnp.uint8:
-        scale = (mx - mn) / 255.0
+        scale = jnp.maximum((mx - mn) / 255.0, 1e-12)
         return data.astype(jnp.float32) * scale + mn
     if data.dtype == jnp.int32:
         scale = _maxabs(mn, mx) / _INT32_MAX
@@ -174,7 +174,8 @@ def quantized_pooling(data, min_data=0.0, max_data=0.0, kernel=(),
         out = pool.fn(data.astype(jnp.int32), kernel=kernel,
                       pool_type="avg", stride=stride, pad=pad,
                       global_pool=global_pool)
-        out = jnp.clip(jnp.round(out), -127, 127).astype(data.dtype)
+        lo, hi = ((0, 255) if data.dtype == jnp.uint8 else (-127, 127))
+        out = jnp.clip(jnp.round(out), lo, hi).astype(data.dtype)
     else:
         # the generic Pooling kernel's -inf init value has no int8 analogue;
         # widen to int32 for the reduce-window, payload is exact either way

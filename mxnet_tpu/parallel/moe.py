@@ -63,7 +63,7 @@ def _route(x, gate_w, capacity):
     aux = E * jnp.sum(density * density_proxy)
     # position of each token within its expert (0-based), capacity mask
     pos = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot            # (T, E)
-    pos_tok = jnp.sum(pos, axis=-1)                              # (T,)
+    pos_tok = jnp.sum(pos, axis=-1).astype(jnp.int32)            # (T,)
     keep = (pos_tok < capacity)
     pos_oh = jax.nn.one_hot(pos_tok, capacity, dtype=x.dtype)    # (T, C)
     dispatch = (onehot * keep[:, None])[:, :, None] * \

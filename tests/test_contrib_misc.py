@@ -196,3 +196,18 @@ def test_dgl_non_uniform_sample_respects_probability():
     sub = subg.asnumpy()
     assert sub[0, 3] == 0 and sub[0, 4] == 0  # zero-prob never sampled
     assert (sub[0] != 0).sum() == 2
+
+
+def test_dgl_non_uniform_sample_sparse_probability():
+    """Fewer positive-probability neighbors than num_neighbor must not
+    raise (regression: np.random.choice p-vector check)."""
+    import mxnet_tpu.ndarray.sparse as sp
+    dense = np.zeros((4, 4), "float32")
+    dense[0, 1:] = [1, 2, 3]
+    g = sp.csr_matrix(dense)
+    prob = mx.nd.array(np.array([0, 1, 0, 0], "float32"))
+    verts, subg, _ = mx.nd.contrib.dgl_csr_neighbor_non_uniform_sample(
+        g, prob, mx.nd.array(np.array([0.0], "float32")), num_args=3,
+        num_hops=1, num_neighbor=3, max_num_vertices=4)
+    sub = subg.asnumpy()
+    assert (sub[0] != 0).sum() == 1 and sub[0, 1] == 1

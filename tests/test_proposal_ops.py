@@ -198,3 +198,37 @@ def test_deformable_psroi_pooling_no_trans():
         output_dim=cdim, group_size=g, pooled_size=g, no_trans=True)
     assert out.shape == (1, cdim, g, g)
     assert np.isfinite(out.asnumpy()).all()
+
+
+def test_psroi_pooling_group_not_equal_pooled():
+    """group_size != pooled_size: output keeps the pooled grid and bin
+    (i, j) reads channel group floor(i*g/p), floor(j*g/p) (regression:
+    modulo tiling / group-sized output)."""
+    g, p, cdim = 2, 4, 1
+    C = cdim * g * g
+    data = np.zeros((1, C, 8, 8), "float32")
+    for c in range(C):
+        data[0, c] = c
+    rois = np.array([[0, 0, 0, 7, 7]], "float32")
+    out = mx.nd.contrib.PSROIPooling(
+        mx.nd.array(data), mx.nd.array(rois), spatial_scale=1.0,
+        output_dim=cdim, pooled_size=p, group_size=g).asnumpy()
+    assert out.shape == (1, cdim, p, p)
+    ref = np.array([[0, 0, 1, 1], [0, 0, 1, 1],
+                    [2, 2, 3, 3], [2, 2, 3, 3]], "float32")
+    np.testing.assert_allclose(out[0, 0], ref, atol=1e-5)
+
+
+def test_deformable_psroi_group_mapping():
+    g, p, cdim = 2, 4, 1
+    C = cdim * g * g
+    data = np.zeros((1, C, 8, 8), "float32")
+    for c in range(C):
+        data[0, c] = c
+    rois = np.array([[0, 0, 0, 8, 8]], "float32")
+    (out,) = mx.nd.contrib.DeformablePSROIPooling(
+        mx.nd.array(data), mx.nd.array(rois), None, spatial_scale=1.0,
+        output_dim=cdim, group_size=g, pooled_size=p, no_trans=True)
+    ref = np.array([[0, 0, 1, 1], [0, 0, 1, 1],
+                    [2, 2, 3, 3], [2, 2, 3, 3]], "float32")
+    np.testing.assert_allclose(out.asnumpy()[0, 0], ref, atol=1e-5)

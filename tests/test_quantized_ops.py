@@ -142,3 +142,29 @@ def test_calibrate_entropy_reasonable_threshold():
     # KL threshold for a unit gaussian should clip well inside the tail
     assert 1.0 < t < 8.0
     assert float(mn.asnumpy()[0]) == -t
+
+
+def test_quantized_avg_pool_uint8_range():
+    """uint8 payloads above 127 must survive avg pooling (regression:
+    the clamp used int8 bounds)."""
+    data = np.full((1, 1, 4, 4), 200, "uint8")
+    mn, mx_ = mx.nd.array([0.0]), mx.nd.array([2.0])
+    out, omn, omx = mx.nd.contrib.quantized_pooling(
+        mx.nd.array(data), mn, mx_, kernel=(2, 2), stride=(2, 2),
+        pool_type="avg")
+    assert out.asnumpy().dtype == np.uint8
+    np.testing.assert_array_equal(out.asnumpy(), 200)
+
+
+def test_quantize_constant_tensor_no_nan():
+    """min == max (constant activations) must not divide by zero."""
+    x = np.zeros((2, 3), "float32")
+    q, mn, mx_ = mx.nd.contrib.quantize_v2(mx.nd.array(x),
+                                           out_type="uint8")
+    assert np.isfinite(mx.nd.contrib.dequantize(q, mn, mx_)
+                       .asnumpy()).all()
+    q2, mn2, mx2 = mx.nd.contrib.quantize(
+        mx.nd.array(x), mx.nd.array([1.0]), mx.nd.array([1.0]),
+        out_type="uint8")
+    back = mx.nd.contrib.dequantize(q2, mn2, mx2).asnumpy()
+    assert np.isfinite(back).all()
