@@ -55,22 +55,43 @@ def score(model_name, batch, image_shape, dtype, repeat=3, iters=20):
     net(mx.nd.zeros((1, c, h, w)))
     if dtype == "bfloat16":
         net.cast("bfloat16")
+    elif dtype == "int8":
+        # real int8 path: conv/dense swapped for int8 blocks with ranges
+        # calibrated on one batch (docs/quantization.md)
+        from mxnet_tpu.contrib.quantization import quantize_net
+        calib = mx.nd.array(np.random.rand(batch, c, h, w)
+                            .astype("float32"))
+        net = quantize_net(net, calib_data=[calib], calib_mode="naive")
     pure, params = functionalize(net, train=False)
     pvals = [p.data()._data for p in params]
     key = jax.random.PRNGKey(0)
+
+    # image sizes below the model's design resolution can pool down to an
+    # EMPTY output tensor, which XLA then rightly dead-codes to nothing —
+    # refuse to report a meaningless number
+    (probe,), _ = pure(key, pvals, jnp.zeros(
+        (1, c, h, w), jnp.bfloat16 if dtype == "bfloat16" else jnp.float32))
+    if probe.size == 0:
+        raise ValueError(
+            "%s produces an empty output at %dx%d — use a larger "
+            "--image-shape" % (model_name, h, w))
 
     @jax.jit
     def many(x):
         def body(carry, _):
             (out,), _aux = pure(key, pvals, carry)
-            # feed a hash of the output back in so XLA cannot dead-code or
-            # overlap iterations; shapes stay constant
-            return carry + 0 * jnp.mean(out).astype(carry.dtype), ()
+            # feed the output back in so XLA cannot dead-code or overlap
+            # iterations. NOTE: `0 * mean` or a denormal multiplier is NOT
+            # safe — XLA folds provably-non-NaN chains away (verified: int8
+            # nets got fully eliminated). 1e-6 keeps a real serial data
+            # dependency; the ~1e-6 input drift is irrelevant for timing.
+            return carry + 1e-6 * jnp.mean(out).astype(carry.dtype), ()
         final, _ = jax.lax.scan(body, x, None, length=iters)
         return final
 
-    x = jnp.asarray(np.random.rand(batch, c, h, w),
-                    jnp.bfloat16 if dtype == "bfloat16" else jnp.float32)
+    x = jnp.asarray(np.random.rand(batch, c, h, w).astype("float32"))
+    if dtype == "bfloat16":
+        x = x.astype(jnp.bfloat16)
     np.asarray(many(x))  # compile + warm
     best = 0.0
     for _ in range(repeat):
@@ -88,7 +109,7 @@ def main():
     ap.add_argument("--batch-size", default="1,32",
                     help="comma-separated batch sizes")
     ap.add_argument("--dtype", default="bfloat16",
-                    choices=["float32", "bfloat16"])
+                    choices=["float32", "bfloat16", "int8"])
     ap.add_argument("--image-shape", default="3,224,224")
     args = ap.parse_args()
 
