@@ -127,7 +127,12 @@ def main():
     names = list(MODELS) if args.model == "all" else args.model.split(",")
     for name in names:
         for b in (int(v) for v in args.batch_size.split(",")):
-            img_s = score(name, b, shape, args.dtype, iters=args.iters)
+            try:
+                img_s = score(name, b, shape, args.dtype, iters=args.iters)
+            except ValueError as e:  # e.g. empty output at this resolution
+                print("model: %s, dtype: %s, batch: %d, SKIPPED (%s)"
+                      % (name, args.dtype, b, e), flush=True)
+                continue
             print("model: %s, dtype: %s, batch: %d, images/sec: %.2f"
                   % (name, args.dtype, b, img_s), flush=True)
 
