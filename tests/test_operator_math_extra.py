@@ -1,0 +1,109 @@
+"""Oracle checks for special-function / linalg / indexing ops not covered
+by the main oracle suite — numpy/scipy-free references derived inline
+(reference tests/python/unittest/test_operator.py breadth)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd as ag
+
+
+def test_erf_erfinv_roundtrip():
+    x = mx.nd.array(np.linspace(-0.9, 0.9, 7).astype("float32"))
+    y = mx.nd.erf(mx.nd.erfinv(x))
+    np.testing.assert_allclose(y.asnumpy(), x.asnumpy(), atol=1e-4)
+
+
+def test_gamma_and_gammaln():
+    x = np.array([1.0, 2.0, 3.0, 4.5], "float32")
+    g = mx.nd.gamma(mx.nd.array(x)).asnumpy()
+    np.testing.assert_allclose(g[:3], [1.0, 1.0, 2.0], rtol=1e-5)
+    gl = mx.nd.gammaln(mx.nd.array(x)).asnumpy()
+    np.testing.assert_allclose(np.exp(gl), g, rtol=1e-4)
+
+
+def test_smooth_l1():
+    x = np.array([-2.0, -0.5, 0.0, 0.5, 2.0], "float32")
+    out = mx.nd.smooth_l1(mx.nd.array(x), scalar=1.0).asnumpy()
+    ref = np.where(np.abs(x) < 1.0, 0.5 * x * x, np.abs(x) - 0.5)
+    np.testing.assert_allclose(out, ref, atol=1e-6)
+
+
+def test_hard_sigmoid_and_softsign():
+    x = np.array([-4.0, -1.0, 0.0, 1.0, 4.0], "float32")
+    hs = mx.nd.hard_sigmoid(mx.nd.array(x)).asnumpy()
+    np.testing.assert_allclose(hs, np.clip(0.2 * x + 0.5, 0, 1),
+                               atol=1e-6)
+    ss = mx.nd.softsign(mx.nd.array(x)).asnumpy()
+    np.testing.assert_allclose(ss, x / (1 + np.abs(x)), atol=1e-6)
+
+
+def test_log_softmax_stability():
+    # huge logits must not overflow
+    x = np.array([[1000.0, 1000.0, 999.0]], "float32")
+    out = mx.nd.log_softmax(mx.nd.array(x)).asnumpy()
+    assert np.isfinite(out).all()
+    np.testing.assert_allclose(np.exp(out).sum(), 1.0, rtol=1e-5)
+
+
+def test_rsqrt_rcbrt_grad():
+    x = mx.nd.array(np.array([1.0, 4.0, 9.0], "float32"))
+    x.attach_grad()
+    with ag.record():
+        y = mx.nd.rsqrt(x)
+    y.backward()
+    np.testing.assert_allclose(y.asnumpy(), [1.0, 0.5, 1.0 / 3], rtol=1e-5)
+    np.testing.assert_allclose(x.grad.asnumpy(),
+                               -0.5 * np.array([1.0, 4.0, 9.0]) ** -1.5,
+                               rtol=1e-4)
+
+
+def test_khatri_rao():
+    a = np.array([[1.0, 2.0], [3.0, 4.0]], "float32")
+    b = np.array([[5.0, 6.0], [7.0, 8.0], [9.0, 10.0]], "float32")
+    out = mx.nd.khatri_rao(mx.nd.array(a), mx.nd.array(b)).asnumpy()
+    # column-wise kronecker: out[:, j] = kron(a[:, j], b[:, j])
+    ref = np.stack([np.kron(a[:, j], b[:, j]) for j in range(2)], axis=1)
+    np.testing.assert_allclose(out, ref, atol=1e-5)
+
+
+def test_linalg_gemm_and_potrf():
+    rng = np.random.RandomState(0)
+    A = rng.randn(3, 4).astype("float32")
+    B = rng.randn(4, 5).astype("float32")
+    C = rng.randn(3, 5).astype("float32")
+    out = mx.nd.linalg_gemm(mx.nd.array(A), mx.nd.array(B),
+                            mx.nd.array(C), alpha=2.0, beta=0.5).asnumpy()
+    np.testing.assert_allclose(out, 2.0 * A @ B + 0.5 * C, rtol=1e-4)
+
+    M = rng.randn(4, 4).astype("float32")
+    spd = M @ M.T + 4 * np.eye(4, dtype="float32")
+    L = mx.nd.linalg_potrf(mx.nd.array(spd)).asnumpy()
+    np.testing.assert_allclose(L @ L.T, spd, rtol=1e-4, atol=1e-4)
+    assert np.allclose(L, np.tril(L))
+
+
+def test_ravel_unravel_roundtrip():
+    shape = (3, 4)
+    idx = np.array([[0, 1, 2], [1, 2, 3]], "float32")  # (ndim, n) coords
+    flat = mx.nd.ravel_multi_index(mx.nd.array(idx), shape=shape)
+    np.testing.assert_allclose(flat.asnumpy(), [1, 6, 11])
+    back = mx.nd.unravel_index(flat, shape=shape).asnumpy()
+    np.testing.assert_allclose(back, idx)
+
+
+def test_shuffle_is_permutation():
+    x = np.arange(10, dtype="float32")
+    out = mx.nd.shuffle(mx.nd.array(x)).asnumpy()
+    np.testing.assert_array_equal(np.sort(out), x)
+
+
+def test_diag_and_trace():
+    x = np.arange(9, dtype="float32").reshape(3, 3)
+    np.testing.assert_array_equal(mx.nd.diag(mx.nd.array(x)).asnumpy(),
+                                  [0, 4, 8])
+    np.testing.assert_array_equal(
+        mx.nd.diag(mx.nd.array(x), k=1).asnumpy(), [1, 5])
+    # vector -> matrix embedding
+    d = mx.nd.diag(mx.nd.array(np.array([1.0, 2.0], "float32"))).asnumpy()
+    np.testing.assert_array_equal(d, [[1, 0], [0, 2]])
