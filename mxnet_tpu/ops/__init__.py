@@ -39,6 +39,14 @@ register("_foreach", n_out=0)(contrib_ops.foreach)
 register("_while_loop", n_out=0)(contrib_ops.while_loop)
 register("_cond", n_out=0)(contrib_ops.cond)
 
+# the `_sample_*` ops are public `mx.nd.sample_*` in the reference
+# (tests/python/unittest/test_operator.py:9320 mx.nd.sample_normal)
+for _s in ("normal", "uniform", "exponential", "gamma", "poisson",
+           "negative_binomial", "generalized_negative_binomial",
+           "unique_zipfian"):
+    if get_op("sample_" + _s) is None and get_op("_sample_" + _s):
+        registry.register_alias("_sample_" + _s, "sample_" + _s)
+
 
 def populate_namespace(target, names=None):
     """Inject registered ops into a module/dict namespace (mx.nd codegen)."""

@@ -1,0 +1,124 @@
+"""Gluon loss zoo vs closed-form numpy oracles — semantics from reference
+`python/mxnet/gluon/loss.py` and `tests/python/unittest/test_loss.py`."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+
+L = gluon.loss
+rng = np.random.RandomState(0)
+
+
+def _nd(a):
+    return mx.nd.array(np.asarray(a, "float32"))
+
+
+def test_l1_l2():
+    p = rng.randn(4, 3).astype("float32")
+    t = rng.randn(4, 3).astype("float32")
+    np.testing.assert_allclose(L.L1Loss()(_nd(p), _nd(t)).asnumpy(),
+                               np.abs(p - t).mean(axis=1), rtol=1e-5)
+    np.testing.assert_allclose(L.L2Loss()(_nd(p), _nd(t)).asnumpy(),
+                               ((p - t) ** 2).mean(axis=1) / 2, rtol=1e-5)
+
+
+def test_huber():
+    p = np.array([[0.0, 3.0]], "float32")
+    t = np.array([[0.5, 0.0]], "float32")
+    out = L.HuberLoss(rho=1.0)(_nd(p), _nd(t)).asnumpy()
+    # |0-0.5|=0.5 -> quadratic 0.125 ; |3|=3 -> linear 3-0.5=2.5
+    np.testing.assert_allclose(out, [(0.125 + 2.5) / 2], rtol=1e-5)
+
+
+def test_sigmoid_bce_from_logits_and_probs():
+    z = rng.randn(3, 4).astype("float32")
+    y = (rng.rand(3, 4) > 0.5).astype("float32")
+    ref = (np.maximum(z, 0) - z * y + np.log1p(np.exp(-np.abs(z))))
+    out = L.SigmoidBinaryCrossEntropyLoss(from_sigmoid=False)(
+        _nd(z), _nd(y)).asnumpy()
+    np.testing.assert_allclose(out, ref.mean(axis=1), rtol=1e-4)
+    p = 1 / (1 + np.exp(-z))
+    out2 = L.SigmoidBinaryCrossEntropyLoss(from_sigmoid=True)(
+        _nd(p), _nd(y)).asnumpy()
+    np.testing.assert_allclose(out2, out, rtol=1e-3, atol=1e-4)
+
+
+def test_softmax_ce_sparse_and_dense():
+    z = rng.randn(5, 4).astype("float32")
+    y = rng.randint(0, 4, 5).astype("float32")
+    ls = z - np.log(np.exp(z).sum(axis=1, keepdims=True))
+    ref = -ls[np.arange(5), y.astype(int)]
+    out = L.SoftmaxCrossEntropyLoss()(_nd(z), _nd(y)).asnumpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-4)
+    onehot = np.eye(4, dtype="float32")[y.astype(int)]
+    out2 = L.SoftmaxCrossEntropyLoss(sparse_label=False)(
+        _nd(z), _nd(onehot)).asnumpy()
+    np.testing.assert_allclose(out2, ref, rtol=1e-4)
+
+
+def test_kldiv():
+    p = rng.rand(3, 4).astype("float32") + 0.1
+    p /= p.sum(axis=1, keepdims=True)
+    q = rng.rand(3, 4).astype("float32") + 0.1
+    q /= q.sum(axis=1, keepdims=True)
+    logq = np.log(q)
+    out = L.KLDivLoss(from_logits=True)(_nd(logq), _nd(p)).asnumpy()
+    ref = (p * (np.log(p) - logq)).mean(axis=1)
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-6)
+
+
+def test_hinge_and_squared_hinge():
+    z = np.array([[2.0, -0.5]], "float32")
+    y = np.array([[1.0, -1.0]], "float32")  # margins: 1-2=-1->0 ; 1-0.5=0.5
+    out = L.HingeLoss()(_nd(z), _nd(y)).asnumpy()
+    np.testing.assert_allclose(out, [0.25], rtol=1e-5)
+    out2 = L.SquaredHingeLoss()(_nd(z), _nd(y)).asnumpy()
+    np.testing.assert_allclose(out2, [0.125], rtol=1e-5)
+
+
+def test_logistic():
+    z = np.array([[0.0, 2.0]], "float32")
+    y = np.array([[1.0, -1.0]], "float32")
+    ref = np.log1p(np.exp(-z * y)).mean()
+    out = L.LogisticLoss()(_nd(z), _nd(y)).asnumpy()
+    np.testing.assert_allclose(out, [ref], rtol=1e-5)
+
+
+def test_poisson_nll():
+    pred = np.array([[1.0, 2.0]], "float32")
+    t = np.array([[0.0, 3.0]], "float32")
+    ref = (pred - t * np.log(pred + 1e-8)).mean()
+    out = L.PoissonNLLLoss(from_logits=False)(_nd(pred), _nd(t)).asnumpy()
+    np.testing.assert_allclose(out, [ref], rtol=1e-4)
+
+
+def test_cosine_embedding():
+    a = rng.randn(2, 5).astype("float32")
+    b = rng.randn(2, 5).astype("float32")
+    y = np.array([1.0, -1.0], "float32")
+    cos = (a * b).sum(1) / (np.linalg.norm(a, axis=1) *
+                            np.linalg.norm(b, axis=1))
+    ref = np.where(y == 1, 1 - cos, np.maximum(0, cos))
+    out = L.CosineEmbeddingLoss()(_nd(a), _nd(b), _nd(y)).asnumpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-4)
+
+
+def test_triplet():
+    a = rng.randn(3, 4).astype("float32")
+    p = rng.randn(3, 4).astype("float32")
+    n = rng.randn(3, 4).astype("float32")
+    ref = np.maximum(((a - p) ** 2).sum(1) - ((a - n) ** 2).sum(1) + 1.0,
+                     0.0)
+    out = L.TripletLoss(margin=1.0)(_nd(a), _nd(p), _nd(n)).asnumpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-4)
+
+
+def test_weight_and_sample_weight():
+    p = np.ones((2, 3), "float32")
+    t = np.zeros((2, 3), "float32")
+    out = L.L1Loss(weight=2.0)(_nd(p), _nd(t)).asnumpy()
+    np.testing.assert_allclose(out, [2.0, 2.0])
+    sw = np.array([[1.0], [0.0]], "float32")
+    out2 = L.L1Loss()(_nd(p), _nd(t), _nd(sw)).asnumpy()
+    np.testing.assert_allclose(out2, [1.0, 0.0])

@@ -1,0 +1,102 @@
+"""Statistical checks over the sampler op zoo — each distribution's sample
+mean/variance against theory at n large enough for tight bounds (reference
+`tests/python/unittest/test_random.py` strategy)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+N = 200_000
+
+
+def _moments(arr):
+    a = arr.asnumpy().ravel().astype(np.float64)
+    return a.mean(), a.var()
+
+
+def setup_module():
+    mx.random.seed(7)
+
+
+def test_uniform_moments():
+    m, v = _moments(mx.nd.random.uniform(-2.0, 4.0, shape=(N,)))
+    assert abs(m - 1.0) < 0.02
+    assert abs(v - 36.0 / 12.0) < 0.05
+
+
+def test_normal_moments():
+    m, v = _moments(mx.nd.random.normal(1.5, 2.0, shape=(N,)))
+    assert abs(m - 1.5) < 0.02
+    assert abs(v - 4.0) < 0.08
+
+
+def test_gamma_moments():
+    alpha, beta = 3.0, 2.0   # mean a*b, var a*b^2 (shape/scale)
+    m, v = _moments(mx.nd.random.gamma(alpha, beta, shape=(N,)))
+    assert abs(m - 6.0) < 0.06
+    assert abs(v - 12.0) < 0.4
+
+
+def test_exponential_moments():
+    scale = 2.5  # reference ndarray/random.py exponential(scale): mean=scale
+    m, v = _moments(mx.nd.random.exponential(scale, shape=(N,)))
+    assert abs(m - scale) < 0.03
+    assert abs(v - scale ** 2) < 0.15
+
+
+def test_poisson_moments():
+    lam = 4.0
+    m, v = _moments(mx.nd.random.poisson(lam, shape=(N,)))
+    assert abs(m - lam) < 0.04
+    assert abs(v - lam) < 0.15
+
+
+def test_negative_binomial_moments():
+    k, p = 5.0, 0.4   # mean k(1-p)/p, var k(1-p)/p^2
+    m, v = _moments(mx.nd.random.negative_binomial(k, p, shape=(N,)))
+    assert abs(m - 7.5) < 0.12
+    assert abs(v - 18.75) < 0.8
+
+
+def test_randint_range_uniformity():
+    s = mx.nd.random.randint(3, 9, shape=(N,)).asnumpy()
+    assert s.min() == 3 and s.max() == 8
+    counts = np.bincount(s.astype(int))[3:9] / N
+    np.testing.assert_allclose(counts, 1 / 6, atol=0.01)
+
+
+def test_multinomial_frequencies():
+    probs = mx.nd.array(np.array([[0.2, 0.3, 0.5]], "float32"))
+    s = mx.nd.sample_multinomial(probs, shape=(N,)).asnumpy().ravel()
+    freq = np.bincount(s.astype(int), minlength=3) / N
+    np.testing.assert_allclose(freq, [0.2, 0.3, 0.5], atol=0.01)
+
+
+def test_bernoulli_like_dropout_rate():
+    import mxnet_tpu.autograd as ag
+    x = mx.nd.ones((N,))
+    with ag.record(train_mode=True):
+        y = mx.nd.Dropout(x, p=0.3, mode="always")
+    kept = (y.asnumpy() > 0).mean()
+    assert abs(kept - 0.7) < 0.01
+
+
+def test_seed_reproducibility():
+    mx.random.seed(123)
+    a = mx.nd.random.normal(shape=(100,)).asnumpy()
+    mx.random.seed(123)
+    b = mx.nd.random.normal(shape=(100,)).asnumpy()
+    np.testing.assert_array_equal(a, b)
+    c = mx.nd.random.normal(shape=(100,)).asnumpy()
+    assert not np.array_equal(b, c)
+
+
+def test_shapes_and_broadcast_params():
+    # per-element parameters (reference sample_op broadcastable params)
+    mu = mx.nd.array(np.array([0.0, 10.0], "float32"))
+    sig = mx.nd.array(np.array([1.0, 0.1], "float32"))
+    s = mx.nd.sample_normal(mu, sig, shape=(N // 2,)).asnumpy()
+    assert s.shape == (2, N // 2)
+    assert abs(s[0].mean()) < 0.05
+    assert abs(s[1].mean() - 10.0) < 0.05
+    assert s[1].std() < 0.2
