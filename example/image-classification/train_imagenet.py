@@ -34,7 +34,12 @@ def parse_args():
     p.add_argument("--image-shape", type=str, default="3,224,224")
     p.add_argument("--num-classes", type=int, default=1000)
     p.add_argument("--data-train", type=str, default=None,
-                   help=".rec file (raw container); synthetic if absent")
+                   help=".rec file (JPEG ImageRecordIO or raw container); "
+                        "synthetic if absent")
+    p.add_argument("--resize", type=int, default=0,
+                   help="resize shorter edge before crop")
+    p.add_argument("--rgb-mean", type=str, default="123.68,116.779,103.939")
+    p.add_argument("--rgb-std", type=str, default="58.393,57.12,57.375")
     p.add_argument("--tp", type=int, default=1, help="tensor-parallel size")
     p.add_argument("--log-interval", type=int, default=10)
     return p.parse_args()
@@ -56,26 +61,71 @@ def main():
         net.cast("bfloat16")
 
     mesh = parallel.make_mesh(dp=n_dev // args.tp, tp=args.tp)
+    bs = args.batch_size
+    use_rec = args.data_train and os.path.exists(args.data_train)
+
+    preprocess = None
+    if use_rec:
+        # data-fed path: host ships raw uint8 NHWC; normalize + layout +
+        # bf16 cast run INSIDE the compiled step (TPU-native input pipeline)
+        import jax.numpy as jnp
+        mean = jnp.array([float(v) for v in args.rgb_mean.split(",")],
+                         jnp.float32)
+        std = jnp.array([float(v) for v in args.rgb_std.split(",")],
+                        jnp.float32)
+        cdt = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
+
+        def preprocess(x):  # (N,H,W,C) u8 → (N,C,H,W) model dtype
+            x = (x.astype(jnp.float32) - mean) / std
+            return x.transpose(0, 3, 1, 2).astype(cdt)
+
     trainer = parallel.ShardedTrainer(
         net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
-        {"learning_rate": args.lr, "momentum": 0.9, "wd": 1e-4}, mesh=mesh)
+        {"learning_rate": args.lr, "momentum": 0.9, "wd": 1e-4}, mesh=mesh,
+        preprocess=preprocess)
 
-    bs = args.batch_size
+    if use_rec:
+        try:
+            from mxnet_tpu import _native
+            pump = _native.Pump(args.data_train, bs, shape,
+                                resize=args.resize, rand_crop=True,
+                                rand_mirror=True, shuffle=True,
+                                u8_output=True, depth=4)
+        except Exception as e:
+            # no native lib on this host — pure-Python decode fallback
+            # (ImageRecordIter PIL path); slower but the same training
+            logging.warning("native pump unavailable (%s); falling back "
+                            "to the Python ImageRecordIter", e)
+            pump = None
+        if pump is not None:
+            logging.info("native pump: %d batches/epoch",
+                         pump.batches_per_epoch)
 
-    if args.data_train and os.path.exists(args.data_train):
-        from mxnet_tpu.io import ImageRecordIter
-        it = ImageRecordIter(path_imgrec=args.data_train, data_shape=shape,
-                             batch_size=bs, shuffle=True, rand_crop=True,
-                             rand_mirror=True)
+            def batches():
+                pump.reset()
+                while True:
+                    item = pump.next()
+                    if item is None:
+                        return
+                    yield item
+        else:
+            from mxnet_tpu.io import ImageRecordIter
+            it = ImageRecordIter(path_imgrec=args.data_train,
+                                 data_shape=shape, batch_size=bs,
+                                 shuffle=True, resize=args.resize,
+                                 rand_crop=True, rand_mirror=True)
 
-        def batches():
-            it.reset()
-            while True:
-                try:
-                    b = it.next()
-                except StopIteration:
-                    return
-                yield b.data[0].astype(args.dtype), b.label[0]
+            def batches():
+                it.reset()
+                while True:
+                    try:
+                        b = it.next()
+                    except StopIteration:
+                        return
+                    # python path emits normalized f32 NCHW; undo the u8
+                    # preprocess contract by feeding NHWC u8-range data
+                    x = b.data[0].asnumpy().transpose(0, 2, 3, 1)
+                    yield x.astype(np.uint8), b.label[0].asnumpy()
     else:
         logging.info("using synthetic data")
         rng = np.random.RandomState(0)

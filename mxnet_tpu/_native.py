@@ -56,6 +56,7 @@ def _try_load():
     except OSError:
         return None
     lib.mxtpu_last_error.restype = ctypes.c_char_p
+    lib.mxtpu_decode_failures.restype = ctypes.c_int64
     lib.mxtpu_recordio_scan.restype = ctypes.c_int64
     lib.mxtpu_recordio_scan.argtypes = [
         ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64),
@@ -66,13 +67,21 @@ def _try_load():
     lib.mxtpu_assemble_batch.argtypes = [
         ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64),
         ctypes.POINTER(ctypes.c_int64), ctypes.c_int, ctypes.c_int,
-        ctypes.c_int, ctypes.c_int, ctypes.c_void_p, ctypes.c_void_p,
+        ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_void_p,
+        ctypes.c_void_p,
         ctypes.c_int, ctypes.c_uint64, ctypes.c_void_p, ctypes.c_void_p]
+    lib.mxtpu_assemble_batch_u8.restype = ctypes.c_int
+    lib.mxtpu_assemble_batch_u8.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.c_uint64, ctypes.c_void_p, ctypes.c_void_p]
     lib.mxtpu_pump_create.restype = ctypes.c_void_p
     lib.mxtpu_pump_create.argtypes = [
         ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
-        ctypes.c_int, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int,
-        ctypes.c_int, ctypes.c_uint64, ctypes.c_int]
+        ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_void_p,
+        ctypes.c_void_p,
+        ctypes.c_int, ctypes.c_int, ctypes.c_uint64, ctypes.c_int]
     lib.mxtpu_pump_next.restype = ctypes.c_int
     lib.mxtpu_pump_next.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
                                     ctypes.c_void_p]
@@ -113,6 +122,11 @@ def check_call(ret):
     return ret
 
 
+def decode_failures():
+    """Cumulative zero-filled bad records (reference skips bad images)."""
+    return lib().mxtpu_decode_failures()
+
+
 def recordio_scan(path):
     """Native record framing scan → (offsets, lengths) int64 arrays."""
     l = lib()
@@ -126,8 +140,8 @@ def recordio_scan(path):
     return offsets, lengths
 
 
-def assemble_batch(blob, offsets, lengths, c, h, w, mean=None, std=None,
-                   aug_flags=0, seed=0):
+def assemble_batch(blob, offsets, lengths, c, h, w, resize=0, mean=None,
+                   std=None, aug_flags=0, seed=0):
     """Parallel native decode of `len(offsets)` records into float32 NCHW."""
     l = lib()
     n = len(offsets)
@@ -149,7 +163,28 @@ def assemble_batch(blob, offsets, lengths, c, h, w, mean=None, std=None,
                          ctypes.c_void_p),
         offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
         lengths.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
-        n, c, h, w, mean_p, std_p, aug_flags, seed,
+        n, c, h, w, resize, mean_p, std_p, aug_flags, seed,
+        out.ctypes.data_as(ctypes.c_void_p),
+        labels.ctypes.data_as(ctypes.c_void_p)))
+    return out, labels
+
+
+def assemble_batch_u8(blob, offsets, lengths, c, h, w, resize=0,
+                      aug_flags=0, seed=0):
+    """uint8 NHWC native decode — the TPU fast path (normalize on device)."""
+    l = lib()
+    n = len(offsets)
+    out = np.empty((n, h, w, c), np.uint8)
+    labels = np.empty(n, np.float32)
+    offsets = np.ascontiguousarray(offsets, np.int64)
+    lengths = np.ascontiguousarray(lengths, np.int64)
+    check_call(l.mxtpu_assemble_batch_u8(
+        blob.ctypes.data_as(ctypes.c_void_p) if isinstance(blob, np.ndarray)
+        else ctypes.cast(ctypes.create_string_buffer(blob, len(blob)),
+                         ctypes.c_void_p),
+        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        lengths.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        n, c, h, w, resize, aug_flags, seed,
         out.ctypes.data_as(ctypes.c_void_p),
         labels.ctypes.data_as(ctypes.c_void_p)))
     return out, labels
@@ -158,12 +193,14 @@ def assemble_batch(blob, offsets, lengths, c, h, w, mean=None, std=None,
 class Pump:
     """Native double-buffered batch producer (src/io/pump.cc)."""
 
-    def __init__(self, path, batch_size, data_shape, mean=None, std=None,
-                 rand_crop=False, rand_mirror=False, shuffle=False, seed=0,
-                 depth=2):
+    def __init__(self, path, batch_size, data_shape, resize=0, mean=None,
+                 std=None, rand_crop=False, rand_mirror=False, shuffle=False,
+                 seed=0, depth=2, u8_output=False):
         l = lib()
         c, h, w = data_shape
-        self._shape = (batch_size, c, h, w)
+        self._u8 = bool(u8_output)
+        self._shape = (batch_size, h, w, c) if self._u8 \
+            else (batch_size, c, h, w)
         aug = (1 if rand_mirror else 0) | (2 if rand_crop else 0)
         mean_p = std_p = None
         if mean is not None:
@@ -173,8 +210,8 @@ class Pump:
             self._std = np.ascontiguousarray(std, np.float32)
             std_p = self._std.ctypes.data_as(ctypes.c_void_p)
         self._h = l.mxtpu_pump_create(path.encode(), batch_size, c, h, w,
-                                      mean_p, std_p, aug, int(shuffle),
-                                      seed, depth)
+                                      resize, int(self._u8), mean_p, std_p,
+                                      aug, int(shuffle), seed, depth)
         if not self._h:
             raise NativeError("pump creation failed for %s" % path)
         self._lib = l
@@ -185,7 +222,7 @@ class Pump:
 
     def next(self):
         """Returns (data, labels) or None at epoch end."""
-        out = np.empty(self._shape, np.float32)
+        out = np.empty(self._shape, np.uint8 if self._u8 else np.float32)
         labels = np.empty(self._shape[0], np.float32)
         r = self._lib.mxtpu_pump_next(
             self._h, out.ctypes.data_as(ctypes.c_void_p),

@@ -480,14 +480,39 @@ class MNISTIter(NDArrayIter):
                          shuffle=shuffle)
 
 
+def _resize_shorter_bilinear(img, size):
+    """Shorter-edge bilinear resize with half-pixel centers — the same
+    convention as the native kernel (src/io/recordio.cc resize_bilinear),
+    so Python-fallback and native ImageRecordIter output match."""
+    ih, iw = img.shape[:2]
+    if min(ih, iw) == size:
+        return img
+    if ih < iw:
+        nh, nw = size, iw * size // ih
+    else:
+        nh, nw = ih * size // iw, size
+    src = img.astype(_np.float64)
+    ys = (_np.arange(nh) + 0.5) * ih / nh - 0.5
+    xs = (_np.arange(nw) + 0.5) * iw / nw - 0.5
+    y0 = _np.clip(_np.floor(ys).astype(int), 0, ih - 1)
+    x0 = _np.clip(_np.floor(xs).astype(int), 0, iw - 1)
+    y1 = _np.clip(y0 + 1, 0, ih - 1)
+    x1 = _np.clip(x0 + 1, 0, iw - 1)
+    wy = _np.clip(ys - y0, 0, 1)[:, None, None]
+    wx = _np.clip(xs - x0, 0, 1)[None, :, None]
+    v = ((1 - wy) * ((1 - wx) * src[y0][:, x0] + wx * src[y0][:, x1]) +
+         wy * ((1 - wx) * src[y1][:, x0] + wx * src[y1][:, x1]))
+    return _np.floor(v + 0.5).clip(0, 255).astype(img.dtype)
+
+
 class ImageRecordIter(DataIter):
     """RecordIO image iterator (reference
     `src/io/iter_image_recordio_2.cc`). Decodes a packed .rec file via
     mxnet_tpu.recordio and serves augmented NCHW batches."""
 
     def __init__(self, path_imgrec, data_shape, batch_size=1,
-                 label_width=1, shuffle=False, mean_r=0.0, mean_g=0.0,
-                 mean_b=0.0, std_r=1.0, std_g=1.0, std_b=1.0,
+                 label_width=1, shuffle=False, resize=0, mean_r=0.0,
+                 mean_g=0.0, mean_b=0.0, std_r=1.0, std_g=1.0, std_b=1.0,
                  rand_crop=False, rand_mirror=False, preprocess_threads=None,
                  prefetch_buffer=4, **kwargs):
         if preprocess_threads is None:
@@ -495,21 +520,21 @@ class ImageRecordIter(DataIter):
             preprocess_threads = _config.get("MXNET_CPU_WORKER_NTHREADS",
                                              default=4)
         super().__init__(batch_size)
-        # native C++ pipeline (src/io/pump.cc): threaded decode+augment and
-        # double-buffered prefetch, GIL-free — used when the library is
-        # built and the records are in the raw container format
+        # native C++ pipeline (src/io/pump.cc): threaded JPEG/raw decode +
+        # augment and double-buffered prefetch, GIL-free
         self._pump = None
         try:
             from .. import _native
             if _native.available():
-                # probe: one-record native decode verifies the container
+                # probe: one-record native decode verifies the payload
                 # format before committing to the native pipeline
                 offs, lens = _native.recordio_scan(path_imgrec)
                 blob = _np.fromfile(path_imgrec, _np.uint8)
                 _native.assemble_batch(blob, offs[:1], lens[:1],
-                                       *tuple(data_shape))
+                                       *tuple(data_shape), resize=resize)
                 self._pump = _native.Pump(
                     path_imgrec, batch_size, tuple(data_shape),
+                    resize=resize,
                     mean=[mean_r, mean_g, mean_b],
                     std=[std_r, std_g, std_b], rand_crop=rand_crop,
                     rand_mirror=rand_mirror, shuffle=shuffle,
@@ -528,6 +553,7 @@ class ImageRecordIter(DataIter):
         self._shuffle = shuffle
         self._label_width = label_width
         self._aug = dict(rand_crop=rand_crop, rand_mirror=rand_mirror,
+                         resize=resize,
                          mean=_np.array([mean_r, mean_g, mean_b]),
                          std=_np.array([std_r, std_g, std_b]))
         self._items = []
@@ -575,9 +601,12 @@ class ImageRecordIter(DataIter):
             header, img = unpack_img(raw)
             label[i] = header.label if _np.isscalar(header.label) \
                 else header.label[0]
-            img = img.astype("float32")
             if img.ndim == 2:
                 img = _np.stack([img] * c, axis=2)
+            rs = self._aug["resize"]
+            if rs:
+                img = _resize_shorter_bilinear(img.astype("uint8"), rs)
+            img = img.astype("float32")
             ih, iw = img.shape[:2]
             if self._aug["rand_crop"] and ih >= h and iw >= w:
                 y0 = _np.random.randint(0, ih - h + 1)

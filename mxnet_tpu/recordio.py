@@ -210,11 +210,12 @@ def unpack(s):
 _RAW_MAGIC = b"MXTPURAW"
 
 
-def pack_img(header, img, quality=95, img_fmt=".raw"):
-    """Pack an image array (reference recordio.py:344). Default is the raw
-    numpy container (shape header + uint8 pixels) — losslessly decodable by
-    the native C++ pipeline (src/io/recordio.cc) without OpenCV/libjpeg;
-    pass ``.jpg``/``.png`` to encode via PIL instead."""
+def pack_img(header, img, quality=95, img_fmt=".jpg"):
+    """Pack an image array (reference recordio.py:344, same ``.jpg``
+    default). JPEG/PNG payloads are encoded via PIL; JPEG records are
+    decodable by the native C++ pipeline (src/io/recordio.cc, libjpeg) —
+    the reference ImageRecordIO format. ``.raw`` selects the lossless
+    raw container (shape header + uint8 pixels)."""
     img = np.asarray(img)
     if img_fmt in (".raw", "raw", None):
         shape = np.asarray(img.shape, dtype=np.int32)

@@ -28,6 +28,11 @@ const char *mxtpu_last_error(void);
 int mxtpu_version(void);
 int mxtpu_num_threads(void);
 
+/* Cumulative count of records that failed decode and were zero-filled
+ * (bad JPEG / corrupt container; parity with the reference parser's
+ * skip-and-continue behavior). */
+int64_t mxtpu_decode_failures(void);
+
 /* ---- RecordIO ---------------------------------------------------------- */
 /* Scan a dmlc-recordio file: fills offsets/lengths arrays (caller-allocated
  * with capacity `cap`); returns number of records or negative error. */
@@ -38,19 +43,29 @@ int64_t mxtpu_recordio_scan(const char *path, int64_t *offsets,
 int64_t mxtpu_recordio_count(const char *path);
 
 /* ---- batch assembly ---------------------------------------------------- */
-/* Decode + augment a batch of raw-container image records into a float32
- * NCHW buffer, parallel across records (OpenMP). Records use the
- * mxnet_tpu.recordio raw payload format:
+/* Decode + augment a batch of image records into a float32 NCHW buffer,
+ * parallel across records (OpenMP). Record payloads are either JPEG
+ * (reference ImageRecordIO format, decoded with libjpeg-turbo) or the
+ * mxnet_tpu.recordio raw container:
  *   IRHeader(IfQQ) [label f32 array if flag>0] "MXTPURAW" u8:ndim
  *   i32[ndim] shape, u8 pixels (HWC).
+ * resize > 0 resizes the shorter edge to `resize` (bilinear) before crop.
  * aug flags: bit0 = random mirror, bit1 = random crop (else center).
  * mean/std are per-channel (3). Returns 0 or negative error. */
 int mxtpu_assemble_batch(const uint8_t *blob, const int64_t *offsets,
                          const int64_t *lengths, int n,
-                         int c, int h, int w,
+                         int c, int h, int w, int resize,
                          const float *mean, const float *std,
                          int aug_flags, uint64_t seed,
                          float *out_data, float *out_labels);
+
+/* uint8 NHWC variant: decode + resize + crop + mirror only — normalize
+ * and layout happen on-device (host→device link ships 4× fewer bytes). */
+int mxtpu_assemble_batch_u8(const uint8_t *blob, const int64_t *offsets,
+                            const int64_t *lengths, int n,
+                            int c, int h, int w, int resize,
+                            int aug_flags, uint64_t seed,
+                            uint8_t *out_data, float *out_labels);
 
 /* ---- prefetch pump ----------------------------------------------------- */
 /* Opaque double-buffered producer running on a native thread. The producer
@@ -58,14 +73,18 @@ int mxtpu_assemble_batch(const uint8_t *blob, const int64_t *offsets,
  * a shuffled epoch order. */
 typedef void *mxtpu_pump_handle;
 
+/* u8_mode != 0 → batches are uint8 NHWC (no normalize; mean/std ignored);
+ * else float32 NCHW with normalize. */
 mxtpu_pump_handle mxtpu_pump_create(const char *path, int batch_size,
-                                    int c, int h, int w,
+                                    int c, int h, int w, int resize,
+                                    int u8_mode,
                                     const float *mean, const float *std,
                                     int aug_flags, int shuffle,
                                     uint64_t seed, int depth);
-/* Blocks until the next batch is ready; copies into out buffers.
+/* Blocks until the next batch is ready; copies into out buffers
+ * (out_data: float32 NCHW, or uint8 NHWC in u8 mode).
  * Returns 0, or 1 at epoch end (no batch copied), negative on error. */
-int mxtpu_pump_next(mxtpu_pump_handle h, float *out_data, float *out_labels);
+int mxtpu_pump_next(mxtpu_pump_handle h, void *out_data, float *out_labels);
 int mxtpu_pump_reset(mxtpu_pump_handle h);
 int mxtpu_pump_batches_per_epoch(mxtpu_pump_handle h);
 void mxtpu_pump_destroy(mxtpu_pump_handle h);

@@ -25,7 +25,7 @@
 namespace {
 
 struct Batch {
-  std::vector<float> data;
+  std::vector<uint8_t> data;  /* float32 NCHW bytes, or uint8 NHWC */
   std::vector<float> labels;
   bool epoch_end = false;
 };
@@ -34,7 +34,7 @@ struct Pump {
   std::vector<uint8_t> blob;
   std::vector<int64_t> offsets, lengths;
   std::vector<int64_t> order;
-  int batch = 0, c = 0, h = 0, w = 0;
+  int batch = 0, c = 0, h = 0, w = 0, resize = 0, u8 = 0;
   float mean[3] = {0, 0, 0}, stdv[3] = {1, 1, 1};
   bool has_mean = false, has_std = false;
   int aug_flags = 0, shuffle = 0, depth = 2;
@@ -65,7 +65,8 @@ struct Pump {
       int64_t nb = batches_per_epoch();
       for (int64_t b = 0; b < nb && !stop.load() && !restart.load(); ++b) {
         Batch out;
-        out.data.resize(static_cast<size_t>(batch) * c * h * w);
+        out.data.resize(static_cast<size_t>(batch) * c * h * w *
+                        (u8 ? 1 : sizeof(float)));
         out.labels.resize(batch);
         std::vector<int64_t> offs(batch), lens(batch);
         for (int i = 0; i < batch; ++i) {
@@ -73,11 +74,18 @@ struct Pump {
           offs[i] = offsets[j];
           lens[i] = lengths[j];
         }
-        int r = mxtpu_assemble_batch(
-            blob.data(), offs.data(), lens.data(), batch, c, h, w,
-            has_mean ? mean : nullptr, has_std ? stdv : nullptr, aug_flags,
-            seed + epoch * 1315423911ull + b, out.data.data(),
-            out.labels.data());
+        int r = u8
+            ? mxtpu_assemble_batch_u8(
+                  blob.data(), offs.data(), lens.data(), batch, c, h, w,
+                  resize, aug_flags, seed + epoch * 1315423911ull + b,
+                  out.data.data(), out.labels.data())
+            : mxtpu_assemble_batch(
+                  blob.data(), offs.data(), lens.data(), batch, c, h, w,
+                  resize,
+                  has_mean ? mean : nullptr, has_std ? stdv : nullptr,
+                  aug_flags, seed + epoch * 1315423911ull + b,
+                  reinterpret_cast<float *>(out.data.data()),
+                  out.labels.data());
         if (r != 0) {
           std::lock_guard<std::mutex> lk(mu);
           error = "batch assembly failed";
@@ -121,7 +129,8 @@ struct Pump {
 extern "C" {
 
 mxtpu_pump_handle mxtpu_pump_create(const char *path, int batch_size, int c,
-                                    int h, int w, const float *mean,
+                                    int h, int w, int resize, int u8_mode,
+                                    const float *mean,
                                     const float *std_, int aug_flags,
                                     int shuffle, uint64_t seed, int depth) {
   auto *p = new Pump();
@@ -153,6 +162,8 @@ mxtpu_pump_handle mxtpu_pump_create(const char *path, int batch_size, int c,
   p->c = c;
   p->h = h;
   p->w = w;
+  p->resize = resize;
+  p->u8 = u8_mode;
   if (mean) {
     std::memcpy(p->mean, mean, 3 * sizeof(float));
     p->has_mean = true;
@@ -169,7 +180,7 @@ mxtpu_pump_handle mxtpu_pump_create(const char *path, int batch_size, int c,
   return p;
 }
 
-int mxtpu_pump_next(mxtpu_pump_handle h, float *out_data, float *out_labels) {
+int mxtpu_pump_next(mxtpu_pump_handle h, void *out_data, float *out_labels) {
   auto *p = static_cast<Pump *>(h);
   std::unique_lock<std::mutex> lk(p->mu);
   p->cv_get.wait(lk, [&] { return !p->queue.empty() || p->stop.load(); });
@@ -178,7 +189,7 @@ int mxtpu_pump_next(mxtpu_pump_handle h, float *out_data, float *out_labels) {
   p->queue.pop();
   p->cv_put.notify_one();
   if (b.epoch_end) return 1;
-  std::memcpy(out_data, b.data.data(), b.data.size() * sizeof(float));
+  std::memcpy(out_data, b.data.data(), b.data.size());
   std::memcpy(out_labels, b.labels.data(), b.labels.size() * sizeof(float));
   return 0;
 }

@@ -22,7 +22,8 @@ def rec_file(tmp_path):
     for i in range(32):
         img = (rng.rand(40, 40, 3) * 255).astype(np.uint8)
         imgs.append(img)
-        rec.write(pack_img(IRHeader(0, float(i % 10), i, 0), img))
+        rec.write(pack_img(IRHeader(0, float(i % 10), i, 0), img,
+                           img_fmt=".raw"))
     rec.close()
     return path, imgs
 

@@ -1,6 +1,7 @@
 """Pack an image folder / .lst into a RecordIO file — reference
-`tools/im2rec.py` role. Uses the raw container format by default so the
-native C++ pipeline (src/io/recordio.cc) can decode without OpenCV."""
+`tools/im2rec.py` role. Writes reference-format ImageRecordIO (JPEG
+payloads by default), decodable by the native C++ pipeline
+(src/io/recordio.cc, libjpeg) and by the reference's own readers."""
 import argparse
 import os
 import sys
@@ -40,8 +41,9 @@ def main():
     p.add_argument("root", help="image folder (folder-per-class)")
     p.add_argument("--resize", type=int, default=0,
                    help="resize shorter edge")
-    p.add_argument("--img-format", type=str, default=".raw",
+    p.add_argument("--img-format", type=str, default=".jpg",
                    choices=[".raw", ".jpg", ".png"])
+    p.add_argument("--quality", type=int, default=95)
     args = p.parse_args()
 
     from mxnet_tpu.recordio import MXIndexedRecordIO, IRHeader, pack_img
@@ -52,18 +54,17 @@ def main():
     for i, rel, label in items:
         img = read_image(os.path.join(args.root, rel))
         if args.resize:
-            import jax
-            import jax.numpy as jnp
+            from PIL import Image
             h, w = img.shape[:2]
             if h < w:
                 nh, nw = args.resize, int(w * args.resize / h)
             else:
                 nh, nw = int(h * args.resize / w), args.resize
-            img = np.asarray(jax.image.resize(
-                jnp.asarray(img, jnp.float32), (nh, nw) + img.shape[2:],
-                "linear")).clip(0, 255).astype(np.uint8)
+            img = np.asarray(Image.fromarray(img.astype(np.uint8))
+                             .resize((nw, nh), Image.BILINEAR))
         rec.write_idx(i, pack_img(IRHeader(0, float(label), i, 0), img,
-                                  img_fmt=args.img_format))
+                                  img_fmt=args.img_format,
+                                  quality=args.quality))
         if (i + 1) % 1000 == 0:
             print("packed %d" % (i + 1))
     rec.close()
