@@ -1,0 +1,249 @@
+"""Language-model training benchmarks: BERT-base pretraining, Transformer
+LM, LSTM LM — the BASELINE.md north-star configs beyond ResNet ("LSTM LM +
+Transformer, BERT-base pretraining").
+
+Unlike the CNN benchmark (bench.py), these are matmul-bound workloads where
+the chip's measured 148.7 TFLOP/s bf16 matmul ceiling (PERF.md) is
+reachable — this is the framework's MFU proof point.
+
+Per model: runs a fused training span (lax.scan over fwd+bwd+update, bf16,
+in-graph synthetic batches via ShardedTrainer.bench_span_fn), then reports
+img-equiv throughput, model FLOP/s, and MFU. FLOPs are counted from the
+model's actual dense weights (6*N per token for fwd+bwd+param-grad) plus
+the analytic attention term; embedding gathers are excluded.
+
+Usage:  python benchmark/bench_lm.py [bert|translm|lstm|all]
+
+Env: LM_STEPS (span length, 64), LM_REPEAT (2), LM_BATCH (overrides per-
+model default batch).
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+V5E_PEAK_TFLOPS = 197.0    # bf16 dense peak
+MEASURED_MATMUL_TFLOPS = 148.7  # PERF.md: 8192^3 bf16 matmul on this chip
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+class LMLoss:
+    """Next-token softmax cross-entropy over (..., V) logits vs (...)
+    integer targets; f32 log-softmax regardless of model dtype."""
+
+    def __call__(self, out, y):
+        import jax
+        import jax.numpy as jnp
+        from mxnet_tpu.ndarray.ndarray import NDArray
+        o = out._data if isinstance(out, NDArray) else out
+        t = y._data if isinstance(y, NDArray) else y
+        logp = jax.nn.log_softmax(o.astype(jnp.float32), axis=-1)
+        ll = jnp.take_along_axis(
+            logp.reshape(-1, logp.shape[-1]),
+            t.reshape(-1).astype(jnp.int32)[:, None], axis=-1)
+        return NDArray(-jnp.mean(ll))
+
+
+def dense_param_elems(trainer, exclude=("embed", "embedding")):
+    """Matmul-participating weight elements (grad-bearing, ndim>=2,
+    non-embedding) — the N of the 6*N*token FLOP estimate."""
+    n = 0
+    for p in trainer._params:
+        if getattr(p, "grad_req", "write") == "null":
+            continue
+        name = p.name.lower()
+        if any(e in name for e in exclude):
+            continue
+        v = p.data()
+        if len(v.shape) >= 2:
+            n += int(np.prod(v.shape))
+    return n
+
+
+def run_span(trainer, make_batch, tag, steps, repeat, tokens_per_step,
+             flops_per_step):
+    log("compiling %s span (%d steps)..." % (tag, steps))
+    t0 = time.time()
+    l = trainer.bench_span_fn(steps, make_batch, tag=tag)
+    lv = l.asnumpy()
+    log("  warmup %.1fs, loss[0]=%.3f loss[-1]=%.3f"
+        % (time.time() - t0, lv[0], lv[-1]))
+    t0 = time.time()
+    for _ in range(repeat):
+        l = trainer.bench_span_fn(steps, make_batch, tag=tag)
+    l.asnumpy()
+    dt = time.time() - t0
+    tok_s = steps * repeat * tokens_per_step / dt
+    tflops = steps * repeat * flops_per_step / dt / 1e12
+    return tok_s, tflops
+
+
+def bench_bert(steps, repeat, batch=None):
+    import jax
+    import jax.numpy as jnp
+    import mxnet_tpu as mx
+    from mxnet_tpu import parallel
+    from mxnet_tpu.models.bert import bert_base
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "example", "bert"))
+    from pretrain_bert import PretrainStep, PretrainLoss
+
+    batch = batch or 64
+    seq, vocab, n_masks = 128, 30522, 20
+    mx.random.seed(0)
+    net = bert_base(vocab_size=vocab, max_length=seq)
+    net.initialize(mx.init.Xavier())
+    step = PretrainStep(net)
+    mesh = parallel.make_mesh(dp=1)
+    trainer = parallel.ShardedTrainer(step, PretrainLoss(), "adam",
+                                      {"learning_rate": 1e-4}, mesh=mesh,
+                                      dtype="bfloat16")
+
+    def make_batch(key):
+        k1, k2, k3, k4 = jax.random.split(key, 4)
+        tokens = jax.random.randint(k1, (batch, seq), 4, vocab
+                                    ).astype(jnp.float32)
+        segments = jnp.concatenate(
+            [jnp.zeros((batch, seq // 2)), jnp.ones((batch, seq // 2))],
+            axis=1).astype(jnp.float32)
+        positions = jax.random.randint(k2, (batch, n_masks), 0, seq
+                                       ).astype(jnp.float32)
+        labels = jax.random.randint(k3, (batch, n_masks), 4, vocab
+                                    ).astype(jnp.float32)
+        weights = jnp.ones((batch, n_masks), jnp.float32)
+        nsp = jax.random.randint(k4, (batch,), 0, 2).astype(jnp.float32)
+        y = jnp.zeros((batch,), jnp.float32)  # unused dummy
+        return (tokens, segments, positions, labels, weights, nsp), y
+
+    n_dense = dense_param_elems(trainer)
+    tokens_per_step = batch * seq
+    # 6*N per token (fwd 2N + bwd 4N) + attention 12*s^2*d per seq per
+    # layer for fwd, x3 for training
+    units, n_layers = 768, 12
+    attn = 3 * n_layers * 4 * seq * seq * units * batch
+    flops_per_step = 6 * n_dense * tokens_per_step + attn
+    log("BERT-base: %.1fM dense-matmul params, %.1f GFLOP/step (b%d s%d)"
+        % (n_dense / 1e6, flops_per_step / 1e9, batch, seq))
+    tok_s, tflops = run_span(trainer, make_batch, "bert", steps, repeat,
+                             tokens_per_step, flops_per_step)
+    return dict(metric="bert_base_pretrain_tokens_per_sec_b%d" % batch,
+                value=round(tok_s, 1), unit="tokens/s",
+                seq_per_sec=round(tok_s / seq, 1),
+                tflops=round(tflops, 1),
+                mfu_peak=round(tflops / V5E_PEAK_TFLOPS, 3),
+                mfu_matmul_ceiling=round(tflops / MEASURED_MATMUL_TFLOPS,
+                                         3))
+
+
+def bench_translm(steps, repeat, batch=None):
+    import jax
+    import jax.numpy as jnp
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, parallel
+    from mxnet_tpu.models.transformer import TransformerLM
+
+    batch = batch or 32
+    seq, vocab = 512, 32000
+    units, n_layers, heads, hidden = 768, 12, 12, 3072  # GPT-2-small class
+    mx.random.seed(0)
+    net = TransformerLM(vocab_size=vocab, units=units, num_layers=n_layers,
+                        num_heads=heads, hidden_size=hidden,
+                        max_len=seq, dropout=0.0)
+    net.initialize(mx.init.Xavier())
+    mesh = parallel.make_mesh(dp=1)
+
+    trainer = parallel.ShardedTrainer(net, LMLoss(), "adam",
+                                      {"learning_rate": 1e-4}, mesh=mesh,
+                                      dtype="bfloat16")
+
+    def make_batch(key):
+        k1, k2 = jax.random.split(key)
+        x = jax.random.randint(k1, (batch, seq), 0, vocab
+                               ).astype(jnp.float32)
+        y = jax.random.randint(k2, (batch, seq), 0, vocab
+                               ).astype(jnp.float32)
+        return (x,), y
+
+    n_dense = dense_param_elems(trainer)
+    tokens_per_step = batch * seq
+    attn = 3 * n_layers * 4 * seq * seq * units * batch
+    flops_per_step = 6 * n_dense * tokens_per_step + attn
+    log("TransformerLM: %.1fM dense params, %.1f GFLOP/step (b%d s%d)"
+        % (n_dense / 1e6, flops_per_step / 1e9, batch, seq))
+    tok_s, tflops = run_span(trainer, make_batch, "translm", steps, repeat,
+                             tokens_per_step, flops_per_step)
+    return dict(metric="transformer_lm_tokens_per_sec_b%d_s%d"
+                % (batch, seq),
+                value=round(tok_s, 1), unit="tokens/s",
+                tflops=round(tflops, 1),
+                mfu_peak=round(tflops / V5E_PEAK_TFLOPS, 3),
+                mfu_matmul_ceiling=round(tflops / MEASURED_MATMUL_TFLOPS,
+                                         3))
+
+
+def bench_lstm(steps, repeat, batch=None):
+    import jax
+    import jax.numpy as jnp
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, parallel
+    from mxnet_tpu.models.lstm_lm import RNNModel
+
+    batch = batch or 128
+    seq, vocab, hidden, layers = 35, 33278, 1500, 2  # reference wikitext-2
+    mx.random.seed(0)
+    net = RNNModel(mode="lstm", vocab_size=vocab, num_embed=hidden,
+                   num_hidden=hidden, num_layers=layers, dropout=0.0)
+    net.initialize(mx.init.Xavier())
+    mesh = parallel.make_mesh(dp=1)
+
+    trainer = parallel.ShardedTrainer(net, LMLoss(), "sgd",
+                                      {"learning_rate": 1.0}, mesh=mesh,
+                                      dtype="bfloat16")
+
+    def make_batch(key):
+        k1, k2 = jax.random.split(key)
+        x = jax.random.randint(k1, (seq, batch), 0, vocab
+                               ).astype(jnp.float32)
+        y = jax.random.randint(k2, (seq, batch), 0, vocab
+                               ).astype(jnp.float32)
+        return (x,), y
+
+    n_dense = dense_param_elems(trainer)
+    tokens_per_step = batch * seq
+    flops_per_step = 6 * n_dense * tokens_per_step
+    log("LSTM-LM: %.1fM dense params, %.1f GFLOP/step (b%d s%d)"
+        % (n_dense / 1e6, flops_per_step / 1e9, batch, seq))
+    tok_s, tflops = run_span(trainer, make_batch, "lstm", steps, repeat,
+                             tokens_per_step, flops_per_step)
+    return dict(metric="lstm_lm_tokens_per_sec_b%d" % batch,
+                value=round(tok_s, 1), unit="tokens/s",
+                tflops=round(tflops, 1),
+                mfu_peak=round(tflops / V5E_PEAK_TFLOPS, 3),
+                mfu_matmul_ceiling=round(tflops / MEASURED_MATMUL_TFLOPS,
+                                         3))
+
+
+def main():
+    which = sys.argv[1] if len(sys.argv) > 1 else "all"
+    steps = int(os.environ.get("LM_STEPS", "64"))
+    repeat = int(os.environ.get("LM_REPEAT", "2"))
+    batch = os.environ.get("LM_BATCH")
+    batch = int(batch) if batch else None
+    import jax
+    log("devices:", jax.devices())
+    runners = dict(bert=bench_bert, translm=bench_translm, lstm=bench_lstm)
+    names = list(runners) if which == "all" else [which]
+    for name in names:
+        res = runners[name](steps, repeat, batch)
+        print(json.dumps(res), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -13,6 +13,7 @@ from . import contrib_ops  # noqa: F401
 from . import ctc       # noqa: F401  (CTC loss dynamic program)
 from . import rnn       # noqa: F401  (fused RNN scan layers)
 from . import tensor_extra  # noqa: F401  (scalar/creation/indexing breadth)
+from . import ste_graph_ops  # noqa: F401  (STEs, grad multiplier, DGL names)
 from . import optim_ops  # noqa: F401  (optimizer update kernels)
 from . import random_ops  # noqa: F401  (sampling ops)
 from . import linalg_extra  # noqa: F401
@@ -54,3 +55,25 @@ def populate_namespace(target, names=None):
         op = get_op(name)
         if op is not None:
             target[name] = op
+
+# Legacy v0 capitalized binary-op names (reference
+# elemwise_binary_op_basic.cc:94 .add_alias("_Plus") etc.), npx-namespace
+# detection/rnn exposures, and contrib spellings — registered here after
+# every op module has loaded.
+for _src, _names in [
+        ("_plus", ("_Plus",)), ("_minus", ("_Minus",)),
+        ("_mul", ("_Mul",)), ("_div", ("_Div",)),
+        ("_power", ("_Power",)),
+        ("_maximum", ("_Maximum",)), ("_minimum", ("_Minimum",)),
+        ("_equal", ("_Equal",)), ("_not_equal", ("_Not_Equal",)),
+        ("_greater", ("_Greater",)),
+        ("_greater_equal", ("_Greater_Equal",)),
+        ("_lesser", ("_Lesser",)), ("_lesser_equal", ("_Lesser_Equal",)),
+        ("ctc_loss", ("_contrib_CTCLoss",)),
+        ("_contrib_box_nms", ("_contrib_box_non_maximum_suppression",)),
+        ("_contrib_MultiBoxDetection", ("_npx_multibox_detection",)),
+        ("_contrib_MultiBoxPrior", ("_npx_multibox_prior",)),
+        ("_contrib_MultiBoxTarget", ("_npx_multibox_target",)),
+        ("RNN", ("_npx_rnn",))]:
+    registry.register_alias(_src, *_names)
+del _src, _names

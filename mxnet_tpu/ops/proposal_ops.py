@@ -380,19 +380,10 @@ def DeformablePSROIPooling(data, rois, trans=None, spatial_scale=1.0,
 
 # ------------------------------------------------- deformable convolution
 
-@register("_contrib_DeformableConvolution",
-          aliases=("DeformableConvolution",))
-def DeformableConvolution(data, offset, weight, bias=None, kernel=(3, 3),
-                          stride=(1, 1), pad=(0, 0), dilate=(1, 1),
-                          num_filter=0, num_group=1, num_deformable_group=1,
-                          no_bias=False, **_ignored):
-    """Deformable conv v1 (reference contrib/deformable_convolution.cc):
-    every kernel tap samples the input at a learned fractional offset.
-
-    Expressed TPU-style as K*K bilinear gathers (piecewise-linear in the
-    offsets, so JAX autodiff reproduces the reference's offset gradients)
-    followed by one (C*K*K) x O matmul on the MXU.
-    """
+def _deform_conv(data, offset, mask, weight, bias, kernel, stride, pad,
+                 dilate, num_group, num_deformable_group, no_bias):
+    """Shared v1/v2 deformable conv body: K*K bilinear gathers
+    (x modulation mask for v2) followed by one (C*K*K) x O matmul."""
     B, C, H, W = data.shape
     O = weight.shape[0]
     KH, KW = int(kernel[0]), int(kernel[1])
@@ -405,6 +396,8 @@ def DeformableConvolution(data, offset, weight, bias=None, kernel=(3, 3),
     Ho = (H + 2 * ph_ - (dh * (KH - 1) + 1)) // sh + 1
     Wo = (W + 2 * pw_ - (dw * (KW - 1) + 1)) // sw + 1
     off = offset.reshape(B, dg, KH * KW, 2, Ho, Wo)
+    if mask is not None:
+        mask = mask.reshape(B, dg, KH * KW, Ho, Wo)
     gy = jnp.arange(Ho, dtype=data.dtype) * sh - ph_
     gx = jnp.arange(Wo, dtype=data.dtype) * sw - pw_
     base_y, base_x = jnp.meshgrid(gy, gx, indexing="ij")
@@ -418,7 +411,10 @@ def DeformableConvolution(data, offset, weight, bias=None, kernel=(3, 3),
                 ys = base_y + ky * dh + off[:, g, tap, 0]
                 xs = base_x + kx * dw + off[:, g, tap, 1]
                 sub = data[:, g * cg:(g + 1) * cg]
-                parts.append(jax.vmap(_sample_one)(sub, xs, ys))
+                val = jax.vmap(_sample_one)(sub, xs, ys)
+                if mask is not None:
+                    val = val * mask[:, g, tap][:, None]
+                parts.append(val)
             cols.append(jnp.concatenate(parts, axis=1))   # (B, C, Ho, Wo)
     col = jnp.stack(cols, axis=2)                         # (B, C, K*K, Ho, Wo)
     wmat = weight.reshape(O, C, KH * KW)
@@ -426,3 +422,101 @@ def DeformableConvolution(data, offset, weight, bias=None, kernel=(3, 3),
     if bias is not None and not no_bias:
         out = out + bias.reshape(1, -1, 1, 1)
     return out
+
+
+@register("_contrib_DeformableConvolution",
+          aliases=("DeformableConvolution",))
+def DeformableConvolution(data, offset, weight, bias=None, kernel=(3, 3),
+                          stride=(1, 1), pad=(0, 0), dilate=(1, 1),
+                          num_filter=0, num_group=1, num_deformable_group=1,
+                          no_bias=False, **_ignored):
+    """Deformable conv v1 (reference contrib/deformable_convolution.cc):
+    every kernel tap samples the input at a learned fractional offset.
+
+    Expressed TPU-style as K*K bilinear gathers (piecewise-linear in the
+    offsets, so JAX autodiff reproduces the reference's offset gradients)
+    followed by one (C*K*K) x O matmul on the MXU.
+    """
+    return _deform_conv(data, offset, None, weight, bias, kernel, stride,
+                        pad, dilate, num_group, num_deformable_group,
+                        no_bias)
+
+
+@register("_contrib_ModulatedDeformableConvolution",
+          aliases=("ModulatedDeformableConvolution",))
+def ModulatedDeformableConvolution(data, offset, mask, weight, bias=None,
+                                   kernel=(3, 3), stride=(1, 1), pad=(0, 0),
+                                   dilate=(1, 1), num_filter=0, num_group=1,
+                                   num_deformable_group=1, no_bias=False,
+                                   **_ignored):
+    """Deformable conv v2 (reference
+    contrib/modulated_deformable_convolution.cc): v1 plus a learned
+    per-tap modulation mask (B, dg*K*K, Ho, Wo) multiplying each sampled
+    value before the matmul."""
+    return _deform_conv(data, offset, mask, weight, bias, kernel, stride,
+                        pad, dilate, num_group, num_deformable_group,
+                        no_bias)
+
+
+@register("_contrib_mrcnn_mask_target", aliases=("mrcnn_mask_target",),
+          n_out=2, differentiable=False)
+def mrcnn_mask_target(rois, gt_masks, matches, cls_targets, num_rois=0,
+                      num_classes=0, mask_size=(14, 14), sample_ratio=2):
+    """Mask-RCNN training target generator (reference
+    contrib/mrcnn_mask_target.cu MRCNNMaskTargetKernel): RoIAligns each
+    roi's matched ground-truth mask to ``mask_size`` (zero outside the
+    image, average of sample_ratio^2 bilinear taps per bin) and emits a
+    per-class one-hot weight volume.
+
+    rois (B, N, 4) corner format, gt_masks (B, M, H, W), matches (B, N),
+    cls_targets (B, N) -> (mask_targets, mask_cls) both (B, N, C, mh, mw).
+    """
+    B, N = rois.shape[:2]
+    M, H, W = gt_masks.shape[1:]
+    mh, mw = int(mask_size[0]), int(mask_size[1])
+    C = int(num_classes)
+    sr = int(sample_ratio)
+    if sr <= 0:
+        raise NotImplementedError(
+            "sample_ratio=-1 (adaptive grid) is data-dependent; use a "
+            "positive sampling ratio on TPU")
+
+    def bilinear_zero(img, ys, xs):
+        """ROIAlign bilinear with zero outside [-1, H] x [-1, W]
+        (mrcnn_mask_target.cu bilinear_interpolate)."""
+        valid = (ys >= -1.0) & (ys <= H) & (xs >= -1.0) & (xs <= W)
+        y = jnp.clip(ys, 0.0, H - 1.0)
+        x = jnp.clip(xs, 0.0, W - 1.0)
+        y0 = jnp.floor(y).astype(jnp.int32)
+        x0 = jnp.floor(x).astype(jnp.int32)
+        y1 = jnp.minimum(y0 + 1, H - 1)
+        x1 = jnp.minimum(x0 + 1, W - 1)
+        ly, lx = y - y0, x - x0
+        v = ((1 - ly) * (1 - lx) * img[y0, x0] + (1 - ly) * lx * img[y0, x1]
+             + ly * (1 - lx) * img[y1, x0] + ly * lx * img[y1, x1])
+        return jnp.where(valid, v, 0.0)
+
+    def one(roi, match, masks):
+        x1, y1, x2, y2 = roi[0], roi[1], roi[2], roi[3]
+        rw = jnp.maximum(x2 - x1, 1.0)
+        rh = jnp.maximum(y2 - y1, 1.0)
+        bh, bw = rh / mh, rw / mw
+        py = jnp.arange(mh, dtype=rois.dtype)
+        px = jnp.arange(mw, dtype=rois.dtype)
+        sy = (jnp.arange(sr, dtype=rois.dtype) + 0.5) / sr
+        sx = (jnp.arange(sr, dtype=rois.dtype) + 0.5) / sr
+        ys = y1 + (py[:, None, None, None] + sy[None, None, :, None]) * bh
+        xs = x1 + (px[None, :, None, None] + sx[None, None, None, :]) * bw
+        ys = jnp.broadcast_to(ys, (mh, mw, sr, sr)).reshape(-1)
+        xs = jnp.broadcast_to(xs, (mh, mw, sr, sr)).reshape(-1)
+        img = masks[match.astype(jnp.int32)]
+        vals = bilinear_zero(img, ys, xs).reshape(mh, mw, sr * sr)
+        return vals.mean(-1)
+
+    sampled = jax.vmap(lambda rs, ms, masks: jax.vmap(
+        lambda r, m: one(r, m, masks))(rs, ms))(rois, matches, gt_masks)
+    mask_targets = jnp.broadcast_to(sampled[:, :, None], (B, N, C, mh, mw))
+    cls_ids = jnp.arange(C, dtype=cls_targets.dtype)
+    onehot = (cls_targets[..., None] == cls_ids).astype(gt_masks.dtype)
+    mask_cls = jnp.broadcast_to(onehot[..., None, None], (B, N, C, mh, mw))
+    return mask_targets, mask_cls

@@ -215,3 +215,97 @@ def _sample_unique_zipfian(range_max=1, shape=None, key=None):
     counts = jax.vmap(row_unique)(draws.reshape(-1, shape[-1]))
     return draws, counts.reshape(shape[:-1] + (1,) if len(shape) > 1
                                  else (1,)).astype(jnp.int64)
+
+
+# ---- probability-density ops (reference src/operator/random/pdf_op.cc:
+# _random_pdf_<distr>, differentiable w.r.t. sample AND distribution
+# parameters — here jax autodiff instead of the hand-written *_Grad
+# kernels in pdf_op.h) -----------------------------------------------------
+
+from jax.scipy.special import gammaln as _gammaln
+
+
+def _pexp(lpdf, is_log):
+    return lpdf if is_log else jnp.exp(lpdf)
+
+
+def _nb_lpdf(sample, k, p):
+    """Shared NB log-pmf: lgamma(x+k) - lgamma(x+1) - lgamma(k)
+    + k*log(p) + x*log(1-p) (pdf_op.h PDF_NegativeBinomial::LPDF)."""
+    return (_gammaln(sample + k) - _gammaln(sample + 1) - _gammaln(k)
+            + k * jnp.log(p) + sample * jnp.log(1 - p))
+
+
+@register("_random_pdf_uniform", aliases=("random_pdf_uniform",))
+def _random_pdf_uniform(sample, low, high, is_log=False):
+    """PDF of U(low, high) at sample (pdf_op.h PDF_Uniform). Parameter
+    arrays have one fewer trailing dim than ``sample``."""
+    l, h = low[..., None], high[..., None]
+    lpdf = -jnp.log(h - l) * jnp.ones_like(sample)
+    return _pexp(lpdf, is_log)
+
+
+@register("_random_pdf_normal", aliases=("random_pdf_normal",))
+def _random_pdf_normal(sample, mu, sigma, is_log=False):
+    """PDF of N(mu, sigma) (pdf_op.h PDF_Normal)."""
+    u, s = mu[..., None], sigma[..., None]
+    expo = -0.5 * (sample - u) ** 2 / (s * s)
+    lpdf = expo - jnp.log(jnp.sqrt(2.0 * jnp.pi) * s)
+    return _pexp(lpdf, is_log)
+
+
+@register("_random_pdf_gamma", aliases=("random_pdf_gamma",))
+def _random_pdf_gamma(sample, alpha, beta, is_log=False):
+    """PDF of Gamma(shape=alpha, rate=beta) (pdf_op.h PDF_Gamma:
+    a*log(b) + (a-1)*log(x) - b*x - lgamma(a))."""
+    a, b = alpha[..., None], beta[..., None]
+    lpdf = a * jnp.log(b) + (a - 1) * jnp.log(sample) - b * sample \
+        - _gammaln(a)
+    return _pexp(lpdf, is_log)
+
+
+@register("_random_pdf_exponential", aliases=("random_pdf_exponential",))
+def _random_pdf_exponential(sample, lam, is_log=False):
+    """PDF of Exp(lam) (pdf_op.h PDF_Exponential)."""
+    l = lam[..., None]
+    lpdf = jnp.log(l) - l * sample
+    return _pexp(lpdf, is_log)
+
+
+@register("_random_pdf_poisson", aliases=("random_pdf_poisson",))
+def _random_pdf_poisson(sample, lam, is_log=False):
+    """PMF of Poisson(lam) (pdf_op.h PDF_Poisson)."""
+    l = lam[..., None]
+    lpdf = sample * jnp.log(l) - _gammaln(sample + 1) - l
+    return _pexp(lpdf, is_log)
+
+
+@register("_random_pdf_negative_binomial",
+          aliases=("random_pdf_negative_binomial",))
+def _random_pdf_negative_binomial(sample, k, p, is_log=False):
+    """PMF of NB(k, p) (pdf_op.h PDF_NegativeBinomial)."""
+    lpdf = _nb_lpdf(sample, k[..., None], p[..., None])
+    return _pexp(lpdf, is_log)
+
+
+@register("_random_pdf_generalized_negative_binomial",
+          aliases=("random_pdf_generalized_negative_binomial",))
+def _random_pdf_generalized_negative_binomial(sample, mu, alpha,
+                                              is_log=False):
+    """PMF of GNB(mu, alpha): NB with k=1/alpha, p=1/(mu*alpha+1)
+    (pdf_op.h PDF_GeneralizedNegativeBinomial)."""
+    kk = 1.0 / alpha[..., None]
+    pp = 1.0 / (mu[..., None] * alpha[..., None] + 1.0)
+    lpdf = _nb_lpdf(sample, kk, pp)
+    return _pexp(lpdf, is_log)
+
+
+@register("_random_pdf_dirichlet", aliases=("random_pdf_dirichlet",))
+def _random_pdf_dirichlet(sample, alpha, is_log=False):
+    """PDF of Dirichlet(alpha): sample (..., n, k), alpha (..., k) ->
+    out (..., n) (pdf_op.h PDF_Dirichlet)."""
+    a = alpha[..., None, :]
+    lpdf = (jnp.sum((a - 1) * jnp.log(sample), axis=-1)
+            + _gammaln(jnp.sum(a, axis=-1))
+            - jnp.sum(_gammaln(a), axis=-1))
+    return _pexp(lpdf, is_log)

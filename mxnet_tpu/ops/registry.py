@@ -39,10 +39,11 @@ class Op:
     cuDNN dropout descriptors held by the op state
     (`src/operator/nn/dropout-inl.h`)."""
     __slots__ = ("name", "fn", "n_out", "aliases", "doc", "namespace",
-                 "differentiable", "state_binders")
+                 "differentiable", "state_binders", "host_op")
 
     def __init__(self, name, fn, n_out=1, aliases=(), doc=None,
-                 namespace="nd", differentiable=True, state_binders=None):
+                 namespace="nd", differentiable=True, state_binders=None,
+                 host_op=False):
         self.name = name
         self.fn = fn
         self.n_out = n_out
@@ -51,6 +52,7 @@ class Op:
         self.namespace = namespace
         self.differentiable = differentiable
         self.state_binders = state_binders or {}
+        self.host_op = host_op
 
     def __call__(self, *args, **kwargs):
         return invoke(self, *args, **kwargs)
@@ -60,8 +62,13 @@ class Op:
 
 
 def register(name=None, n_out=1, aliases=(), namespace="nd",
-             differentiable=True, state_binders=None):
-    """Decorator: register a pure JAX function as a framework op."""
+             differentiable=True, state_binders=None, host_op=False):
+    """Decorator: register a pure JAX function as a framework op.
+
+    ``host_op=True`` registers an eager host-side function (the reference's
+    CPU-only FComputeEx kernels, e.g. the DGL graph samplers): invoke
+    passes NDArray/CSRNDArray objects through unmodified and records no
+    tape — these never appear inside a jitted program."""
     def deco(fn):
         opname = name or fn.__name__
         # duplicate registration is fatal (reference nnvm registry CHECKs):
@@ -74,7 +81,7 @@ def register(name=None, n_out=1, aliases=(), namespace="nd",
                     % (n, _OP_REGISTRY[n].name))
         op = Op(opname, fn, n_out=n_out, aliases=aliases,
                 namespace=namespace, differentiable=differentiable,
-                state_binders=state_binders)
+                state_binders=state_binders, host_op=host_op)
         _OP_REGISTRY[opname] = op
         for a in aliases:
             _OP_REGISTRY[a] = op
@@ -114,6 +121,9 @@ def invoke(op: Op, *args, out=None, **kwargs):
     in ``_data`` — no separate symbolic executor is needed.
     """
     from ..ndarray.ndarray import NDArray
+
+    if op.host_op:
+        return op.fn(*args, **kwargs)
 
     vals = []
     nd_inputs = []

@@ -1,0 +1,120 @@
+"""Straight-through estimators, gradient multiplier, scatter scalar ops,
+and the DGL graph op registry names.
+
+Role parity:
+- reference ``src/operator/contrib/stes_op.cc:34`` (`_contrib_round_ste`,
+  `_contrib_sign_ste`): forward = round/sign, backward = identity —
+  here ``jax.custom_vjp`` instead of a registered backward op (the tape's
+  jax.vjp replay honors custom_vjp automatically);
+- reference ``src/operator/contrib/gradient_multiplier_op.cc:73``
+  (`_contrib_gradientmultiplier`): identity forward, gradient scaled by
+  ``scalar`` on the way back;
+- reference ``src/operator/tensor/elemwise_scatter_op.cc:74-121``
+  (`_scatter_elemwise_div`, `_scatter_{plus,minus}_scalar`): on sparse
+  lhs the op applies only to stored values; dense inputs behave exactly
+  like the plain ops (this build's sparse frontend keeps compressed
+  payloads at the NDArray layer, so the registry kernels are the dense
+  path — `ndarray/sparse.py` routes stored-value arithmetic);
+- reference ``src/operator/contrib/dgl_graph.cc`` / ``contrib/nnz.cc``:
+  the DGL sampler/adjacency/edge_id family — host-side CSR kernels in the
+  reference too (CPU FComputeEx), registered here as host ops delegating
+  to ``mxnet_tpu.contrib.graph``.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+__all__ = ["round_ste", "sign_ste", "gradientmultiplier"]
+
+
+@jax.custom_vjp
+def _round_ste(x):
+    return jnp.round(x)
+
+
+_round_ste.defvjp(lambda x: (jnp.round(x), None),
+                  lambda _, g: (g,))
+
+
+@jax.custom_vjp
+def _sign_ste(x):
+    return jnp.sign(x)
+
+
+_sign_ste.defvjp(lambda x: (jnp.sign(x), None),
+                 lambda _, g: (g,))
+
+
+@register("_contrib_round_ste", aliases=("round_ste",))
+def round_ste(data):
+    """round() forward, identity gradient (stes_op.cc:34)."""
+    return _round_ste(data)
+
+
+@register("_contrib_sign_ste", aliases=("sign_ste",))
+def sign_ste(data):
+    """sign() forward, identity gradient (stes_op.cc)."""
+    return _sign_ste(data)
+
+
+@jax.custom_vjp
+def _gradmul(x, lam):
+    return x
+
+
+_gradmul.defvjp(lambda x, lam: (x, lam),
+                lambda lam, g: (g * lam, jnp.zeros_like(lam)))
+
+
+@register("_contrib_gradientmultiplier",
+          aliases=("gradientmultiplier",))
+def gradientmultiplier(data, scalar=1.0):
+    """Identity forward; backward multiplies the incoming gradient by
+    ``scalar`` (gradient_multiplier_op.cc:73 — the GRL building block)."""
+    return _gradmul(data, jnp.asarray(scalar, data.dtype))
+
+
+@register("_scatter_plus_scalar")
+def scatter_plus_scalar(data, scalar=0.0):
+    """data + scalar applied to stored values only for sparse inputs;
+    identical to _plus_scalar on dense (elemwise_scatter_op.cc:100)."""
+    return data + scalar
+
+
+@register("_scatter_minus_scalar")
+def scatter_minus_scalar(data, scalar=0.0):
+    """data - scalar on stored values (elemwise_scatter_op.cc:121)."""
+    return data - scalar
+
+
+@register("_scatter_elemwise_div")
+def scatter_elemwise_div(lhs, rhs):
+    """lhs / rhs with output storage following lhs
+    (elemwise_scatter_op.cc:74)."""
+    return lhs / rhs
+
+
+def _graph(name):
+    def call(*args, **kwargs):
+        from ..contrib import graph as g
+        return getattr(g, name)(*args, **kwargs)
+    call.__name__ = name
+    call.__doc__ = "host op -> mxnet_tpu.contrib.graph.%s" % name
+    return call
+
+
+for _ref_name, _fn_name in [
+        ("_contrib_edge_id", "edge_id"),
+        ("_contrib_getnnz", "getnnz"),
+        ("_contrib_dgl_adjacency", "dgl_adjacency"),
+        ("_contrib_dgl_subgraph", "dgl_subgraph"),
+        ("_contrib_dgl_csr_neighbor_uniform_sample",
+         "dgl_csr_neighbor_uniform_sample"),
+        ("_contrib_dgl_csr_neighbor_non_uniform_sample",
+         "dgl_csr_neighbor_non_uniform_sample"),
+        ("_contrib_dgl_graph_compact", "dgl_graph_compact")]:
+    register(_ref_name, host_op=True, differentiable=False)(_graph(_fn_name))
+

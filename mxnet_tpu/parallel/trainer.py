@@ -300,6 +300,46 @@ class ShardedTrainer:
         from ..ndarray.ndarray import NDArray
         return NDArray(losses)
 
+    def bench_span_fn(self, steps, make_batch, tag=None):
+        """Like :meth:`bench_span` but with a caller-supplied traced batch
+        generator — for models whose inputs aren't a single image tensor
+        (BERT token/segment/position tuples, LM token streams...).
+
+        ``make_batch(key)`` is traced inside the scan body and must return
+        ``(x_args_tuple, y)`` built from jax ops on ``key``. ``tag`` keys
+        the compile cache (pass a stable string; the callable's identity
+        is not part of the key)."""
+        def many(key, param_vals, states, t0, lr):
+            def body(carry, _):
+                key, pv, st, t = carry
+                key, kb, sub = jax.random.split(key, 3)
+                x_args, y = make_batch(kb)
+                loss, pv2, st2, _aux = self._one_step(
+                    sub, pv, st, t, lr, tuple(x_args), y)
+                return (key, pv2, st2, t + 1), loss
+
+            (key, pv, st, t), losses = jax.lax.scan(
+                body, (key, list(param_vals), list(states), t0), None,
+                length=steps)
+            return losses, pv, st
+
+        sig = ("fn", steps, tag if tag is not None else id(make_batch))
+        cache = getattr(self, "_bench_fns", None)
+        if cache is None:
+            cache = self._bench_fns = {}
+        # cache the generator too: an id()-keyed entry must keep its
+        # make_batch alive, or a recycled id would hit a stale compile
+        entry = cache.get(sig)
+        if entry is None or (tag is None and entry[1] is not make_batch):
+            entry = cache[sig] = (jax.jit(many, donate_argnums=(1, 2)),
+                                  make_batch)
+        fn = entry[0]
+        losses, self._values, self._states = fn(
+            _random.next_key(), self._values, self._states, self._t + 1,
+            self._lr)
+        self._t += steps
+        return NDArray(losses)
+
     def sync_back(self):
         """Write the trainer's (possibly sharded) values back into the
         Block's Parameters — gathers shards first, then lands each ctx copy

@@ -1,0 +1,58 @@
+"""Registry-parity gate (VERDICT r2 item 4): every forward op name
+registered by the reference must resolve in this registry, modulo an
+explicit allowlist of ops with no TPU meaning.
+
+The snapshot tests/data/reference_ops.txt is produced by
+`python tools/op_parity.py --write` (mechanical extraction of every
+NNVM_REGISTER_OP / MXNET_REGISTER_OP_PROPERTY / wrapper-macro /
+.add_alias registration under reference src/operator, forward ops only,
+vendor CuDNN/MKLDNN/TensorRT/TVM names dropped)."""
+import os
+
+import numpy as np
+import pytest
+
+from mxnet_tpu.ops.registry import list_ops, get_op
+
+SNAPSHOT = os.path.join(os.path.dirname(__file__), "data",
+                        "reference_ops.txt")
+
+# Ops that are n/a by design on this substrate (each justified):
+ALLOWLIST = {
+    "_CrossDeviceCopy",   # explicit engine-level device copy; XLA/PJRT
+                          # inserts transfers (NDArray.copyto covers API)
+    "_NDArray",           # legacy in-graph host-callback wrapper op
+                          # (reference src/operator/ndarray_op.cc, Lua/
+                          # torch era); CustomOp is the supported path
+    "_Native",            # same legacy family (native_op.cc)
+}
+
+
+def test_reference_forward_ops_all_registered():
+    names = [l.strip() for l in open(SNAPSHOT) if l.strip()]
+    assert len(names) > 600, "snapshot looks truncated"
+    have = set(list_ops())
+    missing = [n for n in names if n not in have and n not in ALLOWLIST]
+    assert not missing, ("reference forward ops missing from registry "
+                        "(add op or justify in ALLOWLIST): %s" % missing)
+    assert len(ALLOWLIST) <= 20
+
+
+def test_allowlist_entries_are_actually_absent():
+    """Allowlist hygiene: entries that get implemented must be removed."""
+    have = set(list_ops())
+    stale = [n for n in ALLOWLIST if n in have]
+    assert not stale, "implemented ops still allowlisted: %s" % stale
+
+
+def test_straggler_ops_resolve():
+    for n in ["_contrib_gradientmultiplier", "_contrib_round_ste",
+              "_contrib_sign_ste", "_scatter_plus_scalar",
+              "_scatter_minus_scalar", "_scatter_elemwise_div",
+              "_contrib_edge_id", "_contrib_getnnz",
+              "_contrib_dgl_adjacency", "_contrib_dgl_subgraph",
+              "_contrib_ModulatedDeformableConvolution",
+              "_contrib_mrcnn_mask_target", "_random_pdf_uniform",
+              "_random_pdf_dirichlet", "_Plus", "_npx_rnn",
+              "_contrib_CTCLoss"]:
+        assert get_op(n) is not None, n

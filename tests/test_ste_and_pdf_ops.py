@@ -1,0 +1,170 @@
+"""Oracle tests for the round-3 straggler ops: STEs, gradient multiplier,
+scatter scalar ops, the _random_pdf_ family (vs scipy), modulated
+deformable conv, and mrcnn_mask_target."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd as ag
+
+
+def test_round_ste_forward_and_grad():
+    x = nd.array([-1.5, 1.5, -1.9, 1.9, 2.7])
+    x.attach_grad()
+    with ag.record():
+        y = nd.round_ste(x)
+        l = (y * y).sum()
+    l.backward()
+    np.testing.assert_allclose(y.asnumpy(), [-2., 2., -2., 2., 3.])
+    # straight-through: dl/dx = 2*round(x) (identity through round)
+    np.testing.assert_allclose(x.grad.asnumpy(), 2 * y.asnumpy())
+
+
+def test_sign_ste_forward_and_grad():
+    x = nd.array([-0.7, 0.0, 2.5])
+    x.attach_grad()
+    with ag.record():
+        y = nd.sign_ste(x)
+        l = (3.0 * y).sum()
+    l.backward()
+    np.testing.assert_allclose(y.asnumpy(), [-1., 0., 1.])
+    np.testing.assert_allclose(x.grad.asnumpy(), [3., 3., 3.])
+
+
+def test_gradientmultiplier():
+    x = nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with ag.record():
+        y = nd.gradientmultiplier(x, scalar=-0.5)  # GRL
+        l = y.sum()
+    l.backward()
+    np.testing.assert_allclose(y.asnumpy(), x.asnumpy())  # identity fwd
+    np.testing.assert_allclose(x.grad.asnumpy(), [-0.5, -0.5, -0.5])
+
+
+def test_scatter_scalar_ops():
+    from mxnet_tpu.ops.registry import get_op
+    x = nd.array([[1.0, 2.0], [3.0, 4.0]])
+    np.testing.assert_allclose(
+        get_op("_scatter_plus_scalar")(x, scalar=2.0).asnumpy(),
+        x.asnumpy() + 2)
+    np.testing.assert_allclose(
+        get_op("_scatter_minus_scalar")(x, scalar=1.0).asnumpy(),
+        x.asnumpy() - 1)
+    y = nd.array([[2.0, 4.0], [6.0, 8.0]])
+    np.testing.assert_allclose(
+        get_op("_scatter_elemwise_div")(y, x).asnumpy(),
+        y.asnumpy() / x.asnumpy())
+
+
+@pytest.mark.parametrize("is_log", [False, True])
+def test_random_pdf_vs_scipy(is_log):
+    st = pytest.importorskip("scipy.stats")
+    from mxnet_tpu.ops.registry import get_op
+    s = np.array([[0.5, 1.5, 2.5]])
+    checks = [
+        ("_random_pdf_uniform", (np.array([0.0]), np.array([10.0])),
+         st.uniform.pdf(s, 0, 10)),
+        ("_random_pdf_normal", (np.array([1.0]), np.array([2.0])),
+         st.norm.pdf(s, 1.0, 2.0)),
+        ("_random_pdf_gamma", (np.array([2.0]), np.array([3.0])),
+         st.gamma.pdf(s, 2.0, scale=1 / 3.0)),
+        ("_random_pdf_exponential", (np.array([1.5]),),
+         st.expon.pdf(s, scale=1 / 1.5)),
+    ]
+    for name, params, want in checks:
+        got = get_op(name).fn(s, *params, is_log=is_log)
+        np.testing.assert_allclose(np.asarray(got),
+                                   np.log(want) if is_log else want,
+                                   rtol=2e-5, atol=1e-7), name
+    # discrete pmfs at integer samples
+    si = np.array([[0.0, 1.0, 4.0]])
+    got = get_op("_random_pdf_poisson").fn(si, np.array([2.0]),
+                                           is_log=is_log)
+    want = st.poisson.pmf(si, 2.0)
+    np.testing.assert_allclose(np.asarray(got),
+                               np.log(want) if is_log else want, rtol=2e-5)
+    got = get_op("_random_pdf_negative_binomial").fn(
+        si, np.array([4.0]), np.array([0.3]), is_log=is_log)
+    want = st.nbinom.pmf(si, 4, 0.3)
+    np.testing.assert_allclose(np.asarray(got),
+                               np.log(want) if is_log else want, rtol=2e-5)
+    # GNB(mu, alpha) == NB(1/alpha, 1/(mu*alpha+1))
+    got = get_op("_random_pdf_generalized_negative_binomial").fn(
+        si, np.array([2.0]), np.array([0.5]), is_log=is_log)
+    want = st.nbinom.pmf(si, 2.0, 0.5)
+    np.testing.assert_allclose(np.asarray(got),
+                               np.log(want) if is_log else want, rtol=2e-5)
+    d = np.array([[[0.3, 0.7], [0.5, 0.5]]])
+    got = get_op("_random_pdf_dirichlet").fn(d, np.array([[2.0, 3.0]]),
+                                             is_log=is_log)
+    want = np.array([[st.dirichlet.pdf(x, [2.0, 3.0]) for x in d[0]]])
+    np.testing.assert_allclose(np.asarray(got),
+                               np.log(want) if is_log else want, rtol=2e-5)
+
+
+def test_modulated_deformable_conv_reduces_to_v1_with_ones_mask():
+    from mxnet_tpu.ops.registry import get_op
+    rng = np.random.RandomState(0)
+    x = nd.array(rng.rand(2, 4, 8, 8))
+    w = nd.array(rng.rand(6, 4, 3, 3) * 0.1)
+    off = nd.array(rng.rand(2, 18, 6, 6) * 0.5)
+    mask = nd.ones((2, 9, 6, 6))
+    v1 = get_op("_contrib_DeformableConvolution")(
+        x, off, w, kernel=(3, 3), num_filter=6, no_bias=True)
+    v2 = get_op("_contrib_ModulatedDeformableConvolution")(
+        x, off, mask, w, kernel=(3, 3), num_filter=6, no_bias=True)
+    np.testing.assert_allclose(v2.asnumpy(), v1.asnumpy(), rtol=1e-5,
+                               atol=1e-6)
+    # half mask scales sampled values
+    v2h = get_op("_contrib_ModulatedDeformableConvolution")(
+        x, off, mask * 0.5, w, kernel=(3, 3), num_filter=6, no_bias=True)
+    np.testing.assert_allclose(v2h.asnumpy(), 0.5 * v1.asnumpy(),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_mrcnn_mask_target_shapes_and_identity_crop():
+    from mxnet_tpu.ops.registry import get_op
+    B, N, M, H, W, C, ms = 1, 2, 3, 8, 8, 4, 3
+    # linear-gradient masks: bilinear interpolation is exact, so each bin
+    # equals the gradient at the bin's sample centroid
+    yy, xx = np.mgrid[0:H, 0:W].astype("float32")
+    gt = np.stack([m * yy + (m + 1) * xx + m for m in range(M)])[None]
+    rois = nd.array([[[0, 0, 6, 6], [0, 0, 3, 3]]])
+    matches = nd.array([[1, 2]])
+    cls_t = nd.array([[2, 0]])
+    tgt, wcls = get_op("_contrib_mrcnn_mask_target")(
+        rois, nd.array(gt), matches, cls_t, num_rois=N, num_classes=C,
+        mask_size=(ms, ms), sample_ratio=2)
+    assert tgt.shape == (B, N, C, ms, ms)
+    assert wcls.shape == (B, N, C, ms, ms)
+    w0 = wcls.asnumpy()
+    assert w0[0, 0, 2].min() == 1.0 and w0[0, 0, 1].max() == 0.0
+    assert w0[0, 1, 0].min() == 1.0 and w0[0, 1, 2].max() == 0.0
+    # class planes are identical copies of the sampled mask
+    t = tgt.asnumpy()
+    np.testing.assert_allclose(t[0, 0, 0], t[0, 0, 3])
+    # roi 0: bins of size 2 over mask 1 (f = y + 2x + 1), centroids at
+    # (2p+1, 2q+1) -> f = (2p+1) + 2(2q+1) + 1
+    p = np.arange(ms, dtype="float32")
+    want = (2 * p[:, None] + 1) + 2 * (2 * p[None, :] + 1) + 1
+    np.testing.assert_allclose(t[0, 0, 0], want, rtol=1e-5, atol=1e-5)
+    # roi 1: bins of size 1 over mask 2 (f = 2y + 3x + 2), centroids at
+    # (p+0.5, q+0.5)
+    want1 = 2 * (p[:, None] + 0.5) + 3 * (p[None, :] + 0.5) + 2
+    np.testing.assert_allclose(t[0, 1, 0], want1, rtol=1e-5, atol=1e-5)
+
+
+def test_dgl_registry_names_route_to_graph_module():
+    from mxnet_tpu.ops.registry import get_op
+    from mxnet_tpu.ndarray.sparse import CSRNDArray
+    # 3-node graph with edge ids as data
+    data = np.array([1.0, 2.0, 3.0], "float32")
+    indices = np.array([1, 2, 0], "int64")
+    indptr = np.array([0, 2, 3, 3], "int64")
+    csr = CSRNDArray(data, indices, indptr, (3, 3))
+    eid = get_op("_contrib_edge_id")(csr, nd.array([0, 0, 2]),
+                                     nd.array([1, 2, 1]))
+    np.testing.assert_allclose(eid.asnumpy(), [1.0, 2.0, -1.0])
+    nnz = get_op("_contrib_getnnz")(csr)
+    assert int(np.asarray(nnz.asnumpy())) == 3
