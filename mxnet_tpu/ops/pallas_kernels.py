@@ -52,7 +52,7 @@ def _attn_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, blk_q, blk_k,
     """One (batch*head, q-block) program: stream K/V blocks with online
     softmax accumulation in fp32."""
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * scale          # (blk_q, D)
+    q = q_ref[0].astype(jnp.float32) * jnp.float32(scale)  # (blk_q, D)
 
     n_kb = seq_len // blk_k
 
@@ -67,7 +67,7 @@ def _attn_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, blk_q, blk_k,
                 jnp.int32, (blk_q, blk_k), 0)
             k_pos = kb * blk_k + jax.lax.broadcasted_iota(
                 jnp.int32, (blk_q, blk_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+            s = jnp.where(q_pos >= k_pos, s, jnp.float32(NEG_INF))
         m_new = jnp.maximum(m_i, jnp.max(s, axis=-1))
         p = jnp.exp(s - m_new[:, None])
         corr = jnp.exp(m_i - m_new)
@@ -79,27 +79,34 @@ def _attn_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, blk_q, blk_k,
 
     D = q.shape[-1]
     acc = jnp.zeros((blk_q, D), jnp.float32)
-    m_i = jnp.full((blk_q,), NEG_INF, jnp.float32)
+    m_i = jnp.full((blk_q,), jnp.float32(NEG_INF), jnp.float32)
     l_i = jnp.zeros((blk_q,), jnp.float32)
     if causal:
         # only blocks up to (and including) the diagonal contribute
         n_iter = qi * (blk_q // blk_k) + (blk_q // blk_k)
     else:
         n_iter = n_kb
-    acc, m_i, l_i = jax.lax.fori_loop(0, n_iter, body, (acc, m_i, l_i))
-    o_ref[0] = (acc / jnp.maximum(l_i, 1e-20)[:, None]).astype(o_ref.dtype)
+    # int32 loop bounds: under x64 a Python-int bound makes the induction
+    # variable i64 and the `kb * blk_k` block-index arithmetic mixes
+    # i64/i32 ('arith.muli' verification error in Mosaic)
+    acc, m_i, l_i = jax.lax.fori_loop(jnp.int32(0), jnp.int32(n_iter),
+                                      body, (acc, m_i, l_i))
+    o_ref[0] = (acc / jnp.maximum(l_i, jnp.float32(1e-20))[:, None]
+                ).astype(o_ref.dtype)
 
 
 def _flash_fwd(q, k, v, causal, interpret):
     B, H, S, D = q.shape
-    scale = 1.0 / np.sqrt(D)
+    # plain Python float: np.float64 is strongly typed and would promote
+    # the f32 kernel to f64 under x64 (TPU Mosaic has no 64-bit types)
+    scale = float(1.0 / np.sqrt(D))
     qr = q.reshape(B * H, S, D)
     kr = k.reshape(B * H, S, D)
     vr = v.reshape(B * H, S, D)
     grid = (B * H, S // BLOCK_Q)
     kernel = functools.partial(_attn_kernel, scale=scale, causal=causal,
                                blk_q=BLOCK_Q, blk_k=BLOCK_K, seq_len=S)
-    out = pl.pallas_call(
+    call = pl.pallas_call(
         kernel,
         out_shape=jax.ShapeDtypeStruct((B * H, S, D), q.dtype),
         grid=grid,
@@ -110,7 +117,14 @@ def _flash_fwd(q, k, v, causal, interpret):
         ],
         out_specs=pl.BlockSpec((1, BLOCK_Q, D), lambda b, i: (b, i, 0)),
         interpret=interpret,
-    )(qr, kr, vr)
+    )
+    # trace with x64 off: this framework enables jax_enable_x64 globally
+    # (int64 index parity), but Mosaic's grid machinery then emits i64
+    # scalars that fail to legalize ('func.return') on the TPU compiler —
+    # the kernel itself is pure f32/i32
+    from jax.experimental import enable_x64
+    with enable_x64(False):
+        out = call(qr, kr, vr)
     return out.reshape(B, H, S, D)
 
 

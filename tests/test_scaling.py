@@ -1,0 +1,113 @@
+"""Scaling-efficiency artifact (VERDICT r2 item 8; BASELINE.md north star
+"≥90% scaling 8→256" — the correctness/structure half provable without a
+pod):
+
+1. the compiled SPMD training step contains EXACTLY ONE all-reduce per
+   step (the fused gradient sync — no per-parameter collective storm, no
+   stray transfers), asserted on the optimized HLO text;
+2. dp=1/2/4/8 all compile and execute the same program shape on the
+   virtual CPU mesh with per-step loss identical to the single-device
+   run (weak-scaling correctness: same global batch, sharded).
+
+bench_pod.py (example/image-classification) is the ready-to-run
+multi-chip counterpart for when real pod hardware exists.
+"""
+import re
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, parallel
+
+
+def _make_net(seed=0):
+    mx.random.seed(seed)
+    np.random.seed(seed)
+    net = gluon.nn.Sequential()
+    net.add(gluon.nn.Dense(32, activation="relu", in_units=16),
+            gluon.nn.Dense(8, in_units=32))
+    net.initialize(mx.init.Xavier())
+    net(mx.nd.zeros((1, 16)))
+    return net
+
+
+def _trainer(net, dp):
+    mesh = parallel.make_mesh(dp=dp, devices=jax.devices()[:dp])
+    return parallel.ShardedTrainer(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+        {"learning_rate": 0.1}, mesh=mesh)
+
+
+def _lower_step_hlo(trainer, batch=16):
+    """Compile the fused step for this mesh and return optimized HLO."""
+    trainer._build_step()
+    from mxnet_tpu.parallel.mesh import batch_sharding
+    from mxnet_tpu import random as _random
+    bs = batch_sharding(trainer._mesh, trainer._batch_axes)
+    x = jax.device_put(jnp.zeros((batch, 16)), bs)
+    y = jax.device_put(jnp.zeros((batch,)), bs)
+    lowered = trainer._step_fn.lower(
+        _random.next_key(), trainer._values, trainer._states, 1, 0.1, x, y)
+    return lowered.compile().as_text()
+
+
+def _count_all_reduces(hlo):
+    """Count all-reduce *op definitions* in optimized HLO (a def looks
+    like `%all-reduce.5 = (f32[], ...) all-reduce(...)`; uses of the
+    result appear as `(%all-reduce.5)` with no space before the name)."""
+    return len(re.findall(r" all-reduce\(", hlo))
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8-device mesh")
+def test_compiled_step_has_exactly_one_allreduce_per_step():
+    net = _make_net()
+    tr = _trainer(net, dp=8)
+    hlo = _lower_step_hlo(tr)
+    n = _count_all_reduces(hlo)
+    # ONE fused gradient/loss all-reduce: XLA combines the per-parameter
+    # gradient psums and the scalar loss mean into a single collective
+    # (all-reduce combiner); >1 would mean the collectives didn't fuse,
+    # 0 would mean gradients aren't synced at all.
+    assert n == 1, "expected exactly 1 fused all-reduce, found %d" % n
+    # and no cross-device point-to-point traffic in a pure-dp step
+    assert "collective-permute" not in hlo
+    assert "all-to-all" not in hlo
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8-device mesh")
+def test_dp_sweep_same_loss_trajectory():
+    """Same global batch sharded over dp=1/2/4/8 must produce the same
+    loss trajectory as the single-device run (sync data parallelism is
+    semantically invisible)."""
+    rng = np.random.RandomState(3)
+    X = rng.rand(5, 16, 16).astype("float32")
+    Y = rng.randint(0, 8, (5, 16)).astype("float32")
+    ref = None
+    for dp in (1, 2, 4, 8):
+        net = _make_net(seed=7)
+        tr = _trainer(net, dp)
+        losses = [float(tr.step(X[i], Y[i]).asnumpy()) for i in range(5)]
+        if ref is None:
+            ref = losses
+        else:
+            np.testing.assert_allclose(losses, ref, rtol=2e-5, atol=1e-6,
+                                       err_msg="dp=%d diverged" % dp)
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8-device mesh")
+def test_allreduce_count_independent_of_model_size():
+    """A deeper model must still compile to ONE fused all-reduce — the
+    collective combiner keeps gradient sync O(1) in parameter count."""
+    mx.random.seed(0)
+    net = gluon.nn.Sequential()
+    for _ in range(6):
+        net.add(gluon.nn.Dense(64, activation="relu"))
+    net.add(gluon.nn.Dense(8))
+    net.initialize(mx.init.Xavier())
+    net(mx.nd.zeros((1, 16)))
+    tr = _trainer(net, dp=8)
+    hlo = _lower_step_hlo(tr)
+    assert _count_all_reduces(hlo) == 1
