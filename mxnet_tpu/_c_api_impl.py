@@ -1,0 +1,614 @@
+"""Python support layer for the flat C ABI (src/c_api/c_api.cc).
+
+Role parity: the reference implements its C ABI in `src/c_api/*.cc`
+directly against the C++ runtime (c_api.cc, c_api_symbolic.cc,
+c_api_executor.cc, c_predict_api.cc). In the TPU rebuild the runtime
+objects live in Python (over JAX/XLA), so the C boundary is a thin
+marshalling layer (c_api.cc: strings/arrays/handles <-> Python) and THIS
+module is where each entry point lands — one flat function per ABI call,
+operating on the same runtime objects the Python frontend uses.
+
+Nothing here is Python-public API; the stable surface is
+src/include/mxtpu_c.h.
+"""
+import json
+import os
+import tempfile
+
+import numpy as _np
+
+
+# ----------------------------------------------------------------- helpers
+
+def _ctx(s):
+    """Parse a device string: 'cpu', 'cpu(0)', 'gpu(1)', 'tpu(0)'."""
+    from . import context
+    if not s:
+        return context.current_context()
+    s = s.strip()
+    dev_id = 0
+    if "(" in s:
+        name, rest = s.split("(", 1)
+        dev_id = int(rest.rstrip(")") or 0)
+    else:
+        name = s
+    name = name.strip()
+    if name in ("cpu", "cpu_pinned"):
+        return context.cpu(dev_id)
+    if name in ("gpu", "tpu"):
+        return context.tpu(dev_id)
+    raise ValueError("unknown device string %r" % s)
+
+
+def _parse_val(v):
+    """Reference frontends pass op params as strings; recover typed values
+    the way dmlc::Parameter would (bool/int/float/tuple), else keep str."""
+    if not isinstance(v, str):
+        return v
+    s = v.strip()
+    low = s.lower()
+    if low in ("true", "false"):
+        return low == "true"
+    if low in ("none", "null"):
+        return None
+    import ast
+    try:
+        return ast.literal_eval(s)  # ints, floats, tuples incl. "(4,)"
+    except (ValueError, SyntaxError):
+        pass
+    try:
+        return json.loads(s)
+    except (json.JSONDecodeError, ValueError):
+        pass
+    return v
+
+
+def _kwargs(keys, vals):
+    return {k: _parse_val(v) for k, v in zip(keys, vals)}
+
+
+# ----------------------------------------------------------------- ndarray
+
+def ndarray_create(shape, dtype, ctx_str):
+    from .ndarray import ndarray as nd
+    return nd.zeros(tuple(shape), ctx=_ctx(ctx_str) if ctx_str else None,
+                    dtype=dtype or "float32")
+
+
+def ndarray_dtype(a):
+    return _np.dtype(a.dtype).name
+
+
+def ndarray_ctx(a):
+    c = a.ctx
+    return "%s(%d)" % (c.device_type, c.device_id)
+
+
+def ndarray_storage_type(a):
+    return getattr(a, "stype", "default")
+
+
+def ndarray_reshape(a, dims):
+    return a.reshape(tuple(dims))
+
+
+def ndarray_slice(a, begin, end):
+    return a[begin:end]
+
+
+def ndarray_at(a, idx):
+    return a[idx]
+
+
+def ndarray_detach(a):
+    return a.detach() if hasattr(a, "detach") else a
+
+
+def ndarray_grad(a):
+    return a.grad
+
+
+def ndarray_wait_to_read(a):
+    a.wait_to_read()
+
+
+def ndarray_save(fname, arrays, keys):
+    from .ndarray import ndarray as nd
+    if keys:
+        nd.save(fname, dict(zip(keys, arrays)))
+    else:
+        nd.save(fname, list(arrays))
+
+
+def ndarray_load(fname):
+    from .ndarray import ndarray as nd
+    data = nd.load(fname)
+    if isinstance(data, dict):
+        names = list(data.keys())
+        return names, [data[n] for n in names]
+    return [], list(data)
+
+
+def ndarray_load_from_bytes(buf):
+    """Reference MXNDArrayLoadFromBuffer (c_api.cc): the predict API hands
+    the .params file CONTENT, not a path."""
+    with tempfile.NamedTemporaryFile(suffix=".params", delete=False) as fh:
+        fh.write(buf)
+        path = fh.name
+    try:
+        return ndarray_load(path)
+    finally:
+        os.unlink(path)
+
+
+# ---------------------------------------------------------------- autograd
+
+def autograd_set_recording(flag):
+    from . import autograd
+    return autograd.set_recording(bool(flag))
+
+
+def autograd_set_training(flag):
+    from . import autograd
+    return autograd.set_training(bool(flag))
+
+
+def autograd_is_recording():
+    from . import autograd
+    return autograd.is_recording()
+
+
+def autograd_is_training():
+    from . import autograd
+    return autograd.is_training()
+
+
+_GRAD_REQ = {0: "null", 1: "write", 2: "add"}
+
+
+def autograd_mark_variables(arrays, reqs, grads):
+    from . import autograd
+    autograd.mark_variables(
+        list(arrays), list(grads),
+        [_GRAD_REQ.get(int(r), "write") for r in reqs])
+
+
+def autograd_backward(outputs, ograds, retain_graph, train_mode):
+    from . import autograd
+    autograd.backward(list(outputs),
+                      list(ograds) if ograds else None,
+                      retain_graph=bool(retain_graph),
+                      train_mode=bool(train_mode))
+
+
+# ------------------------------------------------------------------ symbol
+
+class _AtomicSymbol:
+    """Two-phase construction mirroring the reference ABI
+    (MXSymbolCreateAtomicSymbol then MXSymbolCompose mutates the SAME
+    handle — c_api_symbolic.cc). Until compose the node is pending; after
+    compose every call forwards to the composed Symbol."""
+
+    def __init__(self, op_name, kwargs):
+        self._pending = (op_name, kwargs)
+        self._real = None
+
+    def compose(self, name, keys, args):
+        from .symbol import symbol as sym
+        op_name, kwargs = self._pending
+        maker = sym._sym_op(op_name)
+        pos, kw = [], dict(kwargs)
+        unwrapped = [_sym_unwrap(a) for a in args]
+        if keys and any(keys):
+            for k, a in zip(keys, unwrapped):
+                if k:
+                    kw[k] = a
+                else:
+                    pos.append(a)
+        else:
+            pos = unwrapped
+        self._real = maker(*pos, name=name or None, **kw)
+        return None
+
+
+def _sym_unwrap(h):
+    if isinstance(h, _AtomicSymbol):
+        if h._real is None:
+            h.compose(None, [], [])
+        return h._real
+    return h
+
+
+def symbol_create_variable(name):
+    from .symbol import symbol as sym
+    return sym.var(name)
+
+
+def symbol_create_atomic(op_name, keys, vals):
+    from .ops.registry import get_op
+    if get_op(op_name) is None:
+        raise ValueError("unknown operator: %s" % op_name)
+    return _AtomicSymbol(op_name, _kwargs(keys, vals))
+
+
+def symbol_compose(h, name, keys, args):
+    if isinstance(h, _AtomicSymbol):
+        h.compose(name, keys, args)
+    else:
+        raise TypeError("MXSymbolCompose: handle is already composed")
+
+
+def symbol_create_group(handles):
+    from .symbol import symbol as sym
+    return sym.Group([_sym_unwrap(h) for h in handles])
+
+
+def symbol_get_output(h, index):
+    return _sym_unwrap(h)[index]
+
+
+def symbol_get_internals(h):
+    return _sym_unwrap(h).get_internals()
+
+
+def symbol_get_name(h):
+    return _sym_unwrap(h).name
+
+
+def symbol_num_outputs(h):
+    return len(_sym_unwrap(h)._outputs_list())
+
+
+def symbol_list_arguments(h):
+    return _sym_unwrap(h).list_arguments()
+
+
+def symbol_list_outputs(h):
+    return _sym_unwrap(h).list_outputs()
+
+
+def symbol_list_aux(h):
+    return _sym_unwrap(h).list_auxiliary_states()
+
+
+def symbol_infer_shape(h, keys, shapes, partial):
+    s = _sym_unwrap(h)
+    kw = {k: tuple(v) for k, v in zip(keys, shapes)}
+    if partial:
+        arg, out, aux = s.infer_shape_partial(**kw)
+    else:
+        arg, out, aux = s.infer_shape(**kw)
+
+    def clean(lst):
+        return [tuple(int(d) for d in t) if t is not None else None
+                for t in (lst or [])]
+    complete = arg is not None and all(t is not None for t in (arg or []))
+    return clean(arg), clean(out), clean(aux), complete
+
+
+def symbol_tojson(h):
+    return _sym_unwrap(h).tojson()
+
+
+def symbol_from_json(js):
+    from .symbol import symbol as sym
+    return sym.load_json(js)
+
+
+def symbol_save_file(h, fname):
+    _sym_unwrap(h).save(fname)
+
+
+def symbol_load_file(fname):
+    from .symbol import symbol as sym
+    return sym.load(fname)
+
+
+def symbol_copy(h):
+    from .symbol import symbol as sym
+    return sym.load_json(_sym_unwrap(h).tojson())
+
+
+def symbol_get_attr(h, key):
+    return _sym_unwrap(h).attr(key)
+
+
+def symbol_set_attr(h, key, val):
+    _sym_unwrap(h)._set_attr(**{key: val})
+
+
+def symbol_print(h):
+    s = _sym_unwrap(h)
+    lines = ["Symbol outputs: %s" % ", ".join(s.list_outputs())]
+    for n in s._toposort():
+        op = n._op.name if n._op else "null"
+        lines.append("  %-24s %s" % (n._name or "?", op))
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------- executor
+
+def executor_simple_bind(h, ctx_str, grad_req, keys, shapes):
+    s = _sym_unwrap(h)
+    kw = {k: tuple(v) for k, v in zip(keys, shapes)}
+    return s.simple_bind(_ctx(ctx_str), grad_req=grad_req or "write", **kw)
+
+
+def executor_forward(ex, is_train):
+    ex.forward(is_train=bool(is_train))
+
+
+def executor_backward(ex, ograds):
+    ex.backward(list(ograds) if ograds else None)
+
+
+def executor_outputs(ex):
+    return list(ex.outputs)
+
+
+def executor_arg_names(ex):
+    return list(ex._arg_names)
+
+
+def executor_arg_arrays(ex):
+    return [ex.arg_dict[n] for n in ex._arg_names]
+
+
+def executor_grad_arrays(ex):
+    return [ex.grad_dict.get(n) for n in ex._arg_names]
+
+
+def executor_aux_arrays(ex):
+    return [ex.aux_dict[n] for n in ex._aux_names]
+
+
+def executor_print(ex):
+    return ex.debug_str()
+
+
+# ----------------------------------------------------------------- kvstore
+
+def kvstore_create(kind):
+    from . import kvstore
+    return kvstore.create(kind or "local")
+
+
+def kvstore_init(kv, keys, vals):
+    kv.init(list(keys), list(vals))
+
+
+def kvstore_push(kv, keys, vals, priority):
+    # KVStore.push already aggregates repeated keys (per-device values)
+    kv.push(list(keys), list(vals), priority=priority)
+
+
+def kvstore_pull(kv, keys, outs, priority):
+    for k, o in zip(keys, outs):
+        kv.pull(k, out=o, priority=priority)
+
+
+def kvstore_type(kv):
+    return kv.type
+
+
+def kvstore_rank(kv):
+    return kv.rank
+
+
+def kvstore_group_size(kv):
+    return kv.num_workers
+
+
+def kvstore_barrier(kv):
+    kv.barrier()
+
+
+def kvstore_num_dead_node(kv):
+    return kv.num_dead_node
+
+
+def kvstore_set_gradient_compression(kv, keys, vals):
+    kv.set_gradient_compression(_kwargs(keys, vals))
+
+
+# ---------------------------------------------------------------- data io
+
+# C-creatable iterators: the file-fed ones whose every parameter is a
+# string (reference MXListDataIters lists the C++ iterators only;
+# NDArrayIter is a Python-frontend construct there too).
+_ITER_NAMES = ["CSVIter", "MNISTIter", "ImageRecordIter"]
+
+
+class _IterState:
+    """Holds the live iterator plus its current batch (the reference C
+    iterator contract: Next() advances, GetData/GetLabel read the current
+    position — c_api.cc MXDataIterNext)."""
+
+    def __init__(self, it):
+        self.it = it
+        self.batch = None
+
+
+def list_data_iters():
+    return list(_ITER_NAMES)
+
+
+def dataiter_create(name, keys, vals):
+    from . import io
+    if name not in _ITER_NAMES:
+        raise ValueError("unknown data iter: %s" % name)
+    kw = _kwargs(keys, vals)
+    return _IterState(getattr(io, name)(**kw))
+
+
+def dataiter_next(st):
+    try:
+        st.batch = st.it.next()
+        return 1
+    except StopIteration:
+        st.batch = None
+        return 0
+
+
+def dataiter_before_first(st):
+    st.it.reset()
+    st.batch = None
+
+
+def dataiter_get_data(st):
+    if st.batch is None:
+        raise RuntimeError("call MXDataIterNext first")
+    return st.batch.data[0]
+
+
+def dataiter_get_label(st):
+    if st.batch is None:
+        raise RuntimeError("call MXDataIterNext first")
+    return st.batch.label[0]
+
+
+def dataiter_get_pad(st):
+    if st.batch is None:
+        raise RuntimeError("call MXDataIterNext first")
+    return int(st.batch.pad or 0)
+
+
+# ---------------------------------------------------------------- recordio
+
+def recordio_writer_create(uri):
+    from . import recordio
+    return recordio.MXRecordIO(uri, "w")  # __init__ opens
+
+
+def recordio_writer_write(w, buf):
+    w.write(bytes(buf))
+
+
+def recordio_writer_tell(w):
+    return w.tell()
+
+
+def recordio_close(rw):
+    rw.close()
+
+
+def recordio_reader_create(uri):
+    from . import recordio
+    return recordio.MXRecordIO(uri, "r")  # __init__ opens
+
+
+def recordio_reader_read(r):
+    return r.read()  # bytes or None at EOF
+
+
+def recordio_reader_seek(r, pos):
+    r.seek(pos)
+
+
+def recordio_reader_tell(r):
+    return r.tell()
+
+
+# ----------------------------------------------------------------- predict
+
+class _Predictor:
+    """Inference-only executor over an exported (symbol-json, params)
+    pair — reference c_predict_api.cc MXPredCreate/SetInput/Forward/
+    GetOutput lifecycle."""
+
+    def __init__(self, symbol_json, param_bytes, dev_str, input_keys,
+                 input_shapes):
+        from .ndarray import ndarray as nd
+        self.ctx = _ctx(dev_str)
+        self.sym = symbol_from_json(symbol_json)
+        names, arrays = (ndarray_load_from_bytes(param_bytes)
+                         if param_bytes else ([], []))
+        params = {}
+        for n, a in zip(names, arrays):
+            params[n.split(":", 1)[-1]] = a  # strip arg:/aux: prefixes
+        shape_kw = {k: tuple(v) for k, v in zip(input_keys, input_shapes)}
+        self.input_keys = list(input_keys)
+        self.exec = self.sym.simple_bind(self.ctx, grad_req="null",
+                                         **shape_kw)
+        for n in self.exec._arg_names:
+            if n in params:
+                self.exec.arg_dict[n][:] = params[n]
+        for n in self.exec._aux_names:
+            if n in params:
+                self.exec.aux_dict[n][:] = params[n]
+        self._nd = nd
+
+    def set_input(self, name, buf):
+        arr = self.exec.arg_dict[name]
+        host = _np.frombuffer(buf, dtype=_np.float32).reshape(arr.shape)
+        arr[:] = host
+
+    def forward(self):
+        self.exec.forward(is_train=False)
+
+    def output_shape(self, i):
+        return tuple(int(d) for d in self.exec.outputs[i].shape)
+
+    def output(self, i):
+        return self.exec.outputs[i].asnumpy().astype(
+            _np.float32).tobytes()
+
+    def reshape(self, keys, shapes):
+        kw = {k: tuple(v) for k, v in zip(keys, shapes)}
+        self.exec = self.exec.reshape(allow_up_sizing=True, **kw)
+
+
+def pred_create(symbol_json, param_bytes, dev_str, input_keys,
+                input_shapes):
+    return _Predictor(symbol_json, param_bytes, dev_str, input_keys,
+                      input_shapes)
+
+
+# -------------------------------------------------------------------- misc
+
+def random_seed(seed):
+    from . import random
+    random.seed(int(seed))
+
+
+def lib_info_features():
+    from .runtime import feature_list
+    feats = feature_list()
+    names = [f.name for f in feats]
+    enabled = [1 if f.enabled else 0 for f in feats]
+    return names, enabled
+
+
+def device_count():
+    import jax
+    return len(jax.devices())
+
+
+def is_np_shape():
+    from . import numpy_extension as npx
+    return 1 if npx.is_np_shape() else 0
+
+
+def set_np_shape(active):
+    from . import numpy_extension as npx
+    prev = npx.is_np_shape()
+    if active:
+        npx.set_np()
+    else:
+        npx.reset_np()
+    return 1 if prev else 0
+
+
+def profiler_set_state(state):
+    from . import profiler
+    profiler.set_state(state)
+
+
+def profiler_set_config(keys, vals):
+    from . import profiler
+    profiler.set_config(**_kwargs(keys, vals))
+
+
+def profiler_dump(finished):
+    from . import profiler
+    profiler.dump(bool(finished))

@@ -36,15 +36,23 @@ else:
     # assertions written against XLA-CPU exactness get the accelerator
     # tolerance when the suite retargets the chip (TPU transcendental
     # approximations differ by ~1e-4 rel).
+    import numpy as _np
     import numpy.testing as _npt
     _orig_allclose = _npt.assert_allclose
 
     def _tpu_allclose(actual, desired, rtol=1e-7, atol=0, *args, **kwargs):
-        return _orig_allclose(actual, desired, rtol=max(rtol, 1e-3),
-                              atol=max(atol, 1e-5), *args, **kwargs)
+        # Floor only floating-point comparisons that didn't ask for
+        # exactness: rtol=0 is an explicit exact-match intent and integer
+        # comparisons must stay bitwise — only default-ish float tolerances
+        # get the accelerator floor.
+        a, d = _np.asarray(actual), _np.asarray(desired)
+        floaty = a.dtype.kind in "fc" or d.dtype.kind in "fc"
+        if floaty and rtol != 0:
+            rtol, atol = max(rtol, 1e-3), max(atol, 1e-5)
+        return _orig_allclose(actual, desired, rtol=rtol, atol=atol,
+                              *args, **kwargs)
 
     _npt.assert_allclose = _tpu_allclose
-    np.testing.assert_allclose = _tpu_allclose
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

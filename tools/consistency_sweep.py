@@ -65,6 +65,14 @@ def main():
         n_fail = int(m.group(1)) if m else 0
         m = re.search(r"(\d+) skipped", summary)
         n_skip = int(m.group(1)) if m else 0
+        # A collection error or crash matches neither regex; don't let it
+        # masquerade as a green run — count it as one failure with context.
+        if r.returncode != 0 and n_fail == 0:
+            n_fail = 1
+            err_tail = ((r.stderr or "") + "\n" + (r.stdout or ""))
+            err_tail = " / ".join(err_tail.strip().splitlines()[-3:])
+            failures.append("CRASH %s (rc=%d): %s" % (f, r.returncode,
+                                                      err_tail[:400]))
         rows.append((f, n_pass, n_fail, n_skip, dt))
         print("%-32s %3d passed %3d failed %3d skipped  %5.1fs"
               % (f, n_pass, n_fail, n_skip, dt), flush=True)
