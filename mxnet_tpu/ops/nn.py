@@ -335,8 +335,11 @@ _softmax_output.defvjp(_softmax_output_fwd, _softmax_output_bwd)
 
 @register("softmax_cross_entropy")
 def softmax_cross_entropy(data, label):
+    # label < 0 = ignore (native RecordIO emits -1 for corrupt records)
     logp = jax.nn.log_softmax(data, axis=-1)
-    nll = -jnp.take_along_axis(logp, label.astype(jnp.int32)[:, None], axis=-1)
+    idx = label.astype(jnp.int32)
+    nll = -jnp.take_along_axis(logp, jnp.maximum(idx, 0)[:, None], axis=-1)
+    nll = jnp.where(idx[:, None] >= 0, nll, 0.0)
     return jnp.sum(nll)
 
 
@@ -526,15 +529,35 @@ def dot_product_attention(query, key, value, mask=None, dropout=0.0,
                           scaled=True, causal=False, rng_key=None, train=False):
     """TPU-native fused attention entry. Not in MXNet 1.6 (attention was
     composed from ops there) — exposed as a contrib op. When the problem
-    aligns to the pallas tiling (seq % 128 == 0, no mask, no dropout) and a
-    TPU is present, lowers to the flash-attention pallas kernel
-    (ops/pallas_kernels.py); else the XLA softmax path below."""
-    if (mask is None and (dropout == 0.0 or not train)
-            and query.ndim == 4 and scaled):
+    aligns to the pallas tiling (seq % 128 == 0) and a TPU is present,
+    lowers to the flash-attention pallas kernel (ops/pallas_kernels.py) —
+    including BERT's padding keep-mask ((B,1,1,T) or (B,T), reduced to a
+    per-key mask) and train-time attention dropout (in-kernel counter RNG,
+    fwd/bwd consistent). Full (B,H,Q,K) masks and cross-attention take the
+    XLA softmax path below."""
+    import os
+    if query.ndim == 4 and scaled and \
+            not os.environ.get("MXTPU_DISABLE_FLASH"):
         from .pallas_kernels import flash_attention, flash_attention_usable
+        # BERT-style key padding masks broadcast over q: reducible to (B,S)
+        kv_mask = None
+        mask_ok = mask is None
+        if mask is not None and getattr(mask, "ndim", 0) == 4 and \
+                mask.shape[1] == 1 and mask.shape[2] == 1 and \
+                mask.shape[0] == query.shape[0] and \
+                mask.shape[3] == key.shape[2]:
+            kv_mask = mask[:, 0, 0, :]
+            mask_ok = True
+        elif mask is not None and getattr(mask, "ndim", 0) == 2 and \
+                mask.shape == (query.shape[0], key.shape[2]):
+            kv_mask = mask
+            mask_ok = True
+        drop = float(dropout) if train else 0.0
         # kernel tiles assume self-attention layout; cross-attention with
         # kv_len != q_len must take the XLA path
-        if (key.shape == query.shape and value.shape == query.shape
+        if (mask_ok and key.shape == query.shape
+                and value.shape == query.shape
+                and (drop == 0.0 or rng_key is not None)
                 and flash_attention_usable(query.shape, causal)):
             try:
                 on_tpu = any(d.platform not in ("cpu",)
@@ -542,7 +565,12 @@ def dot_product_attention(query, key, value, mask=None, dropout=0.0,
             except RuntimeError:
                 on_tpu = False
             if on_tpu:
-                return flash_attention(query, key, value, causal)
+                seed = None
+                if drop > 0.0:
+                    seed = jax.random.randint(
+                        rng_key, (), -2**31, 2**31 - 1, dtype=jnp.int32)
+                return flash_attention(query, key, value, kv_mask, seed,
+                                       causal, drop)
     d = query.shape[-1]
     scores = jnp.einsum("...qd,...kd->...qk", query, key)
     if scaled:
@@ -552,7 +580,11 @@ def dot_product_attention(query, key, value, mask=None, dropout=0.0,
         cm = jnp.tril(jnp.ones((q, k), dtype=bool))
         scores = jnp.where(cm, scores, jnp.finfo(scores.dtype).min)
     if mask is not None:
-        scores = jnp.where(mask.astype(bool), scores, jnp.finfo(scores.dtype).min)
+        m = mask
+        if getattr(m, "ndim", 0) == 2 and scores.ndim == 4 and \
+                m.shape == (scores.shape[0], scores.shape[-1]):
+            m = m[:, None, None, :]  # (B,T) key mask -> broadcast form
+        scores = jnp.where(m.astype(bool), scores, jnp.finfo(scores.dtype).min)
     w = jax.nn.softmax(scores, axis=-1)
     if dropout > 0.0 and train:
         keep = jax.random.bernoulli(rng_key, 1.0 - dropout, w.shape)

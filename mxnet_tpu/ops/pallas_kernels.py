@@ -5,10 +5,22 @@ Role parity: the reference hand-writes CUDA kernels for its hot paths
 few ops XLA doesn't already fuse optimally get Pallas kernels. First
 citizen: flash attention — O(S) memory blockwise attention with online
 softmax, the kernel that sets the ceiling for long-context transformer
-throughput. Forward is Pallas (MXU matmuls over VMEM-resident tiles,
-fp32 accumulators); backward uses XLA's autodiff over the reference
-formulation (recompute-based, still O(S^2/block) flops but memory-safe via
-jax.checkpoint).
+throughput. This is exactly the fusion the reference could never do:
+its attention was composed from ops (`src/operator/contrib/
+transformer.cc`), materialising the (S, S) score matrix in HBM.
+
+Forward AND backward are Pallas (MXU matmuls over VMEM-resident tiles,
+fp32 accumulators; backward recomputes score tiles from the saved
+logsumexp — the standard flash-attention-2 dq/dkdv split).
+
+Supports the full training configuration of the transformer model zoo:
+  - key padding mask (B, S): BERT-style bidirectional masking;
+  - causal masking with block-level skipping;
+  - attention dropout via a counter-based in-kernel PRNG (lowbias32 hash
+    over global (head, q, k) element coordinates + a per-call seed), so
+    forward and both backward kernels regenerate identical keep bits with
+    no O(S^2) mask materialisation and no pltpu PRNG dependency (which
+    has no CPU interpret path).
 
 Layout: (batch, heads, seq, head_dim), blocks of 128 on seq to match the
 MXU/VPU tiling constraints (pallas_guide.md).
@@ -23,7 +35,6 @@ import jax.numpy as jnp
 
 try:
     from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
     _HAS_PALLAS = True
 except ImportError:  # pragma: no cover
     _HAS_PALLAS = False
@@ -47,11 +58,50 @@ def flash_attention_usable(q_shape, causal=False):
     return S % BLOCK_Q == 0 and S >= BLOCK_Q and D <= 256
 
 
-def _attn_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, blk_q, blk_k,
-                 seq_len):
+# --------------------------------------------------------------- dropout rng
+
+_U32 = jnp.uint32
+
+
+def _lowbias32(x):
+    """lowbias32 integer hash (public-domain constant set): good avalanche
+    at 2 multiply + 3 xorshift — plenty for dropout bits, runs on the VPU
+    as plain uint32 lane math."""
+    x = x ^ (x >> _U32(16))
+    x = x * _U32(0x7FEB352D)
+    x = x ^ (x >> _U32(15))
+    x = x * _U32(0x846CA68B)
+    x = x ^ (x >> _U32(16))
+    return x
+
+
+def _keep_bits(seed, bh, q0, k0, blk_q, blk_k, keep_prob):
+    """Deterministic keep-mask tile for global element (bh, q0+i, k0+j).
+
+    Identical calls from the forward and the two backward kernels
+    regenerate identical bits — the dropout mask is never materialised.
+    """
+    qi = q0 + jax.lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 0)
+    ki = k0 + jax.lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 1)
+    c = (qi.astype(_U32) * _U32(0x9E3779B9)) ^ \
+        (ki.astype(_U32) * _U32(0x85EBCA6B)) ^ \
+        (bh.astype(_U32) * _U32(0xC2B2AE35)) ^ seed.astype(_U32)
+    bits = _lowbias32(c)
+    thresh = _U32(min(int(keep_prob * 4294967296.0), 4294967295))
+    return bits < thresh
+
+
+# ------------------------------------------------------------------- forward
+
+def _attn_fwd_kernel(seed_ref, q_ref, k_ref, v_ref, mask_ref, o_ref,
+                     lse_ref, *, scale, causal, blk_q, blk_k, seq_len,
+                     dropout, has_mask):
     """One (batch*head, q-block) program: stream K/V blocks with online
-    softmax accumulation in fp32."""
+    softmax accumulation in fp32. Also writes the per-row logsumexp the
+    backward kernels recompute probability tiles from."""
+    bh = pl.program_id(0)
     qi = pl.program_id(1)
+    seed = seed_ref[0, 0]
     q = q_ref[0].astype(jnp.float32) * jnp.float32(scale)  # (blk_q, D)
 
     n_kb = seq_len // blk_k
@@ -62,16 +112,33 @@ def _attn_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, blk_q, blk_k,
         v = v_ref[0, pl.ds(kb * blk_k, blk_k), :].astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
+        dead = None
         if causal:
             q_pos = qi * blk_q + jax.lax.broadcasted_iota(
                 jnp.int32, (blk_q, blk_k), 0)
             k_pos = kb * blk_k + jax.lax.broadcasted_iota(
                 jnp.int32, (blk_q, blk_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, jnp.float32(NEG_INF))
+            dead = q_pos < k_pos
+        if has_mask:
+            mrow = mask_ref[0, 0:1, pl.ds(kb * blk_k, blk_k)]  # (1, blk_k)
+            mdead = mrow == 0
+            dead = mdead if dead is None else (dead | mdead)
+        if dead is not None:
+            s = jnp.where(dead, jnp.float32(NEG_INF), s)
         m_new = jnp.maximum(m_i, jnp.max(s, axis=-1))
+        # masked positions contribute EXACTLY zero (not exp(-1e30 - m)):
+        # fully-masked rows then keep l = 0 and the epsilon guard below
+        # returns 0 output instead of garbage
         p = jnp.exp(s - m_new[:, None])
+        if dead is not None:
+            p = jnp.where(dead, jnp.float32(0.0), p)
         corr = jnp.exp(m_i - m_new)
-        l_new = l_i * corr + jnp.sum(p, axis=-1)
+        l_new = l_i * corr + jnp.sum(p, axis=-1)  # normalizer: pre-dropout
+        if dropout > 0.0:
+            keep = _keep_bits(seed, bh, qi * blk_q, kb * blk_k, blk_q,
+                              blk_k, 1.0 - dropout)
+            p = jnp.where(keep, p / jnp.float32(1.0 - dropout),
+                          jnp.float32(0.0))
         pv = jax.lax.dot_general(p, v, (((1,), (0,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         acc = acc * corr[:, None] + pv
@@ -91,44 +158,262 @@ def _attn_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, blk_q, blk_k,
     # i64/i32 ('arith.muli' verification error in Mosaic)
     acc, m_i, l_i = jax.lax.fori_loop(jnp.int32(0), jnp.int32(n_iter),
                                       body, (acc, m_i, l_i))
-    o_ref[0] = (acc / jnp.maximum(l_i, jnp.float32(1e-20))[:, None]
-                ).astype(o_ref.dtype)
+    l_safe = jnp.maximum(l_i, jnp.float32(1e-20))
+    o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+    lse_ref[0, 0, :] = m_i + jnp.log(l_safe)
 
 
-def _flash_fwd(q, k, v, causal, interpret):
+# ------------------------------------------------------------ backward tiles
+
+def _recompute_tile(q, k, lse, seed, bh, q0, k0, mask_row, causal,
+                    dropout, scale, blk_q, blk_k):
+    """Recompute (P, Pdrop, keep, dead) for one (q-block, k-block) tile
+    from the saved logsumexp. Shared by the dq and dkdv kernels."""
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    s = s * jnp.float32(scale)
+    dead = None
+    if causal:
+        q_pos = q0 + jax.lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 0)
+        k_pos = k0 + jax.lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 1)
+        dead = q_pos < k_pos
+    if mask_row is not None:
+        mdead = mask_row == 0
+        dead = mdead if dead is None else (dead | mdead)
+    p = jnp.exp(s - lse[:, None])
+    if dead is not None:
+        p = jnp.where(dead, jnp.float32(0.0), p)
+    keep = None
+    pd = p
+    if dropout > 0.0:
+        keep = _keep_bits(seed, bh, q0, k0, blk_q, blk_k, 1.0 - dropout)
+        pd = jnp.where(keep, p / jnp.float32(1.0 - dropout),
+                       jnp.float32(0.0))
+    return p, pd, keep
+
+
+def _attn_bwd_dq_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                        delta_ref, mask_ref, dq_ref, *, scale, causal,
+                        blk_q, blk_k, seq_len, dropout, has_mask):
+    """grad wrt Q: one (batch*head, q-block) program streaming K blocks.
+    dS = P o (dP - delta); dQ = dS K * scale (flash-attention-2 eq. 4)."""
+    bh = pl.program_id(0)
+    qi = pl.program_id(1)
+    seed = seed_ref[0, 0]
+    q = q_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)           # (blk_q, D)
+    lse = lse_ref[0, 0, :]                       # (blk_q,)
+    delta = delta_ref[0, 0, :]                   # (blk_q,)
+
+    def body(kb, dq_acc):
+        k = k_ref[0, pl.ds(kb * blk_k, blk_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(kb * blk_k, blk_k), :].astype(jnp.float32)
+        mask_row = None
+        if has_mask:
+            mask_row = mask_ref[0, 0:1, pl.ds(kb * blk_k, blk_k)]
+        p, _, keep = _recompute_tile(q, k, lse, seed, bh, qi * blk_q,
+                                     kb * blk_k, mask_row, causal,
+                                     dropout, scale, blk_q, blk_k)
+        dpd = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                  preferred_element_type=jnp.float32)
+        if dropout > 0.0:
+            dp = jnp.where(keep, dpd / jnp.float32(1.0 - dropout),
+                           jnp.float32(0.0))
+        else:
+            dp = dpd
+        ds = p * (dp - delta[:, None])
+        dq_acc = dq_acc + jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return dq_acc
+
+    if causal:
+        n_iter = qi * (blk_q // blk_k) + (blk_q // blk_k)
+    else:
+        n_iter = seq_len // blk_k
+    dq = jax.lax.fori_loop(
+        jnp.int32(0), jnp.int32(n_iter), body,
+        jnp.zeros((blk_q, q.shape[-1]), jnp.float32))
+    dq_ref[0] = (dq * jnp.float32(scale)).astype(dq_ref.dtype)
+
+
+def _attn_bwd_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                         delta_ref, mask_ref, dk_ref, dv_ref, *, scale,
+                         causal, blk_q, blk_k, seq_len, dropout, has_mask):
+    """grads wrt K and V: one (batch*head, k-block) program streaming Q
+    blocks. dV = Pdrop^T dO; dK = dS^T Q * scale."""
+    bh = pl.program_id(0)
+    ki = pl.program_id(1)
+    seed = seed_ref[0, 0]
+    k = k_ref[0].astype(jnp.float32)             # (blk_k, D)
+    v = v_ref[0].astype(jnp.float32)
+    mask_row = None
+    if has_mask:
+        mask_row = mask_ref[0, 0:1, pl.ds(ki * blk_k, blk_k)]
+
+    def body(qj, carry):
+        dk_acc, dv_acc = carry
+        # causal: q-blocks before the diagonal contribute nothing; qb
+        # indexes the tail [diag_start, nQ)
+        if causal:
+            qb = qj + ki * (blk_k // blk_q)
+        else:
+            qb = qj
+        q = q_ref[0, pl.ds(qb * blk_q, blk_q), :].astype(jnp.float32)
+        do = do_ref[0, pl.ds(qb * blk_q, blk_q), :].astype(jnp.float32)
+        lse = lse_ref[0, 0, pl.ds(qb * blk_q, blk_q)]
+        delta = delta_ref[0, 0, pl.ds(qb * blk_q, blk_q)]
+        p, pd, keep = _recompute_tile(q, k, lse, seed, bh, qb * blk_q,
+                                      ki * blk_k, mask_row, causal,
+                                      dropout, scale, blk_q, blk_k)
+        dv_acc = dv_acc + jax.lax.dot_general(
+            pd, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dpd = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                  preferred_element_type=jnp.float32)
+        if dropout > 0.0:
+            dp = jnp.where(keep, dpd / jnp.float32(1.0 - dropout),
+                           jnp.float32(0.0))
+        else:
+            dp = dpd
+        ds = p * (dp - delta[:, None])
+        dk_acc = dk_acc + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return dk_acc, dv_acc
+
+    n_qb = seq_len // blk_q
+    if causal:
+        n_iter = n_qb - ki * (blk_k // blk_q)
+    else:
+        n_iter = n_qb
+    D = k.shape[-1]
+    dk, dv = jax.lax.fori_loop(
+        jnp.int32(0), jnp.int32(n_iter), body,
+        (jnp.zeros((blk_k, D), jnp.float32),
+         jnp.zeros((blk_k, D), jnp.float32)))
+    dk_ref[0] = (dk * jnp.float32(scale)).astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+# ----------------------------------------------------------- pallas plumbing
+
+def _prep(q, k, v, kv_mask, seed):
+    B, H, S, D = q.shape
+    qr = q.reshape(B * H, S, D)
+    kr = k.reshape(B * H, S, D)
+    vr = v.reshape(B * H, S, D)
+    if kv_mask is None:
+        mr = jnp.ones((B, 1, S), jnp.int32)  # dummy operand, loads elided
+    else:
+        mr = kv_mask.astype(jnp.int32).reshape(B, 1, S)
+    if seed is None:
+        sr = jnp.zeros((1, 1), jnp.int32)
+    else:
+        sr = jnp.asarray(seed, jnp.int32).reshape(1, 1)
+    return qr, kr, vr, mr, sr
+
+
+def _flash_fwd_impl(q, k, v, kv_mask, seed, causal, dropout, interpret):
     B, H, S, D = q.shape
     # plain Python float: np.float64 is strongly typed and would promote
     # the f32 kernel to f64 under x64 (TPU Mosaic has no 64-bit types)
     scale = float(1.0 / np.sqrt(D))
-    qr = q.reshape(B * H, S, D)
-    kr = k.reshape(B * H, S, D)
-    vr = v.reshape(B * H, S, D)
+    qr, kr, vr, mr, sr = _prep(q, k, v, kv_mask, seed)
     grid = (B * H, S // BLOCK_Q)
-    kernel = functools.partial(_attn_kernel, scale=scale, causal=causal,
-                               blk_q=BLOCK_Q, blk_k=BLOCK_K, seq_len=S)
+    kernel = functools.partial(
+        _attn_fwd_kernel, scale=scale, causal=causal, blk_q=BLOCK_Q,
+        blk_k=BLOCK_K, seq_len=S, dropout=float(dropout),
+        has_mask=kv_mask is not None)
     call = pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((B * H, S, D), q.dtype),
+        out_shape=(jax.ShapeDtypeStruct((B * H, S, D), q.dtype),
+                   jax.ShapeDtypeStruct((B * H, 1, S), jnp.float32)),
         grid=grid,
         in_specs=[
+            pl.BlockSpec((1, 1), lambda b, i: (0, 0)),          # seed
             pl.BlockSpec((1, BLOCK_Q, D), lambda b, i: (b, i, 0)),
             pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, 1, S), lambda b, i, H=H: (b // H, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, BLOCK_Q, D), lambda b, i: (b, i, 0)),
+        out_specs=(pl.BlockSpec((1, BLOCK_Q, D), lambda b, i: (b, i, 0)),
+                   pl.BlockSpec((1, 1, BLOCK_Q), lambda b, i: (b, 0, i))),
         interpret=interpret,
     )
     # trace with x64 off: this framework enables jax_enable_x64 globally
     # (int64 index parity), but Mosaic's grid machinery then emits i64
     # scalars that fail to legalize ('func.return') on the TPU compiler —
     # the kernel itself is pure f32/i32
-    from jax.experimental import enable_x64
-    with enable_x64(False):
-        out = call(qr, kr, vr)
-    return out.reshape(B, H, S, D)
+    with jax.enable_x64(False):
+        out, lse = call(sr, qr, kr, vr, mr)
+    return out.reshape(B, H, S, D), lse
 
 
-def _reference_attention(q, k, v, causal):
+def _flash_bwd_impl(q, k, v, kv_mask, seed, o, lse, g, causal, dropout,
+                    interpret):
+    B, H, S, D = q.shape
+    scale = float(1.0 / np.sqrt(D))
+    qr, kr, vr, mr, sr = _prep(q, k, v, kv_mask, seed)
+    gr = g.reshape(B * H, S, D)
+    orr = o.reshape(B * H, S, D)
+    # delta_i = rowsum(dO o O): one fused XLA elementwise+reduce, O(S·D)
+    delta = jnp.sum(gr.astype(jnp.float32) * orr.astype(jnp.float32),
+                    axis=-1)[:, None, :]
+    common = dict(scale=scale, causal=causal, blk_q=BLOCK_Q, blk_k=BLOCK_K,
+                  seq_len=S, dropout=float(dropout),
+                  has_mask=kv_mask is not None)
+    seed_spec = pl.BlockSpec((1, 1), lambda b, i: (0, 0))
+    mask_spec = pl.BlockSpec((1, 1, S), lambda b, i, H=H: (b // H, 0, 0))
+    full_spec = pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0))
+    row_full = pl.BlockSpec((1, 1, S), lambda b, i: (b, 0, 0))
+
+    dq_call = pl.pallas_call(
+        functools.partial(_attn_bwd_dq_kernel, **common),
+        out_shape=jax.ShapeDtypeStruct((B * H, S, D), q.dtype),
+        grid=(B * H, S // BLOCK_Q),
+        in_specs=[
+            seed_spec,
+            pl.BlockSpec((1, BLOCK_Q, D), lambda b, i: (b, i, 0)),  # q
+            full_spec,                                              # k
+            full_spec,                                              # v
+            pl.BlockSpec((1, BLOCK_Q, D), lambda b, i: (b, i, 0)),  # do
+            pl.BlockSpec((1, 1, BLOCK_Q), lambda b, i: (b, 0, i)),  # lse
+            pl.BlockSpec((1, 1, BLOCK_Q), lambda b, i: (b, 0, i)),  # delta
+            mask_spec,
+        ],
+        out_specs=pl.BlockSpec((1, BLOCK_Q, D), lambda b, i: (b, i, 0)),
+        interpret=interpret,
+    )
+    dkv_call = pl.pallas_call(
+        functools.partial(_attn_bwd_dkv_kernel, **common),
+        out_shape=(jax.ShapeDtypeStruct((B * H, S, D), k.dtype),
+                   jax.ShapeDtypeStruct((B * H, S, D), v.dtype)),
+        grid=(B * H, S // BLOCK_K),
+        in_specs=[
+            seed_spec,
+            full_spec,                                              # q
+            pl.BlockSpec((1, BLOCK_K, D), lambda b, i: (b, i, 0)),  # k
+            pl.BlockSpec((1, BLOCK_K, D), lambda b, i: (b, i, 0)),  # v
+            full_spec,                                              # do
+            row_full,                                               # lse
+            row_full,                                               # delta
+            mask_spec,
+        ],
+        out_specs=(pl.BlockSpec((1, BLOCK_K, D), lambda b, i: (b, i, 0)),
+                   pl.BlockSpec((1, BLOCK_K, D), lambda b, i: (b, i, 0))),
+        interpret=interpret,
+    )
+    with jax.enable_x64(False):
+        dq = dq_call(sr, qr, kr, vr, gr, lse, delta, mr)
+        dk, dv = dkv_call(sr, qr, kr, vr, gr, lse, delta, mr)
+    return (dq.reshape(B, H, S, D), dk.reshape(B, H, S, D),
+            dv.reshape(B, H, S, D))
+
+
+# ---------------------------------------------------------------- public API
+
+def _reference_attention(q, k, v, causal, kv_mask=None):
     D = q.shape[-1]
     s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
                    k.astype(jnp.float32)) / np.sqrt(D)
@@ -136,29 +421,39 @@ def _reference_attention(q, k, v, causal):
         S = s.shape[-1]
         mask = jnp.tril(jnp.ones((S, S), bool))
         s = jnp.where(mask, s, NEG_INF)
+    if kv_mask is not None:
+        s = jnp.where(kv_mask[:, None, None, :].astype(bool), s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)
                       ).astype(q.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def flash_attention(q, k, v, causal=False, interpret=False):
-    """Blockwise exact attention, (B, H, S, D) layout."""
-    return _flash_fwd(q, k, v, causal, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
+def flash_attention(q, k, v, kv_mask=None, seed=None, causal=False,
+                    dropout=0.0, interpret=False):
+    """Blockwise exact attention, (B, H, S, D) layout.
+
+    kv_mask: optional (B, S) key keep-mask (nonzero = attend).
+    seed:    int32 scalar for attention dropout (required if dropout > 0).
+    dropout: STATIC attention-probability dropout rate (traced under jit
+             per distinct value; rates are fixed hyperparameters).
+    """
+    out, _ = _flash_fwd_impl(q, k, v, kv_mask, seed, causal, dropout,
+                             interpret)
+    return out
 
 
-def _fa_fwd(q, k, v, causal, interpret):
-    return _flash_fwd(q, k, v, causal, interpret), (q, k, v)
+def _fa_fwd(q, k, v, kv_mask, seed, causal, dropout, interpret):
+    out, lse = _flash_fwd_impl(q, k, v, kv_mask, seed, causal, dropout,
+                               interpret)
+    return out, (q, k, v, kv_mask, seed, out, lse)
 
 
-def _fa_bwd(causal, interpret, res, g):
-    q, k, v = res
-    # backward via XLA autodiff of the reference formulation with remat —
-    # correct and memory-bounded; a hand-written pallas bwd is a further
-    # optimization hook
-    f = jax.checkpoint(lambda q, k, v: _reference_attention(q, k, v, causal))
-    _, vjp = jax.vjp(f, q, k, v)
-    return vjp(g)
+def _fa_bwd(causal, dropout, interpret, res, g):
+    q, k, v, kv_mask, seed, o, lse = res
+    dq, dk, dv = _flash_bwd_impl(q, k, v, kv_mask, seed, o, lse, g,
+                                 causal, dropout, interpret)
+    return dq, dk, dv, None, None
 
 
 flash_attention.defvjp(_fa_fwd, _fa_bwd)
