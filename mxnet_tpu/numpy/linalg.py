@@ -10,8 +10,8 @@ def _np():
     return np_mod
 
 
-def _wrap(name):
-    jfn = getattr(_jnp.linalg, name)
+def _wrap(name, jfn=None):
+    jfn = jfn or getattr(_jnp.linalg, name)
 
     def fn(*args, **kwargs):
         np_mod = _np()
@@ -31,8 +31,21 @@ def _wrap(name):
     return fn
 
 
+def _svd_fn(A, full_matrices=False, compute_uv=True):
+    # Default path routes through the registered op, which returns the
+    # reference layout (gesvd REDUCED factors — mxnet np.linalg.svd has no
+    # full_matrices param) and carries the TPU host fallback (no device
+    # solver — ops/numpy_ops.py _npi_svd). Explicit full_matrices /
+    # compute_uv requests go to jnp directly (CPU; unsupported on TPU).
+    if full_matrices or not compute_uv:
+        return _jnp.linalg.svd(A, full_matrices=full_matrices,
+                               compute_uv=compute_uv)
+    from ..ops import numpy_ops as _nops
+    return _nops._npi_svd.fn(A)
+
+
 norm = _wrap("norm")
-svd = _wrap("svd")
+svd = _wrap("svd", jfn=_svd_fn)
 inv = _wrap("inv")
 pinv = _wrap("pinv")
 det = _wrap("det")

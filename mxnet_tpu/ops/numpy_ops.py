@@ -77,9 +77,35 @@ def unique(x, return_index=False, return_inverse=False,
 @register("_npi_svd", aliases=("svd",), n_out=3)
 def _npi_svd(A):
     """gesvd returning (UT, L, V) in the reference's layout
-    (np_linalg svd: A = u @ diag(s) @ vh)."""
-    u, s, vh = jnp.linalg.svd(A, full_matrices=False)
-    return u, s, vh
+    (np_linalg svd: A = u @ diag(s) @ vh).
+
+    TPU has no native SVD lowering (libtpu aborts compiling the QR-sweep
+    expansion through this image's AOT helper), so off-CPU the
+    decomposition runs on the host via ``pure_callback`` — the same move
+    the reference makes routing gesvd to LAPACK when the device lacks a
+    solver (`src/operator/tensor/la_op.h` CPU path). Host path is
+    forward-only (no custom VJP), matching the reference's
+    no-backward-for-gesvd contract on non-LAPACK devices."""
+    try:
+        on_accel = any(d.platform not in ("cpu",) for d in jax.devices())
+    except RuntimeError:
+        on_accel = False
+    if not on_accel:
+        u, s, vh = jnp.linalg.svd(A, full_matrices=False)
+        return u, s, vh
+
+    import numpy as onp
+    from jax.core import Tracer
+    if isinstance(A, Tracer):
+        # host callbacks are also unsupported through this image's PJRT
+        # tunnel, so the host route only exists eagerly
+        raise NotImplementedError(
+            "svd inside jit is unsupported on TPU (no device solver, no "
+            "host callback); call it eagerly or on a CPU context")
+    dt = onp.dtype(onp.asarray(A).dtype)
+    u, s, vh = onp.linalg.svd(onp.ascontiguousarray(A), full_matrices=False)
+    return (jnp.asarray(u.astype(dt)), jnp.asarray(s.astype(dt)),
+            jnp.asarray(vh.astype(dt)))
 
 
 @register("einsum", aliases=("_npi_einsum",))

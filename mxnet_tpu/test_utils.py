@@ -63,8 +63,12 @@ def check_numeric_gradient(fn, inputs, eps=1e-3, rtol=1e-3, atol=1e-4):
     analytic = [x.grad.asnumpy().copy() for x in inputs]
 
     for i, x in enumerate(inputs):
-        base = x.asnumpy().astype(np.float64)
-        num = np.zeros_like(base)
+        # ascontiguousarray: the TPU-tunnel backend materialises device
+        # arrays F-contiguous, and ravel() of an F-order array is a COPY —
+        # the nflat[j] writes below would silently vanish (the
+        # docs/consistency_tpu.md all-zero-numeric failure class)
+        base = np.ascontiguousarray(x.asnumpy(), dtype=np.float64)
+        num = np.zeros(base.shape, dtype=np.float64)
         flat = base.ravel()
         nflat = num.ravel()
         for j in range(flat.size):

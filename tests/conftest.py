@@ -54,6 +54,19 @@ else:
 
     _npt.assert_allclose = _tpu_allclose
 
+    # same floor for plain np.allclose asserts (reference
+    # check_consistency applies the device tolerance to every comparison)
+    _orig_np_allclose = _np.allclose
+
+    def _tpu_np_allclose(a, b, rtol=1e-5, atol=1e-8, **kw):
+        aa, bb = _np.asarray(a), _np.asarray(b)
+        floaty = aa.dtype.kind in "fc" or bb.dtype.kind in "fc"
+        if floaty and rtol != 0:
+            rtol, atol = max(rtol, 1e-3), max(atol, 1e-5)
+        return _orig_np_allclose(a, b, rtol=rtol, atol=atol, **kw)
+
+    _np.allclose = _tpu_np_allclose
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
