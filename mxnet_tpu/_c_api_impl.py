@@ -163,7 +163,8 @@ def autograd_is_training():
     return autograd.is_training()
 
 
-_GRAD_REQ = {0: "null", 1: "write", 2: "add"}
+# reference OpReqType: 0 kNullOp, 1 kWriteTo, 2 kWriteInplace, 3 kAddTo
+_GRAD_REQ = {0: "null", 1: "write", 2: "write", 3: "add"}
 
 
 def autograd_mark_variables(arrays, reqs, grads):

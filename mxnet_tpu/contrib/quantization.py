@@ -147,21 +147,73 @@ def _int8_blocks():
     _conv = get_op("_contrib_quantized_conv")
     _deq = get_op("_contrib_dequantize")
 
+    from ..gluon.parameter import Constant
+    from ..ndarray import ndarray as _ndm
+
+    def _const_param(name, arr, dtype):
+        p = Constant(name, _ndm.array(arr, dtype=dtype))
+        p.initialize()
+        return p
+
     class _Int8Layer(Block):
+        """int8 weights, weight range, bias and the calibrated activation
+        range are REGISTERED Parameters, so ``save_parameters`` /
+        ``load_parameters`` round-trip a quantized net (round-2 advisor
+        finding: plain attributes were silently dropped). ``calib`` holds
+        (min, max); NaN means uncalibrated → dynamic per-batch ranges."""
+
         def __init__(self, weight, bias, act):
             super().__init__()
             w = weight.astype(np.float32)
             amax = max(float(np.abs(w).max()), 1e-12)
             q = np.clip(np.round(w / (amax / 127.0)), -127,
                         127).astype(np.int8)
-            self._wq = NDArray(jnp.asarray(q))
-            self._wmn = NDArray(jnp.asarray([-amax], jnp.float32))
-            self._wmx = NDArray(jnp.asarray([amax], jnp.float32))
-            self._b = None if bias is None else NDArray(
-                jnp.asarray(bias.astype(np.float32)))
+            self.qweight = _const_param("qweight", q, "int8")
+            self.wrange = _const_param(
+                "wrange", np.array([-amax, amax], np.float32), "float32")
+            self.qbias = None if bias is None else _const_param(
+                "qbias", bias.astype(np.float32), "float32")
+            self.calib = _const_param(
+                "calib", np.array([np.nan, np.nan], np.float32), "float32")
             self._act = act
             self._calibrating = False
-            self._range = None  # (min, max) after calibration
+            self._range = None      # runtime cache of the calib Parameter
+            self._range_src = None  # jax buffer the cache was read from
+
+        @property
+        def _wq(self):
+            return self.qweight.data()
+
+        @property
+        def _wmn(self):
+            return self.wrange.data()[0:1]
+
+        @property
+        def _wmx(self):
+            return self.wrange.data()[1:2]
+
+        @property
+        def _b(self):
+            return None if self.qbias is None else self.qbias.data()
+
+        def _freeze_calibration(self):
+            if self._range is not None:
+                self.calib.set_data(_ndm.array(
+                    np.asarray(self._range, np.float32)))
+                self._range_src = self.calib.data()._data
+
+        def _calib_range(self):
+            # host read only when the underlying buffer changed (jax
+            # arrays are immutable, so identity identifies the value) —
+            # load_parameters() after a forward is still picked up, and
+            # steady-state forwards pay no device sync
+            cur = self.calib.data()._data
+            if cur is not self._range_src:
+                rng = np.asarray(cur)
+                self._range = (None if np.isnan(rng[0])
+                               else [float(rng[0]), float(rng[1])])
+                self._range_src = cur
+            return self._range
 
         def _quantize_in(self, x):
             if self._calibrating:
@@ -172,9 +224,11 @@ def _int8_blocks():
                 else:
                     self._range = [min(self._range[0], lo),
                                    max(self._range[1], hi)]
-            if self._range is not None and not self._calibrating:
-                return _quant(x, min_calib_range=self._range[0],
-                              max_calib_range=self._range[1])
+                return _quant(x)
+            rng = self._calib_range()
+            if rng is not None:
+                return _quant(x, min_calib_range=rng[0],
+                              max_calib_range=rng[1])
             return _quant(x)
 
     class _Int8Dense(_Int8Layer):
@@ -276,6 +330,7 @@ def quantize_net(network, quantized_dtype="int8", quantize_mode="full",
                 break
         for qb in swapped:
             qb._calibrating = False
+            qb._freeze_calibration()
         logger.info("calibrated %d layers on %d examples", count, seen)
     logger.info("quantize_net: %d layers swapped to int8", count)
     return network

@@ -129,7 +129,13 @@ class SoftmaxCrossEntropyLoss(Loss):
         if not self._from_logits:
             pred = F.log_softmax(pred, axis=self._axis)
         if self._sparse_label:
-            loss = -F.pick(pred, label, axis=self._axis, keepdims=True)
+            # label < 0 is the ignore convention (the native RecordIO
+            # decoder emits -1 for undecodable records): clamp the index
+            # for pick, then zero the contribution
+            valid = label >= 0
+            loss = -F.pick(pred, F.maximum(label, F.zeros_like(label)),
+                           axis=self._axis, keepdims=True)
+            loss = loss * valid.astype(loss.dtype).reshape(loss.shape)
         else:
             label = _reshape_like(F, label, pred)
             loss = -F.sum(pred * label, axis=self._axis, keepdims=True)

@@ -139,8 +139,43 @@ class KVStore:
         self.pull(key, out if out is not None else value, priority)
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
-        # dense fallback (sparse = API-complete, SURVEY §2.1 note)
-        self.pull(key, out=out, priority=priority)
+        """Pull only the rows named by ``row_ids`` into a row_sparse
+        output (reference KVStore::PullRowSparse `kvstore_local.h:359`:
+        row ids are deduplicated+sorted, values gathered server-side so
+        only the touched rows travel)."""
+        if row_ids is None or out is None:
+            return self.pull(key, out=out, priority=priority)
+        import numpy as _onp
+        from .ndarray.sparse import RowSparseNDArray
+        keys, outs = _key_value(key, out)
+        # a list is per-key ONLY when it lines up with the key list and
+        # holds array-likes; a plain [0, 2] row-id list for a single key
+        # must stay one id-set (it would otherwise zip away rows)
+        if isinstance(row_ids, (list, tuple)) and \
+                len(row_ids) == len(keys) and \
+                all(hasattr(r, "__len__") or hasattr(r, "shape")
+                    for r in row_ids):
+            rids = list(row_ids)
+        else:
+            rids = [row_ids] * len(keys)
+        for k, o, rid in zip(keys, outs, rids):
+            if k not in self._store:
+                raise MXNetError("key %s has not been initialized" % k)
+            if not isinstance(o, RowSparseNDArray):
+                # dense out keeps the full-value pull semantics (reference
+                # dense fallback path); only row_sparse outs row-filter
+                self.pull(k, out=o, priority=priority)
+                continue
+            src = self._store[k]
+            idx = _onp.unique(_onp.asarray(
+                rid.asnumpy() if hasattr(rid, "asnumpy") else rid
+            ).astype(_onp.int64).ravel())
+            vals = src._data[jnp.asarray(idx)]
+            o._values = jnp.asarray(vals)
+            o._idx = jnp.asarray(idx)
+            o._dense_cache = None
+            o._shape_ = tuple(src.shape)
+            o._dtype_ = src._data.dtype
 
     def broadcast(self, key, value, out=None, priority=0):
         self.init(key, value)

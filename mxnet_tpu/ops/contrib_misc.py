@@ -37,7 +37,13 @@ def index_copy(old_tensor, index_vector, new_tensor):
           differentiable=False)
 def index_array(data, axes=None):
     """Per-element coordinate array: output shape ``data.shape + (len(axes)
-    or ndim,)`` of int64 indices (reference contrib/index_array.cc)."""
+    or ndim,)`` of int64 indices (reference contrib/index_array.cc).
+
+    Documented deviation: the reference always emits int64. Here the
+    element type follows jax_enable_x64 — int64 when x64 is on (this
+    framework's default), int32 otherwise (e.g. inside the Pallas/Mosaic
+    paths, which have no 64-bit types). Coordinates are bounded by array
+    dims, so int32 is lossless for any shape XLA can compile."""
     nd = data.ndim
     sel = tuple(range(nd)) if axes is None else tuple(int(a) for a in axes)
     coords = [lax.broadcasted_iota(jnp.int64, data.shape, ax) for ax in sel]

@@ -331,10 +331,17 @@ def DeformablePSROIPooling(data, rois, trans=None, spatial_scale=1.0,
 
     def one(roi, tr):
         bidx = roi[0].astype(jnp.int32)
-        x1 = roi[1] * spatial_scale
-        y1 = roi[2] * spatial_scale
-        w = jnp.maximum(roi[3] * spatial_scale - x1, 0.1)
-        h = jnp.maximum(roi[4] * spatial_scale - y1, 0.1)
+        # reference geometry (deformable_psroi_pooling.cc:85-88): integer-
+        # rounded ROI corners, half-pixel shift, end corner inclusive
+        # (round-2 advisor finding: the unrounded variant deviated).
+        # floor(x + 0.5), not jnp.round: C round() is half-away-from-zero
+        # while jnp.round is half-to-even, and ROI coords are >= 0
+        x1 = jnp.floor(roi[1] + 0.5) * spatial_scale - 0.5
+        y1 = jnp.floor(roi[2] + 0.5) * spatial_scale - 0.5
+        w = jnp.maximum(
+            (jnp.floor(roi[3] + 0.5) + 1.0) * spatial_scale - 0.5 - x1, 0.1)
+        h = jnp.maximum(
+            (jnp.floor(roi[4] + 0.5) + 1.0) * spatial_scale - 0.5 - y1, 0.1)
         bw, bh = w / p, h / p
         iy = jnp.arange(p, dtype=data.dtype)
         ix = jnp.arange(p, dtype=data.dtype)

@@ -274,17 +274,24 @@ def quantized_batch_norm(data, gamma, beta, moving_mean, moving_var,
 
 @register("_contrib_calibrate_entropy", aliases=("calibrate_entropy",),
           n_out=2, differentiable=False)
-def calibrate_entropy(hist, hist_edges, num_quantized_bins=255):
+def calibrate_entropy(hist, hist_edges, num_quantized_bins=255,
+                      search_stride=1):
     """KL-divergence threshold search over an activation histogram
     (reference calibrate.cc / the python _LayerOutputCollector path).
-    Host-side numpy: calibration is offline, never inside a jitted step."""
+    Host-side numpy: calibration is offline, never inside a jitted step.
+
+    ``search_stride``: evaluate every stride-th candidate threshold. The
+    reference scans every candidate (stride 1, the default here); larger
+    strides trade calibration time for threshold granularity (round-2
+    advisor finding: the old fixed stride of 8 was an undocumented
+    deviation)."""
     hist = _np.asarray(hist, dtype=_np.float64)
     edges = _np.asarray(hist_edges, dtype=_np.float64)
     num_bins = hist.size
     centers = (edges[:-1] + edges[1:]) / 2.0
     best_t, best_kl = float(edges[-1]), _np.inf
     start = num_quantized_bins // 2
-    for i in range(start, num_bins + 1, 8):
+    for i in range(start, num_bins + 1, max(1, int(search_stride))):
         t = centers[min(i, num_bins - 1)]
         p = hist[:i].copy()
         outliers = hist[i:].sum()

@@ -714,8 +714,34 @@ def load(fname):
         return load_json(f.read())
 
 
+def _attr_value(sv):
+    """Recover a typed kwarg from its serialized string. Handles BOTH this
+    framework's json-encoded values ('"4.0"' stays a string, '4.0' a
+    float) AND the reference export convention, where attrs are plain
+    dmlc-Parameter strings: '64', '(3, 3)', 'True', 'None'
+    (reference nnvm json: every attr is a string)."""
+    if not isinstance(sv, str):
+        return sv
+    try:
+        return json.loads(sv)
+    except (ValueError, TypeError):
+        pass
+    low = sv.strip().lower()
+    if low in ("true", "false"):
+        return low == "true"
+    if low in ("none",):
+        return None
+    try:
+        import ast
+        return ast.literal_eval(sv)  # tuples: "(3, 3)", "(1, 1)"
+    except (ValueError, SyntaxError):
+        return sv
+
+
 def load_json(json_str):
-    """Rebuild a Symbol graph from tojson output."""
+    """Rebuild a Symbol graph from tojson output OR from a
+    reference-convention ``-symbol.json`` export (plain string attrs,
+    2- or 3-element head entries, extra top-level keys ignored)."""
     data = json.loads(json_str)
     nodes = []
     for entry in data["nodes"]:
@@ -728,15 +754,12 @@ def load_json(json_str):
             if op is None:
                 raise MXNetError("cannot load symbol: unknown operator %r"
                                  % entry["op"])
-            kwargs = {}
-            for k, sv in (entry.get("attrs") or {}).items():
-                try:
-                    kwargs[k] = json.loads(sv)
-                except (ValueError, TypeError):
-                    kwargs[k] = sv
+            kwargs = {k: _attr_value(sv)
+                      for k, sv in (entry.get("attrs") or {}).items()}
             node = Symbol(op=op, inputs=[], kwargs=kwargs,
                           name=entry["name"])
-            sym_inputs = [(nodes[i], oi) for i, oi in entry["inputs"]]
+            # reference nnvm entries are [node, out_idx, version]
+            sym_inputs = [(nodes[e[0]], e[1]) for e in entry["inputs"]]
             consts = {pos: val for pos, val in entry.get("const_inputs", [])}
             if consts:
                 raw, si = [], iter(sym_inputs)
@@ -748,7 +771,7 @@ def load_json(json_str):
             node._raw_inputs = raw
             node._inputs = sym_inputs
             nodes.append(node)
-    heads = [(nodes[i], oi) for i, oi in data["heads"]]
+    heads = [(nodes[e[0]], e[1]) for e in data["heads"]]
     if len(heads) == 1 and heads[0][1] == 0:
         return heads[0][0]
     g = Symbol(op=None, name="group")

@@ -878,11 +878,12 @@ MXTPU_API int MXSymbolGetInternals(SymbolHandle sym, SymbolHandle* out) {
 MXTPU_API int MXSymbolGetName(SymbolHandle sym, const char** out,
                               int* success) {
   GILGuard gil;
+  *out = nullptr;
   PyObject* r = impl_call("symbol_get_name",
                           PyTuple_Pack(1, static_cast<PyObject*>(sym)));
   if (!r) return -1;
   int rc = ret_string(r, out);
-  if (success) *success = (*out != nullptr) ? 1 : 0;
+  if (success) *success = (rc == 0 && *out != nullptr) ? 1 : 0;
   Py_DECREF(r);
   return rc;
 }
@@ -990,12 +991,13 @@ MXTPU_API int MXSymbolCopy(SymbolHandle sym, SymbolHandle* out) {
 MXTPU_API int MXSymbolGetAttr(SymbolHandle sym, const char* key,
                               const char** out, int* success) {
   GILGuard gil;
+  *out = nullptr;
   PyObject* r = impl_call(
       "symbol_get_attr",
       Py_BuildValue("(Os)", static_cast<PyObject*>(sym), key));
   if (!r) return -1;
   int rc = ret_string(r, out);
-  if (success) *success = (*out != nullptr) ? 1 : 0;
+  if (success) *success = (rc == 0 && *out != nullptr) ? 1 : 0;
   Py_DECREF(r);
   return rc;
 }

@@ -125,7 +125,7 @@ int MXAutogradSetIsRecording(int is_recording, int* prev);
 int MXAutogradSetIsTraining(int is_training, int* prev);
 int MXAutogradIsRecording(int* out);
 int MXAutogradIsTraining(int* out);
-/* grad_reqs: 0=null 1=write 2=add (reference OpReqType) */
+/* grad_reqs: 0=null 1=write 2=write-inplace 3=add (reference OpReqType) */
 int MXAutogradMarkVariables(int num_var, NDArrayHandle* var_handles,
                             const int* grad_reqs,
                             NDArrayHandle* grad_handles);

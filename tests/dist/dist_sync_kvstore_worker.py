@@ -84,6 +84,52 @@ def main():
     np.testing.assert_allclose(local, np.full((4,), expected_sum,
                                               np.float32))
 
+    # ---- ordering invariant: push before init must raise ----
+    from mxnet_tpu.base import MXNetError
+    try:
+        kv.push("never_inited", nd.ones((2,)))
+        raise AssertionError("push before init did not raise")
+    except MXNetError:
+        pass
+
+    # ---- row_sparse pull (reference dist_sync_kvstore.py row_sparse
+    # invariants): every rank pulls a DIFFERENT row subset ----
+    from mxnet_tpu.ndarray import sparse as sp
+    kv.init("rs", nd.ones((nproc * 2, 3)))
+    kv.push("rs", nd.ones((nproc * 2, 3)) * (rank + 1))
+    rows = np.array([rank, rank + nproc], np.int64)
+    out_rs = sp.row_sparse_array(
+        (np.zeros((2, 3), np.float32), rows), shape=(nproc * 2, 3))
+    kv.row_sparse_pull("rs", out=out_rs, row_ids=nd.array(rows))
+    np.testing.assert_allclose(
+        np.asarray(out_rs.data.asnumpy()),
+        np.full((2, 3), expected_sum, np.float32),
+        err_msg="row_sparse_pull rank %d" % rank)
+    np.testing.assert_array_equal(
+        np.sort(out_rs.indices.asnumpy()), np.sort(rows))
+
+    # ---- compressed push (2bit threshold, error feedback) ----
+    kv2 = mx.kv.create("dist_sync")
+    kv2.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    kv2.init("c", nd.zeros((4,)))
+    for _ in range(2):
+        # every worker pushes 2.0 -> quantizes to +0.5 regardless of the
+        # accumulated residual; store = sum over workers = nproc * 0.5
+        kv2.push("c", nd.ones((4,)) * 2.0)
+        outc = nd.zeros((4,))
+        kv2.pull("c", out=outc)
+        np.testing.assert_allclose(outc.asnumpy(),
+                                   np.full((4,), nproc * 0.5, np.float32),
+                                   rtol=1e-6)
+    # negative values quantize to -threshold
+    kv2.push("c", nd.ones((4,)) * -5.0)
+    outc = nd.zeros((4,))
+    kv2.pull("c", out=outc)
+    np.testing.assert_allclose(outc.asnumpy(),
+                               np.full((4,), nproc * -0.5, np.float32),
+                               rtol=1e-6)
+
+    assert kv.num_dead_node == 0
     kv.barrier()
     print("rank %d OK" % rank, flush=True)
 

@@ -122,3 +122,26 @@ def test_weight_and_sample_weight():
     sw = np.array([[1.0], [0.0]], "float32")
     out2 = L.L1Loss()(_nd(p), _nd(t), _nd(sw)).asnumpy()
     np.testing.assert_allclose(out2, [1.0, 0.0])
+
+
+def test_softmax_ce_ignores_negative_labels():
+    """label -1 (the native RecordIO corrupt-record marker) contributes
+    ZERO loss in both the gluon loss and the softmax_cross_entropy op
+    (round-3 advisor finding: -1 resolved as the last class)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon
+    rng = np.random.RandomState(3)
+    pred = rng.randn(6, 4).astype("float32")
+    lab = np.array([0, 1, -1, 2, -1, 3], "float32")
+    L = gluon.loss.SoftmaxCrossEntropyLoss()
+    out = L(mx.nd.array(pred), mx.nd.array(lab)).asnumpy()
+    logp = pred - np.log(np.exp(pred - pred.max(-1, keepdims=True))
+                         .sum(-1, keepdims=True)) - pred.max(-1,
+                                                             keepdims=True)
+    expect = np.array([-logp[i, int(l)] if l >= 0 else 0.0
+                       for i, l in enumerate(lab)], "float32")
+    np.testing.assert_allclose(out, expect, rtol=1e-5, atol=1e-6)
+
+    op_out = mx.nd.softmax_cross_entropy(
+        mx.nd.array(pred), mx.nd.array(lab)).asnumpy()
+    np.testing.assert_allclose(op_out, expect.sum(), rtol=1e-5, atol=1e-5)
