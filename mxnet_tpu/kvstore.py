@@ -170,12 +170,19 @@ class KVStore:
             idx = _onp.unique(_onp.asarray(
                 rid.asnumpy() if hasattr(rid, "asnumpy") else rid
             ).astype(_onp.int64).ravel())
-            vals = src._data[jnp.asarray(idx)]
+            if idx.size and (idx[0] < 0 or idx[-1] >= src.shape[0]):
+                # jax gather would CLAMP out-of-range ids — silently wrong
+                raise MXNetError(
+                    "row_sparse_pull: row id out of range for key %s "
+                    "(shape %s, ids [%d, %d])"
+                    % (k, src.shape, int(idx[0]), int(idx[-1])))
+            out_dtype = o.dtype
+            vals = src._data[jnp.asarray(idx)].astype(out_dtype)
             o._values = jnp.asarray(vals)
             o._idx = jnp.asarray(idx)
             o._dense_cache = None
             o._shape_ = tuple(src.shape)
-            o._dtype_ = src._data.dtype
+            o._dtype_ = _onp.dtype(out_dtype)
 
     def broadcast(self, key, value, out=None, priority=0):
         self.init(key, value)

@@ -174,6 +174,12 @@ class Module(BaseModule):
                     out.append((s[0], tuple(s[1])))
             return out
 
+        # batch axis follows the DataDesc layout (reference
+        # DataDesc.get_batch_axis — time-major 'TNC' data has batch at 1)
+        self._batch_axis = 0
+        first = (data_shapes or [None])[0]
+        if isinstance(first, DataDesc):
+            self._batch_axis = DataDesc.get_batch_axis(first.layout)
         self._data_shapes = _norm(data_shapes)
         self._label_shapes = _norm(label_shapes)
         shape_kwargs = dict(self._data_shapes + self._label_shapes)
@@ -196,11 +202,29 @@ class Module(BaseModule):
         assert self.binded and self.params_initialized
         if self.optimizer_initialized and not force_init:
             return
+        # SoftmaxOutput-style heads emit per-sample gradients summed over
+        # the batch; the Module scales them down by the bound batch size
+        # (reference module.py:506 rescale_grad = 1.0/batch_size), read
+        # from the layout's batch axis (DataDesc.get_batch_axis)
+        axis = getattr(self, "_batch_axis", 0)
+        batch_size = self._data_shapes[0][1][axis] \
+            if self._data_shapes else 1
+        rescale_grad = 1.0 / max(batch_size, 1)
         if isinstance(optimizer, str):
             idx2name = {i: n for i, n in enumerate(self._param_names)}
             optimizer_params = dict(optimizer_params)
+            if "rescale_grad" not in optimizer_params:
+                optimizer_params["rescale_grad"] = rescale_grad
             optimizer = opt_mod.create(optimizer, param_idx2name=idx2name,
                                        **optimizer_params)
+        elif getattr(optimizer, "rescale_grad", rescale_grad) \
+                != rescale_grad:
+            import logging
+            logging.warning(
+                "Optimizer created manually outside Module but "
+                "rescale_grad is not normalized to 1.0/batch_size "
+                "(%s vs. %s). Is this intended?",
+                optimizer.rescale_grad, rescale_grad)
         self._optimizer = optimizer
         self._updater = opt_mod.get_updater(optimizer)
         self.optimizer_initialized = True

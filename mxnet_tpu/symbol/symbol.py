@@ -746,8 +746,12 @@ def load_json(json_str):
     nodes = []
     for entry in data["nodes"]:
         if entry["op"] == "null":
-            v = var(entry["name"],
-                    attr=entry.get("node_attrs"))
+            # variable attrs live under 'node_attrs' in this framework's
+            # output and under 'attrs' in reference exports (__dtype__/
+            # __shape__/__lr_mult__ hints) — merge both
+            attr = dict(entry.get("attrs") or {})
+            attr.update(entry.get("node_attrs") or {})
+            v = var(entry["name"], attr=attr or None)
             nodes.append(v)
         else:
             op = get_op(entry["op"])

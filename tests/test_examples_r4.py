@@ -1,0 +1,69 @@
+"""E2E tests for the round-4 example ports (VERDICT r3 item 5): sparse
+linear classification, model-parallel, module workflow, numpy-ops
+CustomOp, quantization calibrate->deploy. Each drives the example's
+`train`/`main` entry exactly as the CLI does and asserts the capability
+the reference example demonstrates."""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+for sub in ("sparse", "model-parallel", "module", "numpy-ops",
+            "quantization"):
+    sys.path.insert(0, os.path.join(REPO, "example", sub))
+
+
+def test_sparse_linear_classification():
+    """Row-sparse weight in the kvstore, row_sparse_pull per batch,
+    row-sparse gradient push through the store-side optimizer."""
+    from linear_classification import train, load_libsvm
+    losses, acc, w_final, w_true = train(epochs=6, log=lambda *a: None)
+    assert losses[-1] < losses[0] * 0.8
+    assert acc > 0.8, acc
+    assert w_final.shape == (1000, 1)
+    # rows never touched by any sample must still be zero (the updates
+    # really were row-sparse)
+    csr, _ = load_libsvm("/tmp/sparse_linear.libsvm", 1000)
+    touched = np.nonzero(csr.asnumpy().any(axis=0))[0]
+    untouched = np.setdiff1d(np.arange(1000), touched)
+    assert untouched.size > 0
+    np.testing.assert_array_equal(w_final[untouched], 0.0)
+
+
+def test_model_parallel_mlp():
+    """Two pipeline stages on two devices via group2ctx; training crosses
+    the device boundary forward and backward."""
+    import jax
+    if len(jax.devices()) < 2:
+        pytest.skip("needs multi-device mesh")
+    from mlp_model_parallel import train
+    first, last, n_devices = train(steps=300, log=lambda *a: None)
+    assert n_devices == 2
+    assert last < first * 0.8, (first, last)
+
+
+def test_module_workflow():
+    """fit -> checkpoint -> resume -> score -> predict (reference
+    example/module)."""
+    from mnist_module import train
+    acc, preds = train(epochs=4, log=lambda *a: None)
+    assert acc > 0.9, acc
+    assert preds.shape[1] == 10
+
+
+def test_numpy_ops_custom_softmax():
+    """A numpy CustomOp as the loss layer of a Module-trained net."""
+    from custom_softmax import train
+    acc = train(epochs=6, log=lambda *a: None)
+    assert acc > 0.8, acc
+
+
+def test_quantization_calibrate_deploy():
+    """fp32 train -> naive calibration -> int8 swap -> save/reload."""
+    from quantize_deploy import main
+    acc_fp32, acc_int8, acc_loaded = main(epochs=3, log=lambda *a: None)
+    assert acc_fp32 > 0.9
+    assert acc_int8 > acc_fp32 - 0.05
+    assert abs(acc_loaded - acc_int8) < 0.02
