@@ -54,6 +54,16 @@ def rand_shape_nd(ndim, dim=10):
 def check_numeric_gradient(fn, inputs, eps=1e-3, rtol=1e-3, atol=1e-4):
     """Central finite differences vs tape backward
     (reference `python/mxnet/test_utils.py:981`)."""
+    import jax
+    try:
+        on_accel = any(d.platform not in ("cpu",) for d in jax.devices())
+    except RuntimeError:
+        on_accel = False
+    if on_accel:
+        # f32 central differences on the accelerator carry ~1e-3 rel
+        # truncation+rounding; the reference's GPU FD checks run at 1e-2
+        # (test_utils.py check_numeric_gradient GPU defaults)
+        rtol, atol = max(rtol, 1e-2), max(atol, 1e-3)
     inputs = [x if isinstance(x, NDArray) else array(x) for x in inputs]
     for x in inputs:
         x.attach_grad()

@@ -1,0 +1,160 @@
+"""Convergence tests on small datasets — the reference's strongest
+training oracle (`tests/python/train/test_mlp.py` asserts accuracy >=
+0.85 after a short fit; test_conv does the same for a CNN). Synthetic but
+non-trivial tasks with held-out validation: these catch optimizer /
+gradient-scale / data-pipeline regressions that unit oracles miss (the
+round-4 Module rescale_grad bug was exactly this class)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd as ag, gluon, nd
+
+
+def concentric_circles(n=600, seed=3):
+    """Non-linearly-separable 2-class task (inner disc vs outer ring)."""
+    rng = onp.random.RandomState(seed)
+    r = onp.concatenate([rng.rand(n // 2) * 0.8,
+                         1.2 + rng.rand(n // 2) * 0.8])
+    th = rng.rand(n) * 2 * onp.pi
+    x = onp.stack([r * onp.cos(th), r * onp.sin(th)], 1)
+    x += rng.randn(n, 2) * 0.05
+    y = onp.concatenate([onp.zeros(n // 2), onp.ones(n // 2)])
+    idx = rng.permutation(n)
+    return x[idx].astype("float32"), y[idx].astype("float32")
+
+
+def digits_like(n=800, classes=10, seed=5):
+    """8x8 'digit' images: class = which 2x2 superpixel pattern lights up
+    (MNIST stand-in with real spatial structure)."""
+    rng = onp.random.RandomState(seed)
+    y = rng.randint(0, classes, n)
+    x = rng.randn(n, 1, 8, 8).astype("float32") * 0.4
+    for i, c in enumerate(y):
+        r, col = divmod(c, 4)
+        x[i, 0, r * 2:(r + 1) * 2, col * 2:(col + 1) * 2] += 1.8
+        x[i, 0, (r * 3) % 8, (col * 5) % 8] += 1.0
+    return x, y.astype("float32")
+
+
+def test_mlp_convergence_module():
+    """reference tests/python/train/test_mlp.py: Module.fit an MLP,
+    accuracy >= 0.85 on held-out data."""
+    x, y = concentric_circles()
+    split = 480
+    train_it = mx.io.NDArrayIter(x[:split], y[:split], batch_size=32,
+                                 shuffle=True)
+    val_it = mx.io.NDArrayIter(x[split:], y[split:], batch_size=32)
+
+    data = mx.sym.var("data")
+    h = mx.sym.Activation(mx.sym.FullyConnected(data, num_hidden=32,
+                                                name="fc1"),
+                          act_type="tanh")
+    h = mx.sym.Activation(mx.sym.FullyConnected(h, num_hidden=32,
+                                                name="fc2"),
+                          act_type="tanh")
+    out = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(h, num_hidden=2, name="fc3"),
+        mx.sym.var("softmax_label"), name="softmax")
+    mod = mx.mod.Module(out, context=mx.cpu())
+    mod.fit(train_it, optimizer="adam",
+            optimizer_params={"learning_rate": 0.01},
+            initializer=mx.init.Xavier(), num_epoch=40)
+    acc = dict(mod.score(val_it, "acc"))["accuracy"]
+    assert acc >= 0.85, "circles MLP val accuracy %.3f" % acc
+
+
+def test_cnn_convergence_gluon():
+    """reference tests/python/train test_conv analogue on the gluon path:
+    small CNN, held-out accuracy >= 0.85."""
+    x, y = digits_like()
+    split = 640
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Conv2D(8, 3, padding=1, activation="relu"),
+            gluon.nn.MaxPool2D(2),
+            gluon.nn.Conv2D(16, 3, padding=1, activation="relu"),
+            gluon.nn.MaxPool2D(2),
+            gluon.nn.Flatten(),
+            gluon.nn.Dense(10))
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 2e-3})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    for epoch in range(12):
+        for s in range(0, split, 64):
+            xb = nd.array(x[s:s + 64])
+            yb = nd.array(y[s:s + 64])
+            with ag.record():
+                loss = loss_fn(net(xb), yb).mean()
+            loss.backward()
+            trainer.step(1)
+    preds = net(nd.array(x[split:])).asnumpy().argmax(1)
+    acc = float((preds == y[split:]).mean())
+    assert acc >= 0.85, "CNN val accuracy %.3f" % acc
+
+
+def test_rnn_sequence_convergence():
+    """LSTM learns a majority-vote sequence task (sequence supervision) —
+    the recurrent analogue of the reference train tests."""
+    rng = onp.random.RandomState(11)
+    n, T = 512, 12
+    bits = rng.randint(0, 2, (n, T)).astype("float32")
+    labels = (bits.sum(1) > T / 2).astype("float32")
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    x = bits[..., None]
+    split = 400
+
+    class Head(gluon.Block):
+        def __init__(self):
+            super().__init__()
+            with self.name_scope():
+                self.lstm = gluon.rnn.LSTM(24, layout="NTC")
+                self.out = gluon.nn.Dense(2)
+
+        def forward(self, x):
+            h = self.lstm(x)
+            return self.out(h[:, -1, :])
+
+    model = Head()
+    model.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(model.collect_params(), "adam",
+                            {"learning_rate": 1e-2})
+    for epoch in range(60):
+        for s in range(0, split, 64):
+            xb = nd.array(x[s:s + 64])
+            yb = nd.array(labels[s:s + 64])
+            with ag.record():
+                loss = loss_fn(model(xb), yb).mean()
+            loss.backward()
+            trainer.step(1)
+    preds = model(nd.array(x[split:])).asnumpy().argmax(1)
+    acc = float((preds == labels[split:]).mean())
+    assert acc >= 0.85, "LSTM parity val accuracy %.3f" % acc
+
+
+def test_sgd_momentum_matches_adam_direction():
+    """Optimizer sanity on a convex quadratic: both reach the optimum
+    (catches update-rule sign/scale regressions)."""
+    target = onp.array([1.5, -2.0, 0.5], "float32")
+    for opt, kw, steps in [("sgd", {"learning_rate": 0.1,
+                                    "momentum": 0.9}, 200),
+                           ("adam", {"learning_rate": 0.05}, 300)]:
+        w = nd.zeros((3,))
+        w.attach_grad()
+        trainer = gluon.Trainer({"w": _as_param(w)}, opt, kw)
+        for _ in range(steps):
+            with ag.record():
+                loss = ((w - nd.array(target)) ** 2).sum()
+            loss.backward()
+            trainer.step(1)
+        onp.testing.assert_allclose(w.asnumpy(), target, atol=0.05,
+                                    err_msg=opt)
+
+
+def _as_param(w):
+    from mxnet_tpu.gluon.parameter import Parameter
+    p = Parameter("w", shape=w.shape, dtype="float32")
+    p.initialize(init="zeros")
+    p._data = [w]
+    return p
