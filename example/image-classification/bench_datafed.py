@@ -228,6 +228,9 @@ def main():
     loss.asnumpy()
     dt = time.time() - t0
     stop[0] = True
+    th.join()          # drain the feeder fully before later phases
+    while not q.empty():
+        q.get()        # release staged device chunks
     fed_rate = n_chunks * chunk * batch / dt
     bound = min(io_rate, wire_c_rate, compute_rate)
     log("data-fed training: %.0f img/s (binding constraint %.0f img/s -> "
@@ -274,8 +277,8 @@ def main():
 
     xc, yc = put_chunk()
     trainer.step_many(xc, yc).asnumpy()  # warm
-    xc, yc = put_chunk()
     t0 = time.time()
+    xc, yc = put_chunk()   # chunk 0's puts are part of the measured cost
     for i in range(n_chunks):
         loss = trainer.step_many(xc, yc)   # async dispatch
         if i + 1 < n_chunks:
@@ -307,7 +310,6 @@ def main():
     # A 16 GB HBM holds ~90k uint8 224^2 images alongside ResNet-50
     # training state — the small-dataset epoch-caching strategy.
     # (Pool chunks were NOT donated by step_many: reusable every epoch.)
-    pool_emit = {}
     if os.environ.get("DF_POOL", "1") != "0":
         n_pool = min(n_chunks, 8)
         pool = []
@@ -322,10 +324,11 @@ def main():
         log("NOTE: pool staged AFTER first dispatch here (degraded puts, "
             "%.1fs); in a fresh process staging runs at the idle wire "
             "rate — see PERF.md" % stage_t)
+        yd = jax.device_put(jnp.asarray(yc), d)  # labels device-resident
         loss = None
         t0 = time.time()
         for c in range(n_pool):
-            loss = trainer.step_many(pool[c], yc)
+            loss = trainer.step_many(pool[c], yd)
         loss.asnumpy()
         dt = time.time() - t0
         pool_rate = n_pool * chunk * batch / dt

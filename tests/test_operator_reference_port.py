@@ -98,24 +98,23 @@ def test_convolution_no_bias_and_grad():
                                     atol=2e-3)
 
 
-def test_deconvolution_inverts_conv_shape():
-    # reference test_deconvolution: deconv(conv(x)) shape round-trip and
-    # numeric against the gradient-of-conv identity
-    x = nd.array(_a(2, 3, 7, 7))
+def test_deconvolution_is_conv_input_gradient():
+    # reference test_deconvolution: Deconvolution(g) with weight w equals
+    # d/dx of Convolution at cotangent g — checked NUMERICALLY
+    g = nd.array(_a(2, 3, 4, 4))          # cotangent in conv-output space
     w = nd.array(_a(3, 4, 3, 3, scale=0.4))
-    y = mx.nd.Deconvolution(x, w, kernel=(3, 3), num_filter=4,
+    y = mx.nd.Deconvolution(g, w, kernel=(3, 3), num_filter=4,
                             stride=(2, 2), pad=(1, 1), adj=(1, 1))
-    assert y.shape == (2, 4, 14, 14)
-    # VJP identity: deconv with weight w == grad of conv wrt its input
-    g = nd.array(_a(*y.shape))
-    xc = nd.array(y.asnumpy())
+    assert y.shape == (2, 4, 8, 8)
+    xc = nd.array(_a(2, 4, 8, 8))         # conv input of matching shape
     xc.attach_grad()
-    wc = nd.array(w.asnumpy())
     with ag.record():
-        z = mx.nd.Convolution(xc, wc, None, kernel=(3, 3), num_filter=3,
+        z = mx.nd.Convolution(xc, w, None, kernel=(3, 3), num_filter=3,
                               stride=(2, 2), pad=(1, 1), no_bias=True)
-    z.backward(nd.array(_a(*z.shape)))
-    assert xc.grad.shape == y.shape
+    assert z.shape == g.shape
+    z.backward(g)
+    onp.testing.assert_allclose(xc.grad.asnumpy(), y.asnumpy(),
+                                rtol=2e-4, atol=2e-4)
 
 
 # ------------------------------------------------------------------- pooling
