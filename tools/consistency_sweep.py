@@ -27,7 +27,7 @@ ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 # devices and stay on the virtual CPU mesh.
 FILES = [
     "test_operator.py", "test_operator_oracle.py",
-    "test_operator_reference_port.py",
+    "test_operator_reference_port.py", "test_operator_reference_port2.py",
     "test_operator_dtypes.py", "test_operator_extra.py",
     "test_operator_math_extra.py", "test_loss_oracle.py",
     "test_ste_and_pdf_ops.py", "test_ndarray.py", "test_autograd.py",
