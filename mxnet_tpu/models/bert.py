@@ -30,16 +30,18 @@ class _MaskedAttention(MultiHeadAttention):
                          **kwargs)
 
     def hybrid_forward(self, F, x, mask=None):
+        # natural (B, T, H, D) layout end to end (see MultiHeadAttention)
         B, T, C = x.shape
         H = self._num_heads
         qkv = self.qkv(x)
         qkv = qkv.reshape((B, T, 3, H, C // H))
-        qkv = F.transpose(qkv, axes=(2, 0, 3, 1, 4))
-        q, k, v = qkv[0], qkv[1], qkv[2]
+        q = qkv[:, :, 0]
+        k = qkv[:, :, 1]
+        v = qkv[:, :, 2]
         out = F._contrib_dot_product_attention(
-            q, k, v, mask=mask, dropout=self._dropout, causal=False)
-        out = F.transpose(out, axes=(0, 2, 1, 3)).reshape((B, T, C))
-        return self.proj(out)
+            q, k, v, mask=mask, dropout=self._dropout, causal=False,
+            layout="BSHD")
+        return self.proj(out.reshape((B, T, C)))
 
 
 class _BERTLayer(HybridBlock):

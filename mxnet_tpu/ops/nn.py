@@ -523,10 +523,26 @@ def UpSampling(*data, scale=1, sample_type="nearest", num_args=1,
 # ------------------------------------------------------------ attention
 
 
+def _reduce_key_mask(mask, batch, key_len):
+    """Reduce a BERT-style broadcastable keep-mask to (B, S_k) for the
+    flash kernels. Returns (kv_mask, ok): ok=False means the mask shape
+    is unsupported by the fused path (full (B,H,Q,K) masks etc.)."""
+    if mask is None:
+        return None, True
+    nd = getattr(mask, "ndim", 0)
+    if nd == 4 and mask.shape[1] == 1 and mask.shape[2] == 1 and \
+            mask.shape[0] == batch and mask.shape[3] == key_len:
+        return mask[:, 0, 0, :], True
+    if nd == 2 and mask.shape == (batch, key_len):
+        return mask, True
+    return None, False
+
+
 @register("_contrib_dot_product_attention",
           state_binders={"rng_key": _bind_key, "train": _bind_train})
 def dot_product_attention(query, key, value, mask=None, dropout=0.0,
-                          scaled=True, causal=False, rng_key=None, train=False):
+                          scaled=True, causal=False, layout="BHSD",
+                          rng_key=None, train=False):
     """TPU-native fused attention entry. Not in MXNet 1.6 (attention was
     composed from ops there) — exposed as a contrib op. When the problem
     aligns to the pallas tiling (seq % 128 == 0) and a TPU is present,
@@ -536,22 +552,51 @@ def dot_product_attention(query, key, value, mask=None, dropout=0.0,
     fwd/bwd consistent). Full (B,H,Q,K) masks and cross-attention take the
     XLA softmax path below."""
     import os
+    if layout == "BSHD" and getattr(query, "ndim", 0) == 4:
+        # (B, S, H, D) — the transformer's natural layout straight out of
+        # the qkv projection. The head-fused kernel consumes it with NO
+        # physical transpose (the BHSD kernels force one on each side:
+        # ~12% of a BERT-base s128 span per the XPlane study in PERF.md).
+        from .pallas_kernels import (flash_attention_bshd,
+                                     flash_attention_bshd_usable)
+        kv_mask, mask_ok = _reduce_key_mask(mask, query.shape[0],
+                                            key.shape[1])
+        drop = float(dropout) if train else 0.0
+        if (scaled and mask_ok and key.shape == query.shape
+                and value.shape == query.shape
+                and (drop == 0.0 or rng_key is not None)
+                and flash_attention_bshd_usable(query.shape,
+                                                query.shape[-1])
+                and not os.environ.get("MXTPU_DISABLE_FLASH")):
+            try:
+                on_tpu = any(d.platform not in ("cpu",)
+                             for d in jax.devices())
+            except RuntimeError:
+                on_tpu = False
+            if on_tpu:
+                seed = None
+                if drop > 0.0:
+                    seed = jax.random.randint(
+                        rng_key, (), -2**31, 2**31 - 1, dtype=jnp.int32)
+                return flash_attention_bshd(query, key, value, kv_mask,
+                                            seed, causal, drop)
+        # fallback: run the BHSD path and restore the layout; XLA fuses
+        # these transposes into the surrounding einsums. (.fn: the module
+        # name is the registered Op wrapper, whose __call__ re-wraps)
+        out = dot_product_attention.fn(
+            jnp.transpose(query, (0, 2, 1, 3)),
+            jnp.transpose(key, (0, 2, 1, 3)),
+            jnp.transpose(value, (0, 2, 1, 3)),
+            mask=mask, dropout=dropout, scaled=scaled, causal=causal,
+            layout="BHSD", rng_key=rng_key, train=train)
+        return jnp.transpose(out, (0, 2, 1, 3))
+
     if query.ndim == 4 and scaled and \
             not os.environ.get("MXTPU_DISABLE_FLASH"):
         from .pallas_kernels import flash_attention, flash_attention_usable
         # BERT-style key padding masks broadcast over q: reducible to (B,S)
-        kv_mask = None
-        mask_ok = mask is None
-        if mask is not None and getattr(mask, "ndim", 0) == 4 and \
-                mask.shape[1] == 1 and mask.shape[2] == 1 and \
-                mask.shape[0] == query.shape[0] and \
-                mask.shape[3] == key.shape[2]:
-            kv_mask = mask[:, 0, 0, :]
-            mask_ok = True
-        elif mask is not None and getattr(mask, "ndim", 0) == 2 and \
-                mask.shape == (query.shape[0], key.shape[2]):
-            kv_mask = mask
-            mask_ok = True
+        kv_mask, mask_ok = _reduce_key_mask(mask, query.shape[0],
+                                            key.shape[2])
         drop = float(dropout) if train else 0.0
         # kernel tiles assume self-attention layout; cross-attention with
         # kv_len != q_len must take the XLA path

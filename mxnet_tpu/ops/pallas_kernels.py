@@ -39,7 +39,8 @@ try:
 except ImportError:  # pragma: no cover
     _HAS_PALLAS = False
 
-__all__ = ["flash_attention", "pallas_available", "flash_attention_usable"]
+__all__ = ["flash_attention", "flash_attention_bshd", "pallas_available",
+           "flash_attention_usable", "flash_attention_bshd_usable"]
 
 BLOCK_Q = 128
 BLOCK_K = 128
@@ -91,6 +92,68 @@ def _keep_bits(seed, bh, q0, k0, blk_q, blk_k, keep_prob):
     return bits < thresh
 
 
+# ----------------------------------------------------------- shared tile math
+
+def _tile_dead(causal, q0, k0, blk_q, blk_k, mask_row):
+    """Combined causal/key-padding invalid-position mask for one tile
+    (None when every position is live)."""
+    dead = None
+    if causal:
+        q_pos = q0 + jax.lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 0)
+        k_pos = k0 + jax.lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 1)
+        dead = q_pos < k_pos
+    if mask_row is not None:
+        mdead = mask_row == 0
+        dead = mdead if dead is None else (dead | mdead)
+    return dead
+
+
+def _fwd_tile_update(q, k, v, carry, dead, seed, bh, q0, k0, blk_q, blk_k,
+                     dropout):
+    """One online-softmax accumulation step over a (q-block, k-block)
+    tile — the single implementation both the BHSD and the head-fused
+    BSHD forward kernels run. Masked positions contribute EXACTLY zero
+    (not exp(-1e30 - m)): fully-masked rows keep l = 0 and the epsilon
+    guard at the end returns 0 output instead of garbage. The normalizer
+    l accumulates PRE-dropout probabilities (dropout rescales P, never
+    the softmax denominator)."""
+    acc, m_i, l_i = carry
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    if dead is not None:
+        s = jnp.where(dead, jnp.float32(NEG_INF), s)
+    m_new = jnp.maximum(m_i, jnp.max(s, axis=-1))
+    p = jnp.exp(s - m_new[:, None])
+    if dead is not None:
+        p = jnp.where(dead, jnp.float32(0.0), p)
+    corr = jnp.exp(m_i - m_new)
+    l_new = l_i * corr + jnp.sum(p, axis=-1)
+    if dropout > 0.0:
+        keep = _keep_bits(seed, bh, q0, k0, blk_q, blk_k, 1.0 - dropout)
+        p = jnp.where(keep, p / jnp.float32(1.0 - dropout),
+                      jnp.float32(0.0))
+    pv = jax.lax.dot_general(p, v, (((1,), (0,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    return p, (acc * corr[:, None] + pv, m_new, l_new)
+
+
+def _bwd_tile_ds(q, k, v, do, lse, delta, mask_row, causal, dropout,
+                 scale, seed, bh, q0, k0, blk_q, blk_k):
+    """Recompute dS = P o (dP - delta) for one tile (and Pdrop for dV) —
+    the single implementation all four backward kernels run."""
+    p, pd, keep = _recompute_tile(q, k, lse, seed, bh, q0, k0, mask_row,
+                                  causal, dropout, scale, blk_q, blk_k)
+    dpd = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                              preferred_element_type=jnp.float32)
+    if dropout > 0.0:
+        dp = jnp.where(keep, dpd / jnp.float32(1.0 - dropout),
+                       jnp.float32(0.0))
+    else:
+        dp = dpd
+    ds = p * (dp - delta[:, None])
+    return ds, pd
+
+
 # ------------------------------------------------------------------- forward
 
 def _attn_fwd_kernel(seed_ref, q_ref, k_ref, v_ref, mask_ref, o_ref,
@@ -107,42 +170,16 @@ def _attn_fwd_kernel(seed_ref, q_ref, k_ref, v_ref, mask_ref, o_ref,
     n_kb = seq_len // blk_k
 
     def body(kb, carry):
-        acc, m_i, l_i = carry
         k = k_ref[0, pl.ds(kb * blk_k, blk_k), :].astype(jnp.float32)
         v = v_ref[0, pl.ds(kb * blk_k, blk_k), :].astype(jnp.float32)
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
-        dead = None
-        if causal:
-            q_pos = qi * blk_q + jax.lax.broadcasted_iota(
-                jnp.int32, (blk_q, blk_k), 0)
-            k_pos = kb * blk_k + jax.lax.broadcasted_iota(
-                jnp.int32, (blk_q, blk_k), 1)
-            dead = q_pos < k_pos
-        if has_mask:
-            mrow = mask_ref[0, 0:1, pl.ds(kb * blk_k, blk_k)]  # (1, blk_k)
-            mdead = mrow == 0
-            dead = mdead if dead is None else (dead | mdead)
-        if dead is not None:
-            s = jnp.where(dead, jnp.float32(NEG_INF), s)
-        m_new = jnp.maximum(m_i, jnp.max(s, axis=-1))
-        # masked positions contribute EXACTLY zero (not exp(-1e30 - m)):
-        # fully-masked rows then keep l = 0 and the epsilon guard below
-        # returns 0 output instead of garbage
-        p = jnp.exp(s - m_new[:, None])
-        if dead is not None:
-            p = jnp.where(dead, jnp.float32(0.0), p)
-        corr = jnp.exp(m_i - m_new)
-        l_new = l_i * corr + jnp.sum(p, axis=-1)  # normalizer: pre-dropout
-        if dropout > 0.0:
-            keep = _keep_bits(seed, bh, qi * blk_q, kb * blk_k, blk_q,
-                              blk_k, 1.0 - dropout)
-            p = jnp.where(keep, p / jnp.float32(1.0 - dropout),
-                          jnp.float32(0.0))
-        pv = jax.lax.dot_general(p, v, (((1,), (0,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
-        acc = acc * corr[:, None] + pv
-        return acc, m_new, l_new
+        mrow = mask_ref[0, 0:1, pl.ds(kb * blk_k, blk_k)] \
+            if has_mask else None
+        dead = _tile_dead(causal, qi * blk_q, kb * blk_k, blk_q, blk_k,
+                          mrow)
+        _, carry = _fwd_tile_update(q, k, v, carry, dead, seed, bh,
+                                    qi * blk_q, kb * blk_k, blk_q, blk_k,
+                                    dropout)
+        return carry
 
     D = q.shape[-1]
     acc = jnp.zeros((blk_q, D), jnp.float32)
@@ -211,21 +248,12 @@ def _attn_bwd_dq_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         mask_row = None
         if has_mask:
             mask_row = mask_ref[0, 0:1, pl.ds(kb * blk_k, blk_k)]
-        p, _, keep = _recompute_tile(q, k, lse, seed, bh, qi * blk_q,
-                                     kb * blk_k, mask_row, causal,
-                                     dropout, scale, blk_q, blk_k)
-        dpd = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
-                                  preferred_element_type=jnp.float32)
-        if dropout > 0.0:
-            dp = jnp.where(keep, dpd / jnp.float32(1.0 - dropout),
-                           jnp.float32(0.0))
-        else:
-            dp = dpd
-        ds = p * (dp - delta[:, None])
-        dq_acc = dq_acc + jax.lax.dot_general(
+        ds, _ = _bwd_tile_ds(q, k, v, do, lse, delta, mask_row, causal,
+                             dropout, scale, seed, bh, qi * blk_q,
+                             kb * blk_k, blk_q, blk_k)
+        return dq_acc + jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        return dq_acc
 
     if causal:
         n_iter = qi * (blk_q // blk_k) + (blk_q // blk_k)
@@ -263,20 +291,12 @@ def _attn_bwd_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         do = do_ref[0, pl.ds(qb * blk_q, blk_q), :].astype(jnp.float32)
         lse = lse_ref[0, 0, pl.ds(qb * blk_q, blk_q)]
         delta = delta_ref[0, 0, pl.ds(qb * blk_q, blk_q)]
-        p, pd, keep = _recompute_tile(q, k, lse, seed, bh, qb * blk_q,
-                                      ki * blk_k, mask_row, causal,
-                                      dropout, scale, blk_q, blk_k)
+        ds, pd = _bwd_tile_ds(q, k, v, do, lse, delta, mask_row, causal,
+                              dropout, scale, seed, bh, qb * blk_q,
+                              ki * blk_k, blk_q, blk_k)
         dv_acc = dv_acc + jax.lax.dot_general(
             pd, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        dpd = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
-                                  preferred_element_type=jnp.float32)
-        if dropout > 0.0:
-            dp = jnp.where(keep, dpd / jnp.float32(1.0 - dropout),
-                           jnp.float32(0.0))
-        else:
-            dp = dpd
-        ds = p * (dp - delta[:, None])
         dk_acc = dk_acc + jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -457,3 +477,292 @@ def _fa_bwd(causal, dropout, interpret, res, g):
 
 
 flash_attention.defvjp(_fa_fwd, _fa_bwd)
+
+
+# ===================================================================== BSHD
+# Head-fused kernels operating directly on (B, S, H, D) tensors viewed as
+# (B, S, H*D): the transformer's natural layout straight out of the qkv
+# projection. Eliminates the (B,T,H,D)->(B,H,T,D) physical transposes the
+# BHSD kernels force around every attention (XPlane: ~12% of a BERT-base
+# s128 training span). Mosaic's tiling rule forbids per-head blocks
+# ((..,1,D) over (..,H,D)), so each program loads full (blk, H*D) rows —
+# every byte of which it needs — and statically unrolls the head loop.
+# Requires H*D % 128 == 0.
+
+def flash_attention_bshd_usable(q_shape, head_dim):
+    if not _HAS_PALLAS:
+        return False
+    B, S, HD = q_shape[0], q_shape[1], int(np.prod(q_shape[2:]))
+    # Each program holds two FULL (S, H*D) operands in VMEM (K+V in the
+    # forward; Q+dO in the dkdv backward) plus block-sized tiles and fp32
+    # accumulators. Bound that footprint well under the ~16 MB VMEM so
+    # long-sequence/many-head shapes fall back to the per-head BHSD path
+    # instead of failing Mosaic compilation.
+    full_operand_bytes = 2 * S * HD * 4
+    return (S % BLOCK_Q == 0 and S >= BLOCK_Q and HD % 128 == 0
+            and head_dim <= 256
+            and full_operand_bytes <= 8 * 1024 * 1024)
+
+
+def _bshd_fwd_kernel(seed_ref, q_ref, k_ref, v_ref, mask_ref, o_ref,
+                     lse_ref, *, scale, causal, blk_q, blk_k, seq_len,
+                     dropout, has_mask, num_heads, head_dim):
+    b = pl.program_id(0)
+    qi = pl.program_id(1)
+    seed = seed_ref[0, 0]
+    n_kb = seq_len // blk_k
+    H, D = num_heads, head_dim
+
+    for h in range(H):                            # static unroll
+        q = q_ref[0, :, h * D:(h + 1) * D].astype(jnp.float32) \
+            * jnp.float32(scale)
+        bh = b * jnp.int32(H) + jnp.int32(h)
+
+        def body(kb, carry, h=h, q=q, bh=bh):
+            k = k_ref[0, pl.ds(kb * blk_k, blk_k),
+                      h * D:(h + 1) * D].astype(jnp.float32)
+            v = v_ref[0, pl.ds(kb * blk_k, blk_k),
+                      h * D:(h + 1) * D].astype(jnp.float32)
+            mrow = mask_ref[0, 0:1, pl.ds(kb * blk_k, blk_k)] \
+                if has_mask else None
+            dead = _tile_dead(causal, qi * blk_q, kb * blk_k, blk_q,
+                              blk_k, mrow)
+            _, carry = _fwd_tile_update(q, k, v, carry, dead, seed, bh,
+                                        qi * blk_q, kb * blk_k, blk_q,
+                                        blk_k, dropout)
+            return carry
+
+        acc = jnp.zeros((blk_q, D), jnp.float32)
+        m_i = jnp.full((blk_q,), jnp.float32(NEG_INF), jnp.float32)
+        l_i = jnp.zeros((blk_q,), jnp.float32)
+        if causal:
+            n_iter = qi * (blk_q // blk_k) + (blk_q // blk_k)
+        else:
+            n_iter = n_kb
+        acc, m_i, l_i = jax.lax.fori_loop(jnp.int32(0), jnp.int32(n_iter),
+                                          body, (acc, m_i, l_i))
+        l_safe = jnp.maximum(l_i, jnp.float32(1e-20))
+        o_ref[0, :, h * D:(h + 1) * D] = \
+            (acc / l_safe[:, None]).astype(o_ref.dtype)
+        lse_ref[0, 0, :, h] = m_i + jnp.log(l_safe)
+
+
+def _bshd_bwd_dq_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                        delta_ref, mask_ref, dq_ref, *, scale, causal,
+                        blk_q, blk_k, seq_len, dropout, has_mask,
+                        num_heads, head_dim):
+    b = pl.program_id(0)
+    qi = pl.program_id(1)
+    seed = seed_ref[0, 0]
+    H, D = num_heads, head_dim
+
+    for h in range(H):
+        q = q_ref[0, :, h * D:(h + 1) * D].astype(jnp.float32)
+        do = do_ref[0, :, h * D:(h + 1) * D].astype(jnp.float32)
+        lse = lse_ref[0, 0, :, h]
+        delta = delta_ref[0, 0, :, h]
+        bh = b * jnp.int32(H) + jnp.int32(h)
+
+        def body(kb, dq_acc, h=h, q=q, do=do, lse=lse, delta=delta, bh=bh):
+            k = k_ref[0, pl.ds(kb * blk_k, blk_k),
+                      h * D:(h + 1) * D].astype(jnp.float32)
+            v = v_ref[0, pl.ds(kb * blk_k, blk_k),
+                      h * D:(h + 1) * D].astype(jnp.float32)
+            mask_row = None
+            if has_mask:
+                mask_row = mask_ref[0, 0:1, pl.ds(kb * blk_k, blk_k)]
+            ds, _ = _bwd_tile_ds(q, k, v, do, lse, delta, mask_row,
+                                 causal, dropout, scale, seed, bh,
+                                 qi * blk_q, kb * blk_k, blk_q, blk_k)
+            return dq_acc + jax.lax.dot_general(
+                ds, k, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+
+        if causal:
+            n_iter = qi * (blk_q // blk_k) + (blk_q // blk_k)
+        else:
+            n_iter = seq_len // blk_k
+        dq = jax.lax.fori_loop(jnp.int32(0), jnp.int32(n_iter), body,
+                               jnp.zeros((blk_q, D), jnp.float32))
+        dq_ref[0, :, h * D:(h + 1) * D] = \
+            (dq * jnp.float32(scale)).astype(dq_ref.dtype)
+
+
+def _bshd_bwd_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                         delta_ref, mask_ref, dk_ref, dv_ref, *, scale,
+                         causal, blk_q, blk_k, seq_len, dropout, has_mask,
+                         num_heads, head_dim):
+    b = pl.program_id(0)
+    ki = pl.program_id(1)
+    seed = seed_ref[0, 0]
+    H, D = num_heads, head_dim
+    mask_row = None
+    if has_mask:
+        mask_row = mask_ref[0, 0:1, pl.ds(ki * blk_k, blk_k)]
+
+    n_qb = seq_len // blk_q
+    for h in range(H):
+        k = k_ref[0, :, h * D:(h + 1) * D].astype(jnp.float32)
+        v = v_ref[0, :, h * D:(h + 1) * D].astype(jnp.float32)
+        bh = b * jnp.int32(H) + jnp.int32(h)
+
+        def body(qj, carry, h=h, k=k, v=v, bh=bh):
+            dk_acc, dv_acc = carry
+            if causal:
+                qb = qj + ki * (blk_k // blk_q)
+            else:
+                qb = qj
+            q = q_ref[0, pl.ds(qb * blk_q, blk_q),
+                      h * D:(h + 1) * D].astype(jnp.float32)
+            do = do_ref[0, pl.ds(qb * blk_q, blk_q),
+                        h * D:(h + 1) * D].astype(jnp.float32)
+            lse = lse_ref[0, qb, :, h]
+            delta = delta_ref[0, qb, :, h]
+            ds, pd = _bwd_tile_ds(q, k, v, do, lse, delta, mask_row,
+                                  causal, dropout, scale, seed, bh,
+                                  qb * blk_q, ki * blk_k, blk_q, blk_k)
+            dv_acc = dv_acc + jax.lax.dot_general(
+                pd, do, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            dk_acc = dk_acc + jax.lax.dot_general(
+                ds, q, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            return dk_acc, dv_acc
+
+        if causal:
+            n_iter = n_qb - ki * (blk_k // blk_q)
+        else:
+            n_iter = n_qb
+        dk, dv = jax.lax.fori_loop(
+            jnp.int32(0), jnp.int32(n_iter), body,
+            (jnp.zeros((blk_k, D), jnp.float32),
+             jnp.zeros((blk_k, D), jnp.float32)))
+        dk_ref[0, :, h * D:(h + 1) * D] = \
+            (dk * jnp.float32(scale)).astype(dk_ref.dtype)
+        dv_ref[0, :, h * D:(h + 1) * D] = dv.astype(dv_ref.dtype)
+
+
+def _bshd_prep(q, k, v, kv_mask, seed):
+    B, S, H, D = q.shape
+    qf = q.reshape(B, S, H * D)
+    kf = k.reshape(B, S, H * D)
+    vf = v.reshape(B, S, H * D)
+    if kv_mask is None:
+        mr = jnp.ones((B, 1, S), jnp.int32)
+    else:
+        mr = kv_mask.astype(jnp.int32).reshape(B, 1, S)
+    if seed is None:
+        sr = jnp.zeros((1, 1), jnp.int32)
+    else:
+        sr = jnp.asarray(seed, jnp.int32).reshape(1, 1)
+    return qf, kf, vf, mr, sr
+
+
+def _bshd_fwd_impl(q, k, v, kv_mask, seed, causal, dropout, interpret):
+    B, S, H, D = q.shape
+    HD = H * D
+    scale = float(1.0 / np.sqrt(D))
+    qf, kf, vf, mr, sr = _bshd_prep(q, k, v, kv_mask, seed)
+    n_q = S // BLOCK_Q
+    kernel = functools.partial(
+        _bshd_fwd_kernel, scale=scale, causal=causal, blk_q=BLOCK_Q,
+        blk_k=BLOCK_K, seq_len=S, dropout=float(dropout),
+        has_mask=kv_mask is not None, num_heads=H, head_dim=D)
+    call = pl.pallas_call(
+        kernel,
+        out_shape=(jax.ShapeDtypeStruct((B, S, HD), q.dtype),
+                   jax.ShapeDtypeStruct((B, n_q, BLOCK_Q, H),
+                                        jnp.float32)),
+        grid=(B, n_q),
+        in_specs=[
+            pl.BlockSpec((1, 1), lambda b, i: (0, 0)),
+            pl.BlockSpec((1, BLOCK_Q, HD), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, S, HD), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, S, HD), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, 1, S), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=(pl.BlockSpec((1, BLOCK_Q, HD), lambda b, i: (b, i, 0)),
+                   pl.BlockSpec((1, 1, BLOCK_Q, H),
+                                lambda b, i: (b, i, 0, 0))),
+        interpret=interpret,
+    )
+    with jax.enable_x64(False):
+        out, lse = call(sr, qf, kf, vf, mr)
+    return out.reshape(B, S, H, D), lse
+
+
+def _bshd_bwd_impl(q, k, v, kv_mask, seed, o, lse, g, causal, dropout,
+                   interpret):
+    B, S, H, D = q.shape
+    HD = H * D
+    scale = float(1.0 / np.sqrt(D))
+    qf, kf, vf, mr, sr = _bshd_prep(q, k, v, kv_mask, seed)
+    gf = g.reshape(B, S, HD)
+    # delta = rowsum_d(dO o O) per head: (B, nQ, blk_q, H)
+    delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1)                          # (B, S, H)
+    n_q = S // BLOCK_Q
+    delta = delta.reshape(B, n_q, BLOCK_Q, H)
+    common = dict(scale=scale, causal=causal, blk_q=BLOCK_Q, blk_k=BLOCK_K,
+                  seq_len=S, dropout=float(dropout),
+                  has_mask=kv_mask is not None, num_heads=H, head_dim=D)
+    seed_spec = pl.BlockSpec((1, 1), lambda b, i: (0, 0))
+    mask_spec = pl.BlockSpec((1, 1, S), lambda b, i: (b, 0, 0))
+    full_spec = pl.BlockSpec((1, S, HD), lambda b, i: (b, 0, 0))
+    blkq_spec = pl.BlockSpec((1, BLOCK_Q, HD), lambda b, i: (b, i, 0))
+    blkk_spec = pl.BlockSpec((1, BLOCK_K, HD), lambda b, i: (b, i, 0))
+    lse_blk = pl.BlockSpec((1, 1, BLOCK_Q, H), lambda b, i: (b, i, 0, 0))
+    lse_full = pl.BlockSpec((1, n_q, BLOCK_Q, H),
+                            lambda b, i: (b, 0, 0, 0))
+
+    dq_call = pl.pallas_call(
+        functools.partial(_bshd_bwd_dq_kernel, **common),
+        out_shape=jax.ShapeDtypeStruct((B, S, HD), q.dtype),
+        grid=(B, n_q),
+        in_specs=[seed_spec, blkq_spec, full_spec, full_spec, blkq_spec,
+                  lse_blk, lse_blk, mask_spec],
+        out_specs=blkq_spec,
+        interpret=interpret,
+    )
+    dkv_call = pl.pallas_call(
+        functools.partial(_bshd_bwd_dkv_kernel, **common),
+        out_shape=(jax.ShapeDtypeStruct((B, S, HD), k.dtype),
+                   jax.ShapeDtypeStruct((B, S, HD), v.dtype)),
+        grid=(B, S // BLOCK_K),
+        in_specs=[seed_spec, full_spec, blkk_spec, blkk_spec, full_spec,
+                  lse_full, lse_full, mask_spec],
+        out_specs=(blkk_spec, blkk_spec),
+        interpret=interpret,
+    )
+    with jax.enable_x64(False):
+        dq = dq_call(sr, qf, kf, vf, gf, lse, delta, mr)
+        dk, dv = dkv_call(sr, qf, kf, vf, gf, lse, delta, mr)
+    return (dq.reshape(B, S, H, D), dk.reshape(B, S, H, D),
+            dv.reshape(B, S, H, D))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
+def flash_attention_bshd(q, k, v, kv_mask=None, seed=None, causal=False,
+                         dropout=0.0, interpret=False):
+    """Blockwise exact attention in (B, S, H, D) layout — no physical
+    transpose between the qkv projection and the kernel. Same mask/
+    dropout semantics as `flash_attention`."""
+    out, _ = _bshd_fwd_impl(q, k, v, kv_mask, seed, causal, dropout,
+                            interpret)
+    return out
+
+
+def _fab_fwd(q, k, v, kv_mask, seed, causal, dropout, interpret):
+    out, lse = _bshd_fwd_impl(q, k, v, kv_mask, seed, causal, dropout,
+                              interpret)
+    return out, (q, k, v, kv_mask, seed, out, lse)
+
+
+def _fab_bwd(causal, dropout, interpret, res, g):
+    q, k, v, kv_mask, seed, o, lse = res
+    dq, dk, dv = _bshd_bwd_impl(q, k, v, kv_mask, seed, o, lse, g,
+                                causal, dropout, interpret)
+    return dq, dk, dv, None, None
+
+
+flash_attention_bshd.defvjp(_fab_fwd, _fab_bwd)

@@ -231,3 +231,76 @@ def test_dispatch_reduces_bert_mask(monkeypatch):
     ref = _reference_attention(q, k, v, False)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-3,
                                rtol=2e-3)
+
+
+# ----------------------------------------------------- head-fused BSHD (r4)
+
+def _to_bhsd(x):
+    return jnp.transpose(x, (0, 2, 1, 3))
+
+
+@pytest.mark.parametrize("causal,masked", [(False, False), (True, False),
+                                           (False, True)])
+def test_bshd_kernel_matches_reference(causal, masked):
+    """Head-fused (B,S,H,D) kernel: forward AND both backward kernels
+    agree with the dense oracle (transposed for comparison)."""
+    from mxnet_tpu.ops.pallas_kernels import flash_attention_bshd
+    B, S, H, D = 2, 256, 4, 32
+    q, k, v = (_rand((B, S, H, D), 80 + i) for i in range(3))
+    kv_mask = None
+    if masked:
+        kv_mask = jnp.asarray(
+            (np.arange(S)[None, :] < 192).astype("int32")).repeat(B, 0)
+    out = flash_attention_bshd(q, k, v, kv_mask, None, causal, 0.0, True)
+    ref = _reference_attention(_to_bhsd(q), _to_bhsd(k), _to_bhsd(v),
+                               causal, kv_mask)
+    np.testing.assert_allclose(np.asarray(_to_bhsd(out)), np.asarray(ref),
+                               atol=2e-2, rtol=2e-2)
+
+    g_out = _rand((B, S, H, D), 90)
+    _, vjp = jax.vjp(lambda q, k, v: flash_attention_bshd(
+        q, k, v, kv_mask, None, causal, 0.0, True), q, k, v)
+    _, vjp_r = jax.vjp(lambda q, k, v: _to_bhsd(_reference_attention(
+        _to_bhsd(q), _to_bhsd(k), _to_bhsd(v), causal, kv_mask)), q, k, v)
+    for a, b, n in zip(vjp(g_out), vjp_r(g_out), "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-2,
+                                   rtol=5e-2, err_msg="d%s" % n)
+
+
+def test_bshd_dropout_deterministic_and_grad_consistent():
+    from mxnet_tpu.ops.pallas_kernels import flash_attention_bshd
+    B, S, H, D = 1, 128, 2, 64
+    q, k, v = (_rand((B, S, H, D), 95 + i) for i in range(3))
+    seed = jnp.asarray(11, jnp.int32)
+    o1 = flash_attention_bshd(q, k, v, None, seed, False, 0.3, True)
+    o2 = flash_attention_bshd(q, k, v, None, seed, False, 0.3, True)
+    np.testing.assert_array_equal(np.asarray(o1), np.asarray(o2))
+
+    u = np.array(_rand((B, S, H, D), 99))
+    u /= np.linalg.norm(u)
+    un = jnp.asarray(u)
+
+    def f(qq):
+        return flash_attention_bshd(qq, k, v, None, seed, False, 0.3,
+                                    True).sum()
+    directional = float(jnp.vdot(jax.grad(f)(q), un))
+    eps = 1e-2
+    fd = (float(f(q + eps * un)) - float(f(q - eps * un))) / (2 * eps)
+    np.testing.assert_allclose(directional, fd, rtol=3e-2, atol=3e-3)
+
+
+def test_bshd_usability_gate_and_fallback():
+    """H*D not a multiple of 128 must fall back to the BHSD path and
+    still match the oracle through the fused op."""
+    from mxnet_tpu.ops.pallas_kernels import flash_attention_bshd_usable
+    from mxnet_tpu.ops import nn as nn_ops
+    assert flash_attention_bshd_usable((2, 256, 4, 32), 32)
+    assert not flash_attention_bshd_usable((2, 256, 3, 20), 20)  # HD=60
+    assert not flash_attention_bshd_usable((2, 100, 4, 32), 32)  # seq
+    B, S, H, D = 1, 128, 3, 20
+    q, k, v = (_rand((B, S, H, D), 70 + i) for i in range(3))
+    out = nn_ops.dot_product_attention.fn(q, k, v, layout="BSHD")
+    ref = _to_bhsd(_reference_attention(_to_bhsd(q), _to_bhsd(k),
+                                        _to_bhsd(v), False))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-3, rtol=2e-3)
