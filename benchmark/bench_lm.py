@@ -134,8 +134,11 @@ def bench_bert(steps, repeat, batch=None):
         % (n_dense / 1e6, flops_per_step / 1e9, batch, seq))
     tok_s, tflops = run_span(trainer, make_batch, "bert", steps, repeat,
                              tokens_per_step, flops_per_step)
+    kern = ("xla_softmax" if os.environ.get("MXTPU_DISABLE_FLASH")
+            else "bshd_flash")
     return dict(metric="bert_base_pretrain_tokens_per_sec_b%d_s%d"
                        % (batch, seq),
+                kernel=kern,
                 value=round(tok_s, 1), unit="tokens/s",
                 seq_per_sec=round(tok_s / seq, 1),
                 tflops=round(tflops, 1),

@@ -109,7 +109,7 @@ def _tile_dead(causal, q0, k0, blk_q, blk_k, mask_row):
 
 
 def _fwd_tile_update(q, k, v, carry, dead, seed, bh, q0, k0, blk_q, blk_k,
-                     dropout):
+                     dropout, scale):
     """One online-softmax accumulation step over a (q-block, k-block)
     tile — the single implementation both the BHSD and the head-fused
     BSHD forward kernels run. Masked positions contribute EXACTLY zero
@@ -118,8 +118,16 @@ def _fwd_tile_update(q, k, v, carry, dead, seed, bh, q0, k0, blk_q, blk_k,
     l accumulates PRE-dropout probabilities (dropout rescales P, never
     the softmax denominator)."""
     acc, m_i, l_i = carry
+    # matmuls run in the OPERAND dtype (bf16 inputs ride the fast MXU
+    # path, 3x the f32 rate) with f32 accumulation; all softmax math
+    # stays f32. k/v follow q's dtype so partially-AMP'd models with
+    # mixed q/k/v precisions still trace (dot_general requires equal
+    # operand dtypes).
+    k = k.astype(q.dtype)
+    v = v.astype(q.dtype)
     s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32)
+    s = s * jnp.float32(scale)
     if dead is not None:
         s = jnp.where(dead, jnp.float32(NEG_INF), s)
     m_new = jnp.maximum(m_i, jnp.max(s, axis=-1))
@@ -132,7 +140,7 @@ def _fwd_tile_update(q, k, v, carry, dead, seed, bh, q0, k0, blk_q, blk_k,
         keep = _keep_bits(seed, bh, q0, k0, blk_q, blk_k, 1.0 - dropout)
         p = jnp.where(keep, p / jnp.float32(1.0 - dropout),
                       jnp.float32(0.0))
-    pv = jax.lax.dot_general(p, v, (((1,), (0,)), ((), ())),
+    pv = jax.lax.dot_general(p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
                              preferred_element_type=jnp.float32)
     return p, (acc * corr[:, None] + pv, m_new, l_new)
 
@@ -141,6 +149,9 @@ def _bwd_tile_ds(q, k, v, do, lse, delta, mask_row, causal, dropout,
                  scale, seed, bh, q0, k0, blk_q, blk_k):
     """Recompute dS = P o (dP - delta) for one tile (and Pdrop for dV) —
     the single implementation all four backward kernels run."""
+    k = k.astype(q.dtype)
+    v = v.astype(q.dtype)
+    do = do.astype(q.dtype)
     p, pd, keep = _recompute_tile(q, k, lse, seed, bh, q0, k0, mask_row,
                                   causal, dropout, scale, blk_q, blk_k)
     dpd = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
@@ -165,20 +176,20 @@ def _attn_fwd_kernel(seed_ref, q_ref, k_ref, v_ref, mask_ref, o_ref,
     bh = pl.program_id(0)
     qi = pl.program_id(1)
     seed = seed_ref[0, 0]
-    q = q_ref[0].astype(jnp.float32) * jnp.float32(scale)  # (blk_q, D)
+    q = q_ref[0]                                  # (blk_q, D), raw dtype
 
     n_kb = seq_len // blk_k
 
     def body(kb, carry):
-        k = k_ref[0, pl.ds(kb * blk_k, blk_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(kb * blk_k, blk_k), :].astype(jnp.float32)
+        k = k_ref[0, pl.ds(kb * blk_k, blk_k), :]
+        v = v_ref[0, pl.ds(kb * blk_k, blk_k), :]
         mrow = mask_ref[0, 0:1, pl.ds(kb * blk_k, blk_k)] \
             if has_mask else None
         dead = _tile_dead(causal, qi * blk_q, kb * blk_k, blk_q, blk_k,
                           mrow)
         _, carry = _fwd_tile_update(q, k, v, carry, dead, seed, bh,
                                     qi * blk_q, kb * blk_k, blk_q, blk_k,
-                                    dropout)
+                                    dropout, scale)
         return carry
 
     D = q.shape[-1]
@@ -237,14 +248,14 @@ def _attn_bwd_dq_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
     bh = pl.program_id(0)
     qi = pl.program_id(1)
     seed = seed_ref[0, 0]
-    q = q_ref[0].astype(jnp.float32)
-    do = do_ref[0].astype(jnp.float32)           # (blk_q, D)
+    q = q_ref[0]
+    do = do_ref[0]                               # (blk_q, D)
     lse = lse_ref[0, 0, :]                       # (blk_q,)
     delta = delta_ref[0, 0, :]                   # (blk_q,)
 
     def body(kb, dq_acc):
-        k = k_ref[0, pl.ds(kb * blk_k, blk_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(kb * blk_k, blk_k), :].astype(jnp.float32)
+        k = k_ref[0, pl.ds(kb * blk_k, blk_k), :]
+        v = v_ref[0, pl.ds(kb * blk_k, blk_k), :]
         mask_row = None
         if has_mask:
             mask_row = mask_ref[0, 0:1, pl.ds(kb * blk_k, blk_k)]
@@ -252,7 +263,7 @@ def _attn_bwd_dq_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
                              dropout, scale, seed, bh, qi * blk_q,
                              kb * blk_k, blk_q, blk_k)
         return dq_acc + jax.lax.dot_general(
-            ds, k, (((1,), (0,)), ((), ())),
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     if causal:
@@ -273,8 +284,8 @@ def _attn_bwd_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
     bh = pl.program_id(0)
     ki = pl.program_id(1)
     seed = seed_ref[0, 0]
-    k = k_ref[0].astype(jnp.float32)             # (blk_k, D)
-    v = v_ref[0].astype(jnp.float32)
+    k = k_ref[0]                                 # (blk_k, D)
+    v = v_ref[0]
     mask_row = None
     if has_mask:
         mask_row = mask_ref[0, 0:1, pl.ds(ki * blk_k, blk_k)]
@@ -287,18 +298,18 @@ def _attn_bwd_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
             qb = qj + ki * (blk_k // blk_q)
         else:
             qb = qj
-        q = q_ref[0, pl.ds(qb * blk_q, blk_q), :].astype(jnp.float32)
-        do = do_ref[0, pl.ds(qb * blk_q, blk_q), :].astype(jnp.float32)
+        q = q_ref[0, pl.ds(qb * blk_q, blk_q), :]
+        do = do_ref[0, pl.ds(qb * blk_q, blk_q), :]
         lse = lse_ref[0, 0, pl.ds(qb * blk_q, blk_q)]
         delta = delta_ref[0, 0, pl.ds(qb * blk_q, blk_q)]
         ds, pd = _bwd_tile_ds(q, k, v, do, lse, delta, mask_row, causal,
                               dropout, scale, seed, bh, qb * blk_q,
                               ki * blk_k, blk_q, blk_k)
         dv_acc = dv_acc + jax.lax.dot_general(
-            pd, do, (((0,), (0,)), ((), ())),
+            pd.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dk_acc = dk_acc + jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())),
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         return dk_acc, dv_acc
 
@@ -514,22 +525,19 @@ def _bshd_fwd_kernel(seed_ref, q_ref, k_ref, v_ref, mask_ref, o_ref,
     H, D = num_heads, head_dim
 
     for h in range(H):                            # static unroll
-        q = q_ref[0, :, h * D:(h + 1) * D].astype(jnp.float32) \
-            * jnp.float32(scale)
+        q = q_ref[0, :, h * D:(h + 1) * D]
         bh = b * jnp.int32(H) + jnp.int32(h)
 
         def body(kb, carry, h=h, q=q, bh=bh):
-            k = k_ref[0, pl.ds(kb * blk_k, blk_k),
-                      h * D:(h + 1) * D].astype(jnp.float32)
-            v = v_ref[0, pl.ds(kb * blk_k, blk_k),
-                      h * D:(h + 1) * D].astype(jnp.float32)
+            k = k_ref[0, pl.ds(kb * blk_k, blk_k), h * D:(h + 1) * D]
+            v = v_ref[0, pl.ds(kb * blk_k, blk_k), h * D:(h + 1) * D]
             mrow = mask_ref[0, 0:1, pl.ds(kb * blk_k, blk_k)] \
                 if has_mask else None
             dead = _tile_dead(causal, qi * blk_q, kb * blk_k, blk_q,
                               blk_k, mrow)
             _, carry = _fwd_tile_update(q, k, v, carry, dead, seed, bh,
                                         qi * blk_q, kb * blk_k, blk_q,
-                                        blk_k, dropout)
+                                        blk_k, dropout, scale)
             return carry
 
         acc = jnp.zeros((blk_q, D), jnp.float32)
@@ -557,17 +565,15 @@ def _bshd_bwd_dq_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
     H, D = num_heads, head_dim
 
     for h in range(H):
-        q = q_ref[0, :, h * D:(h + 1) * D].astype(jnp.float32)
-        do = do_ref[0, :, h * D:(h + 1) * D].astype(jnp.float32)
+        q = q_ref[0, :, h * D:(h + 1) * D]
+        do = do_ref[0, :, h * D:(h + 1) * D]
         lse = lse_ref[0, 0, :, h]
         delta = delta_ref[0, 0, :, h]
         bh = b * jnp.int32(H) + jnp.int32(h)
 
         def body(kb, dq_acc, h=h, q=q, do=do, lse=lse, delta=delta, bh=bh):
-            k = k_ref[0, pl.ds(kb * blk_k, blk_k),
-                      h * D:(h + 1) * D].astype(jnp.float32)
-            v = v_ref[0, pl.ds(kb * blk_k, blk_k),
-                      h * D:(h + 1) * D].astype(jnp.float32)
+            k = k_ref[0, pl.ds(kb * blk_k, blk_k), h * D:(h + 1) * D]
+            v = v_ref[0, pl.ds(kb * blk_k, blk_k), h * D:(h + 1) * D]
             mask_row = None
             if has_mask:
                 mask_row = mask_ref[0, 0:1, pl.ds(kb * blk_k, blk_k)]
@@ -575,7 +581,7 @@ def _bshd_bwd_dq_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
                                  causal, dropout, scale, seed, bh,
                                  qi * blk_q, kb * blk_k, blk_q, blk_k)
             return dq_acc + jax.lax.dot_general(
-                ds, k, (((1,), (0,)), ((), ())),
+                ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)
 
         if causal:
@@ -602,8 +608,8 @@ def _bshd_bwd_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
 
     n_qb = seq_len // blk_q
     for h in range(H):
-        k = k_ref[0, :, h * D:(h + 1) * D].astype(jnp.float32)
-        v = v_ref[0, :, h * D:(h + 1) * D].astype(jnp.float32)
+        k = k_ref[0, :, h * D:(h + 1) * D]
+        v = v_ref[0, :, h * D:(h + 1) * D]
         bh = b * jnp.int32(H) + jnp.int32(h)
 
         def body(qj, carry, h=h, k=k, v=v, bh=bh):
@@ -612,20 +618,18 @@ def _bshd_bwd_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
                 qb = qj + ki * (blk_k // blk_q)
             else:
                 qb = qj
-            q = q_ref[0, pl.ds(qb * blk_q, blk_q),
-                      h * D:(h + 1) * D].astype(jnp.float32)
-            do = do_ref[0, pl.ds(qb * blk_q, blk_q),
-                        h * D:(h + 1) * D].astype(jnp.float32)
+            q = q_ref[0, pl.ds(qb * blk_q, blk_q), h * D:(h + 1) * D]
+            do = do_ref[0, pl.ds(qb * blk_q, blk_q), h * D:(h + 1) * D]
             lse = lse_ref[0, qb, :, h]
             delta = delta_ref[0, qb, :, h]
             ds, pd = _bwd_tile_ds(q, k, v, do, lse, delta, mask_row,
                                   causal, dropout, scale, seed, bh,
                                   qb * blk_q, ki * blk_k, blk_q, blk_k)
             dv_acc = dv_acc + jax.lax.dot_general(
-                pd, do, (((0,), (0,)), ((), ())),
+                pd.astype(do.dtype), do, (((0,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)
             dk_acc = dk_acc + jax.lax.dot_general(
-                ds, q, (((0,), (0,)), ((), ())),
+                ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)
             return dk_acc, dv_acc
 
