@@ -134,8 +134,16 @@ def bench_bert(steps, repeat, batch=None):
         % (n_dense / 1e6, flops_per_step / 1e9, batch, seq))
     tok_s, tflops = run_span(trainer, make_batch, "bert", steps, repeat,
                              tokens_per_step, flops_per_step)
-    kern = ("xla_softmax" if os.environ.get("MXTPU_DISABLE_FLASH")
-            else "bshd_flash")
+    # provenance from the ACTUAL dispatch conditions, not just the env
+    import jax as _jax
+    from mxnet_tpu.ops.pallas_kernels import flash_attention_bshd_usable
+    on_tpu = any(d.platform != "cpu" for d in _jax.devices())
+    head_dim = units // 12
+    usable = flash_attention_bshd_usable((batch, seq, 12, head_dim),
+                                         head_dim)
+    kern = ("bshd_flash" if on_tpu and usable
+            and not os.environ.get("MXTPU_DISABLE_FLASH")
+            else "xla_softmax")
     return dict(metric="bert_base_pretrain_tokens_per_sec_b%d_s%d"
                        % (batch, seq),
                 kernel=kern,
