@@ -274,7 +274,8 @@ def symbol_list_aux(h):
 
 def symbol_infer_shape(h, keys, shapes, partial):
     s = _sym_unwrap(h)
-    kw = {k: tuple(v) for k, v in zip(keys, shapes)}
+    # None = unknown shape (C side encodes ndim=-1): leave unconstrained
+    kw = {k: tuple(v) for k, v in zip(keys, shapes) if v is not None}
     if partial:
         arg, out, aux = s.infer_shape_partial(**kw)
     else:
@@ -331,7 +332,7 @@ def symbol_print(h):
 
 def executor_simple_bind(h, ctx_str, grad_req, keys, shapes):
     s = _sym_unwrap(h)
-    kw = {k: tuple(v) for k, v in zip(keys, shapes)}
+    kw = {k: tuple(v) for k, v in zip(keys, shapes) if v is not None}
     return s.simple_bind(_ctx(ctx_str), grad_req=grad_req or "write", **kw)
 
 

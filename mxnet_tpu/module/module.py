@@ -209,6 +209,20 @@ class Module(BaseModule):
         axis = getattr(self, "_batch_axis", 0)
         batch_size = self._data_shapes[0][1][axis] \
             if self._data_shapes else 1
+        # dist-sync kvstores SUM gradients across workers (psum), so the
+        # effective global batch is batch_size * num_workers (reference
+        # module.py:505 applies the same multiplier)
+        # Resolving the string through kvstore.create single-sources the
+        # alias map ("nccl"/"dist_sync"/... -> dist_tpu_sync); KVStore
+        # construction has no side effects (jax.distributed.initialize is
+        # the caller's job, as everywhere else in multi-host JAX), and
+        # num_workers is 1 for every non-dist store.
+        kv = kvstore
+        if isinstance(kv, str) and kv:
+            from .. import kvstore as kvs_mod
+            kv = kvs_mod.create(kv)
+        if kv is not None:
+            batch_size *= getattr(kv, "num_workers", 1)
         rescale_grad = 1.0 / max(batch_size, 1)
         if isinstance(optimizer, str):
             idx2name = {i: n for i, n in enumerate(self._param_names)}

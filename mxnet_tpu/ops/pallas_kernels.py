@@ -101,6 +101,17 @@ def _pick_blocks_bshd(S, causal, HD, itemsize):
         bk = shrink(bk)
     while bq > 128 and not fits(bq, bk):
         bq = shrink(bq)
+    if causal and (bk > bq or bq % bk):
+        # the VMEM shrink can break the blk_k-divides-blk_q invariant the
+        # causal block-skip arithmetic (n_iter = (qi+1)*(blk_q//blk_k))
+        # relies on; restore it with the largest 128-multiple divisor of bq
+        # no bigger than the budget-respecting bk (128 always qualifies)
+        cap = min(bq, bk)
+        bk = 128
+        for cand in range(cap, 127, -128):
+            if bq % cand == 0:
+                bk = cand
+                break
     return bq, bk
 NEG_INF = -1e30
 

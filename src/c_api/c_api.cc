@@ -165,8 +165,15 @@ PyObject* py_shapelist(const int* ndims, const int64_t* data, int n) {
   PyObject* l = PyList_New(n);
   const int64_t* p = data;
   for (int i = 0; i < n; ++i) {
-    PyList_SET_ITEM(l, i, py_shape_tuple(p, ndims ? ndims[i] : 0));
-    p += ndims ? ndims[i] : 0;
+    int nd = ndims ? ndims[i] : 0;
+    if (nd < 0) {
+      // unknown shape (partial inference): mirrors store_shapelist's -1
+      Py_INCREF(Py_None);
+      PyList_SET_ITEM(l, i, Py_None);
+      continue;
+    }
+    PyList_SET_ITEM(l, i, py_shape_tuple(p, nd));
+    p += nd;
   }
   return l;
 }
@@ -1436,6 +1443,7 @@ MXTPU_API int MXPredGetOutputShape(PredictorHandle pred, int index,
                                     "output_shape", "i", index);
   if (!r) { set_error(py_error_string()); return -1; }
   PyObject* seq = PySequence_Fast(r, "shape");
+  if (!seq) { Py_DECREF(r); set_error(py_error_string()); return -1; }
   Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
   shape_store.clear();
   for (Py_ssize_t i = 0; i < n; ++i) {

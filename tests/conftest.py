@@ -54,18 +54,22 @@ else:
 
     _npt.assert_allclose = _tpu_allclose
 
-    # same floor for plain np.allclose asserts (reference
-    # check_consistency applies the device tolerance to every comparison)
-    _orig_np_allclose = _np.allclose
+    # Optionally floor plain np.allclose too (reference check_consistency
+    # applies the device tolerance to every comparison) — but patching the
+    # GLOBAL np.allclose can mask intentionally-tight asserts, so it is
+    # opt-in for the chip sweep (tools/consistency_sweep.py sets it),
+    # not ambient for every TPU-targeted run.
+    if os.environ.get("MXTPU_TEST_ALLCLOSE_FLOOR", "0") == "1":
+        _orig_np_allclose = _np.allclose
 
-    def _tpu_np_allclose(a, b, rtol=1e-5, atol=1e-8, **kw):
-        aa, bb = _np.asarray(a), _np.asarray(b)
-        floaty = aa.dtype.kind in "fc" or bb.dtype.kind in "fc"
-        if floaty and rtol != 0:
-            rtol, atol = max(rtol, 1e-3), max(atol, 1e-5)
-        return _orig_np_allclose(a, b, rtol=rtol, atol=atol, **kw)
+        def _tpu_np_allclose(a, b, rtol=1e-5, atol=1e-8, **kw):
+            aa, bb = _np.asarray(a), _np.asarray(b)
+            floaty = aa.dtype.kind in "fc" or bb.dtype.kind in "fc"
+            if floaty and rtol != 0:
+                rtol, atol = max(rtol, 1e-3), max(atol, 1e-5)
+            return _orig_np_allclose(a, b, rtol=rtol, atol=atol, **kw)
 
-    _np.allclose = _tpu_np_allclose
+        _np.allclose = _tpu_np_allclose
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

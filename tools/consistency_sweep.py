@@ -46,7 +46,8 @@ def main():
     args = ap.parse_args()
     files = QUICK if args.quick else FILES
 
-    env = dict(os.environ, MXTPU_TEST_PLATFORM="tpu")
+    env = dict(os.environ, MXTPU_TEST_PLATFORM="tpu",
+               MXTPU_TEST_ALLCLOSE_FLOOR="1")
     rows = []
     failures = []
     t_all = time.time()
