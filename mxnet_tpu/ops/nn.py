@@ -48,6 +48,36 @@ def _pair(v, n=2):
     return t if t else (1,) * n
 
 
+def _conv_s2d_stride2(data, weight, padding):
+    """Stride-2 conv with few input channels, rewritten via space-to-depth.
+
+    A 7x7/s2 stem conv on 3 channels runs the MXU at ~3/128 packing — the
+    round-5 profile measured the ResNet-50 stem fwd+dw at 5.2% of step time
+    (~24 TFLOP/s vs the 54 conv ceiling). Mathematically identical rewrite:
+    block-2 space-to-depth on the (padded) input (C -> 4C channels, half
+    spatial) turns it into a ceil(k/2)^2 STRIDE-1 conv on 4C channels:
+        out[o,i,j] = sum_{c,u,v} xp[c,2i+u,2j+v] w[o,c,u,v]
+                   = sum_{c,r_u,r_v,q_u,q_v} X2[(c,ru,rv), i+qu, j+qv]
+                                             W2[o,(c,ru,rv), qu, qv]
+    with u = 2 qu + ru (kernel zero-padded k -> 2*ceil(k/2)). Same FLOPs,
+    4x the MXU contraction depth, and the gradient convs (autodiff through
+    the reshape/transpose) get the same packing win."""
+    N, C, H, W = data.shape
+    O, _, K, _ = weight.shape
+    K2 = (K + 1) // 2
+    xp = jnp.pad(data, [(0, 0), (0, 0), padding[0], padding[1]])
+    Hp, Wp = xp.shape[2], xp.shape[3]
+    x2 = xp.reshape(N, C, Hp // 2, 2, Wp // 2, 2)
+    x2 = x2.transpose(0, 1, 3, 5, 2, 4).reshape(N, C * 4, Hp // 2, Wp // 2)
+    wp = jnp.pad(weight, [(0, 0), (0, 0), (0, 2 * K2 - K), (0, 2 * K2 - K)])
+    w2 = wp.reshape(O, C, K2, 2, K2, 2)
+    w2 = w2.transpose(0, 1, 3, 5, 2, 4).reshape(O, C * 4, K2, K2)
+    dn = lax.conv_dimension_numbers(x2.shape, w2.shape,
+                                    ("NCHW", "OIHW", "NCHW"))
+    return lax.conv_general_dilated(x2, w2, (1, 1), [(0, 0), (0, 0)],
+                                    dimension_numbers=dn)
+
+
 @register("Convolution", aliases=("convolution",))
 def Convolution(data, weight, bias=None, kernel=(), stride=(), dilate=(),
                 pad=(), num_filter=0, num_group=1, no_bias=False,
@@ -60,6 +90,22 @@ def Convolution(data, weight, bias=None, kernel=(), stride=(), dilate=(),
     dilate = _pair(dilate, nd)
     pad = _pair(pad, nd) if pad else (0,) * nd
     padding = [(p, p) for p in pad]
+    if (_CONV_S2D and nd == 2 and num_group == 1 and stride == (2, 2)
+            and dilate == (1, 1)
+            and weight.ndim == 4 and weight.shape[1] * weight.shape[2] <= 32
+            and weight.shape[2] == weight.shape[3]
+            and weight.shape[2] % 2 == 1 and weight.shape[2] >= 5
+            and (data.shape[2] + 2 * pad[0]) % 2 == 0
+            and (data.shape[3] + 2 * pad[1]) % 2 == 0):
+        # OFF by default: measured on-chip (round 5, ResNet-50 b32) the
+        # space-to-depth shuffle cost exceeded the MXU-packing gain
+        # (2695 vs 2782 img/s end-to-end, barrier'd or fused) — the stem
+        # conv is latency- not depth-bound at these shapes. Kept behind
+        # MXTPU_CONV_S2D=1; the rewrite itself is oracle-exact.
+        out = _conv_s2d_stride2(data, weight, padding)
+        if not no_bias and bias is not None:
+            out = out + bias.reshape((1, -1, 1, 1))
+        return out
     dn_str = {1: ("NCH", "OIH", "NCH"),
               2: ("NCHW", "OIHW", "NCHW"),
               3: ("NCDHW", "OIDHW", "NCDHW")}[nd]
@@ -111,6 +157,113 @@ def Deconvolution(data, weight, bias=None, kernel=(), stride=(), dilate=(),
     return out
 
 
+import os as _os
+
+_POOL_EQBWD = _os.environ.get("MXTPU_MAXPOOL_EQBWD", "0") == "1"
+_CONV_S2D = _os.environ.get("MXTPU_CONV_S2D", "0") == "1"
+# default OFF: helps isolated conv+BN probes (+17-20%) but LOSES 6-10%
+# end-to-end in ResNet-50 (see PERF.md round-5 study)
+_BN_BARRIER = _os.environ.get("MXTPU_BN_BARRIER", "0") == "1"
+
+
+@jax.custom_vjp
+def _fwd_barrier(x):
+    """optimization_barrier in the forward pass only; gradients flow
+    through untouched (a plain barrier transposes to a cotangent barrier,
+    which breaks backward fusions)."""
+    return lax.optimization_barrier(x)
+
+
+_fwd_barrier.defvjp(lambda x: (lax.optimization_barrier(x), None),
+                    lambda _, g: (g,))
+
+
+# -- max-pool with a TPU-friendly backward ---------------------------------
+#
+# XLA derives reduce_window's max-pool gradient as select-and-scatter, which
+# the round-2/round-5 profiles measured as the single slowest HLO in the
+# ResNet-50 step (3.8% of device time for ONE op, plus a 1.8% forward that
+# re-reads windows). This custom VJP keeps the reduce_window forward but
+# replaces the backward with an equality-spread: each input position checks
+# the <=ceil(k/s)^2 windows that cover it and accumulates g/count for every
+# window whose max it equals (count = number of tied positions, computed
+# with k^2 strided slices in output space). Tie handling differs from
+# select-and-scatter (which gives the whole gradient to the FIRST max):
+# ties SHARE the gradient — per-window gradient mass is identical, and for
+# the no-tie case (distinct window values) the two are exactly equal.
+
+def _cover_indices(in_size, out_size, k, s, p):
+    """Per input coordinate y, the <=2 output windows covering it (valid
+    for k <= 2s): index vectors (lo, hi) and hi's validity mask."""
+    yp = _np.arange(in_size) + p
+    lo = (yp - k + s) // s          # ceil((yp - k + 1) / s)
+    hi = yp // s
+    # full membership check (window i covers yp iff i*s <= yp < i*s + k):
+    # with k < s there are inter-window gaps, and a clamped/gap index must
+    # not claim coverage
+    lo_ok = (lo >= 0) & (lo <= out_size - 1) & \
+        (lo * s <= yp) & (lo * s + k > yp)
+    hi_ok = (hi >= 0) & (hi <= out_size - 1) & (hi != lo) & \
+        (hi * s <= yp) & (hi * s + k > yp)
+    return (_np.clip(lo, 0, out_size - 1), lo_ok,
+            _np.clip(hi, 0, out_size - 1), hi_ok)
+
+
+def _maxpool2d_fwd(data, kernel, stride, padding):
+    init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) \
+        else jnp.asarray(jnp.iinfo(data.dtype).min, data.dtype)
+    return lax.reduce_window(data, init, lax.max, (1, 1) + kernel,
+                             (1, 1) + stride, [(0, 0), (0, 0)] + padding)
+
+
+def _maxpool2d_nchw_bwd(kernel, stride, padding, res, g):
+    data, out = res
+    (kh, kw), (sh, sw) = kernel, stride
+    (ph, _), (pw, _) = padding
+    N, C, H, W = data.shape
+    OH, OW = out.shape[2], out.shape[3]
+    neg = jnp.asarray(-jnp.inf, data.dtype)
+    xp = jnp.pad(data, [(0, 0), (0, 0), padding[0], padding[1]],
+                 constant_values=neg)
+    # ties per window: k*k strided slices of the padded input, all fused
+    # into one elementwise pass in output space
+    count = None
+    for dy in range(kh):
+        for dx in range(kw):
+            sl = lax.slice(xp, (0, 0, dy, dx),
+                           (N, C, dy + sh * (OH - 1) + 1,
+                            dx + sw * (OW - 1) + 1), (1, 1, sh, sw))
+            eq = (sl == out).astype(jnp.float32)
+            count = eq if count is None else count + eq
+    gn = (g.astype(jnp.float32) / count).astype(data.dtype)
+    # spread back: for each of the <=2x2 covering windows per position,
+    # gather out/gn rows (constant index vectors -> fused gathers) and
+    # accumulate where the input equals the window max
+    ylo, ylo_ok, yhi, yhi_ok = _cover_indices(H, OH, kh, sh, ph)
+    xlo, xlo_ok, xhi, xhi_ok = _cover_indices(W, OW, kw, sw, pw)
+    gin = jnp.zeros(data.shape, data.dtype)
+    for yi, ym in ((ylo, ylo_ok), (yhi, yhi_ok)):
+        for xi, xm in ((xlo, xlo_ok), (xhi, xhi_ok)):
+            o = jnp.take(jnp.take(out, yi, axis=2), xi, axis=3)
+            gv = jnp.take(jnp.take(gn, yi, axis=2), xi, axis=3)
+            m = (ym[:, None] & xm[None, :])
+            gin = gin + jnp.where((data == o) & m, gv,
+                                  jnp.zeros((), data.dtype))
+    return (gin,)
+
+
+# kernel/stride/padding are static python values (nondiff)
+_maxpool2d_nchw = jax.custom_vjp(_maxpool2d_fwd, nondiff_argnums=(1, 2, 3))
+
+
+def _maxpool2d_res_fwd(data, kernel, stride, padding):
+    out = _maxpool2d_fwd(data, kernel, stride, padding)
+    return out, (data, out)
+
+
+_maxpool2d_nchw.defvjp(_maxpool2d_res_fwd, _maxpool2d_nchw_bwd)
+
+
 @register("Pooling", aliases=("pooling",))
 def Pooling(data, kernel=(), pool_type="max", stride=(), pad=(),
             global_pool=False, pooling_convention="valid", cudnn_off=False,
@@ -143,6 +296,17 @@ def Pooling(data, kernel=(), pool_type="max", stride=(), pad=(),
     else:
         padding = [(0, 0), (0, 0)] + [(p, p) for p in pad]
     if pool_type == "max":
+        spad = padding[2:]
+        if (_POOL_EQBWD and nd == 2
+                and jnp.issubdtype(data.dtype, jnp.floating)
+                and all(k <= 2 * s for k, s in zip(kernel, stride))
+                and all(p[0] == p[1] for p in spad)):
+            # Equality-spread backward (see _maxpool2d_nchw above). OFF by
+            # default: measured on-chip (round 5), the gather-based spread
+            # lowered to materialized layout copies and LOST ~25% end-to-end
+            # vs XLA's select-and-scatter; kept behind MXTPU_MAXPOOL_EQBWD=1
+            # for future reruns against newer XLA gather fusion.
+            return _maxpool2d_nchw(data, kernel, stride, list(spad))
         init = (-jnp.inf if jnp.issubdtype(data.dtype, jnp.floating)
                 else jnp.asarray(jnp.iinfo(data.dtype).min, data.dtype))
         return lax.reduce_window(data, init, lax.max, window, strides, padding)
@@ -369,6 +533,15 @@ def BatchNorm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
         # formulation and precision as cuDNN/TF fused batch norm (the
         # reference's backend); fp32 accumulation bounds the cancellation
         # error at ~mean^2 * 2^-24, which the max(.., 0) clamp backstops.
+        if _BN_BARRIER:
+            # Keep the stat reductions OUT of the producing conv's fusion:
+            # measured on-chip (round 5, scan probes at ResNet stage-2/3
+            # shapes), a conv with BN-stat epilogue fused runs at 74-80
+            # TFLOP/s vs 86-96 with this barrier (+17-20%). Forward-only
+            # (identity gradient): a plain optimization_barrier transposes
+            # to a cotangent barrier that measurably breaks backward
+            # fusions (2495 vs 2772 img/s end-to-end ResNet-50).
+            data = _fwd_barrier(data)
         xf = data.astype(jnp.float32)
         mean = jnp.mean(xf, axis=reduce_axes)
         var = jnp.maximum(

@@ -1,0 +1,152 @@
+"""Profile the ResNet-50 bench span on the real chip and aggregate the
+XPlane device trace by hlo_category (the PERF.md methodology, now a
+committed tool).
+
+Usage: python tools/profile_resnet.py [--steps 16] [--batch 32]
+       [--outdir /tmp/mxtpu_prof_r5] [--top 25]
+
+Prints total device time, per-category shares, and the top-N individual
+HLO programs by self time — the working set for deciding what to attack
+with Pallas / layout changes.
+"""
+import argparse
+import collections
+import glob
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def aggregate_xplane(path):
+    """Aggregate the device plane's 'XLA Ops' line by hlo_category using
+    SELF time (an enclosing while/call event is charged only for the time
+    not covered by its children — interval nesting via a stack). The
+    'Async XLA Ops' line (copy-start spans that overlap compute) is
+    reported separately and NOT added to the total."""
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2
+    sp = xplane_pb2.XSpace()
+    with open(path, "rb") as f:
+        sp.ParseFromString(f.read())
+    out = []
+    for plane in sp.planes:
+        if not plane.name.startswith("/device:TPU"):
+            continue
+        stat_md = {k: v.name for k, v in plane.stat_metadata.items()}
+
+        def ev_info(ev):
+            md = plane.event_metadata[ev.metadata_id]
+            cat = src = ""
+            for st in md.stats:
+                nm = stat_md.get(st.metadata_id)
+                if nm == "hlo_category":
+                    cat = st.str_value
+                elif nm == "source":
+                    src = st.str_value
+            return md.name or md.display_name, cat, src
+
+        cat_ps = collections.Counter()
+        op_ps = collections.Counter()
+        op_meta = {}
+        total_ps = 0
+        async_ps = 0
+        for line in plane.lines:
+            if line.name == "Async XLA Ops":
+                async_ps = sum(e.duration_ps for e in line.events)
+                continue
+            if line.name != "XLA Ops":
+                continue
+            evs = sorted(line.events, key=lambda e: (e.offset_ps,
+                                                     -e.duration_ps))
+            stack = []  # (end_ps, child_time_accum_index)
+            child_time = []
+            for ev in evs:
+                start, dur = ev.offset_ps, ev.duration_ps
+                while stack and start >= stack[-1][0]:
+                    stack.pop()
+                if stack:
+                    child_time[stack[-1][1]] += dur
+                stack.append((start + dur, len(child_time)))
+                child_time.append(0)
+            for ev, ct in zip(evs, child_time):
+                self_ps = max(ev.duration_ps - ct, 0)
+                if not self_ps:
+                    continue
+                name, cat, src = ev_info(ev)
+                total_ps += self_ps
+                cat_ps[cat or "(uncategorized)"] += self_ps
+                op_ps[name] += self_ps
+                op_meta[name] = (cat, src)
+        if total_ps:
+            out.append((plane.name, total_ps, async_ps, cat_ps, op_ps,
+                        op_meta))
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=16)
+    ap.add_argument("--batch", type=int, default=32)
+    ap.add_argument("--image", type=int, default=224)
+    ap.add_argument("--outdir", default="/tmp/mxtpu_prof_r5")
+    ap.add_argument("--top", type=int, default=25)
+    ap.add_argument("--parse-only", default=None,
+                    help="skip the run; parse this xplane.pb")
+    args = ap.parse_args()
+
+    if args.parse_only:
+        path = args.parse_only
+    else:
+        import jax
+        import numpy as np
+        import mxnet_tpu as mx
+        from mxnet_tpu import gluon, parallel
+        from mxnet_tpu.gluon.model_zoo import vision
+
+        mx.random.seed(0)
+        np.random.seed(0)
+        print("devices:", jax.devices(), file=sys.stderr)
+        net = vision.resnet50_v1()
+        net.initialize(mx.init.Xavier())
+        net(mx.nd.zeros((1, 3, args.image, args.image)))
+        net.cast("bfloat16")
+        mesh = parallel.make_mesh(dp=1)
+        loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+        trainer = parallel.ShardedTrainer(
+            net, loss_fn, "sgd", {"learning_rate": 0.1, "momentum": 0.9},
+            mesh=mesh)
+        shape = (args.batch, 3, args.image, args.image)
+        # warm up (compile) outside the trace
+        trainer.bench_span(args.steps, shape, 1000,
+                           dtype="bfloat16").asnumpy()
+        with jax.profiler.trace(args.outdir):
+            l = trainer.bench_span(args.steps, shape, 1000, dtype="bfloat16")
+            l.asnumpy()
+        paths = sorted(glob.glob(os.path.join(
+            args.outdir, "**", "*.xplane.pb"), recursive=True),
+            key=os.path.getmtime)
+        if not paths:
+            print("no xplane produced under", args.outdir, file=sys.stderr)
+            return 1
+        path = paths[-1]
+
+    print("parsing", path, file=sys.stderr)
+    for pname, total_ps, async_ps, cat_ps, op_ps, op_meta in \
+            aggregate_xplane(path):
+        ms = total_ps / 1e9
+        print("== plane %s: %.2f ms device time (%.3f ms/step over %d); "
+              "async-copy spans %.2f ms (overlapped, not counted) =="
+              % (pname, ms, ms / args.steps, args.steps, async_ps / 1e9))
+        for cat, ps in cat_ps.most_common():
+            print("  %-28s %6.2f%%  %8.3f ms"
+                  % (cat, 100.0 * ps / total_ps, ps / 1e9))
+        print("  -- top %d ops by self time --" % args.top)
+        for name, ps in op_ps.most_common(args.top):
+            cat, src = op_meta.get(name, ("", ""))
+            print("  %6.2f%%  %9.3f ms  [%s] %s   <%s>"
+                  % (100.0 * ps / total_ps, ps / 1e9, cat, name[:60], src))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
