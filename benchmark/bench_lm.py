@@ -123,15 +123,23 @@ def bench_bert(steps, repeat, batch=None):
         y = jnp.zeros((batch,), jnp.float32)  # unused dummy
         return (tokens, segments, positions, labels, weights, nsp), y
 
-    n_dense = dense_param_elems(trainer)
-    tokens_per_step = batch * seq
     # 6*N per token (fwd 2N + bwd 4N) + attention 12*s^2*d per seq per
-    # layer for fwd, x3 for training
+    # layer for fwd, x3 for training. The MLM head (transform + vocab
+    # decoder) runs gather-first on the M masked slots only, so its params
+    # are billed at B*M tokens, not B*T (round-5 change; reference
+    # GluonNLP decode semantics).
+    n_dense = dense_param_elems(trainer, exclude=("embed", "embedding",
+                                                  "mlm"))
+    n_mlm = dense_param_elems(trainer) - n_dense
+    tokens_per_step = batch * seq
     units, n_layers = 768, 12
     attn = 3 * n_layers * 4 * seq * seq * units * batch
-    flops_per_step = 6 * n_dense * tokens_per_step + attn
-    log("BERT-base: %.1fM dense-matmul params, %.1f GFLOP/step (b%d s%d)"
-        % (n_dense / 1e6, flops_per_step / 1e9, batch, seq))
+    flops_per_step = (6 * n_dense * tokens_per_step
+                      + 6 * n_mlm * batch * n_masks + attn)
+    log("BERT-base: %.1fM body + %.1fM mlm-head dense params, "
+        "%.1f GFLOP/step (b%d s%d m%d)"
+        % (n_dense / 1e6, n_mlm / 1e6, flops_per_step / 1e9, batch, seq,
+           n_masks))
     tok_s, tflops = run_span(trainer, make_batch, "bert", steps, repeat,
                              tokens_per_step, flops_per_step)
     # provenance from the ACTUAL dispatch conditions, not just the env

@@ -22,6 +22,16 @@ __all__ = ["BERTModel", "BERTEncoder", "bert_tiny", "bert_base",
            "BERTPretrainingLoss"]
 
 
+def _gather_positions(F, x, positions):
+    """Gather (B, M, ...) rows of ``x`` (B, T, ...) at integer ``positions``
+    (B, M) — shared by the gather-first decode and the loss fallback."""
+    B, M = positions.shape
+    rows = F.arange(0, B).reshape((B, 1))
+    rows = F.broadcast_mul(rows, F.ones_like(positions))
+    idx = F.stack(rows.reshape((-1,)), positions.reshape((-1,)), axis=0)
+    return F.gather_nd(x, idx)                         # (B*M, ...)
+
+
 class _MaskedAttention(MultiHeadAttention):
     """MultiHeadAttention with a padding keep-mask (bidirectional)."""
 
@@ -136,30 +146,53 @@ class BERTModel(HybridBlock):
             self.nsp = nn.Dense(2, flatten=False, in_units=units,
                                 prefix="nsp_")
 
-    def hybrid_forward(self, F, tokens, segments, valid_len=None):
+    def hybrid_forward(self, F, tokens, segments, valid_len=None,
+                       masked_positions=None):
         seq = self.encoder(tokens, segments, valid_len)
         cls = F.slice_axis(seq, axis=1, begin=0, end=1).reshape(
             (seq.shape[0], -1))
         pooled = F.tanh(self.pooler(cls))
-        h = F.LeakyReLU(self.mlm_transform(seq), act_type="gelu")
-        mlm_logits = self.mlm_decoder(self.mlm_ln(h))
+        if masked_positions is not None:
+            # gather-FIRST (reference GluonNLP BERTModel._decode: the MLM
+            # transform + vocab decoder run only on the M masked slots, not
+            # all T positions — at s128/M20 that is 6.4x less vocab-head
+            # work; the round-5 XPlane study measured full-seq decoding at
+            # ~18% of the training step)
+            B, M = masked_positions.shape
+            picked = _gather_positions(F, seq, masked_positions).reshape(
+                (B, M, -1))
+            h = F.LeakyReLU(self.mlm_transform(picked), act_type="gelu")
+            mlm_logits = self.mlm_decoder(self.mlm_ln(h))  # (B, M, V)
+        else:
+            h = F.LeakyReLU(self.mlm_transform(seq), act_type="gelu")
+            mlm_logits = self.mlm_decoder(self.mlm_ln(h))  # (B, T, V)
         nsp_logits = self.nsp(pooled)
         return seq, pooled, mlm_logits, nsp_logits
 
 
 class BERTPretrainingLoss(HybridBlock):
     """Masked-LM + next-sentence loss. ``mlm_positions`` selects the masked
-    slots (B, M); ``mlm_weights`` zeroes padding in M."""
+    slots (B, M); ``mlm_weights`` zeroes padding in M.
+
+    ``picked=True`` declares that ``mlm_logits`` is already (B, M, V) from
+    the model's gather-first decode (``masked_positions`` passed to
+    ``BERTModel``) — explicit, because shape inference alone cannot
+    distinguish full-sequence logits when T == M."""
+
+    def __init__(self, picked=False, **kwargs):
+        super().__init__(**kwargs)
+        self._picked = picked
 
     def hybrid_forward(self, F, mlm_logits, nsp_logits, mlm_labels,
                        mlm_positions, mlm_weights, nsp_labels):
         B, M = mlm_positions.shape
         V = mlm_logits.shape[-1]
-        rows = F.arange(0, B).reshape((B, 1))
-        rows = F.broadcast_mul(rows, F.ones_like(mlm_positions))
-        idx = F.stack(rows.reshape((-1,)), mlm_positions.reshape((-1,)),
-                      axis=0)
-        picked = F.gather_nd(mlm_logits, idx)          # (B*M, V)
+        if self._picked:
+            assert mlm_logits.shape[1] == M, \
+                "picked=True expects (B, M, V) logits"
+            picked = mlm_logits.reshape((B * M, V))
+        else:
+            picked = _gather_positions(F, mlm_logits, mlm_positions)
         logp = F.log_softmax(picked, axis=-1)
         ll = F.pick(logp, mlm_labels.reshape((-1,)), axis=-1)
         w = mlm_weights.reshape((-1,))

@@ -12,10 +12,21 @@ re-layouts internally for the TPU).
 """
 from __future__ import annotations
 
+import os as _os
+
 import numpy as _np
 import jax
 import jax.numpy as jnp
 from jax import lax
+
+# round-5 perf-experiment gates (each a measured end-to-end loss in its
+# default-off state -- see PERF.md round-5 study)
+_POOL_EQBWD = _os.environ.get("MXTPU_MAXPOOL_EQBWD", "0") == "1"
+_CONV_S2D = _os.environ.get("MXTPU_CONV_S2D", "0") == "1"
+_BN_BARRIER = _os.environ.get("MXTPU_BN_BARRIER", "0") == "1"
+# threefry restores jax.random.bernoulli dropout masks (10x costlier on
+# the VPU than the default counter-hash; see PERF.md round-5 LM study)
+_DROPOUT_THREEFRY = _os.environ.get("MXTPU_DROPOUT_THREEFRY", "0") == "1"
 
 from ..base import dtype_np
 from ._common import _bind_key, _bind_train
@@ -157,13 +168,6 @@ def Deconvolution(data, weight, bias=None, kernel=(), stride=(), dilate=(),
     return out
 
 
-import os as _os
-
-_POOL_EQBWD = _os.environ.get("MXTPU_MAXPOOL_EQBWD", "0") == "1"
-_CONV_S2D = _os.environ.get("MXTPU_CONV_S2D", "0") == "1"
-# default OFF: helps isolated conv+BN probes (+17-20%) but LOSES 6-10%
-# end-to-end in ResNet-50 (see PERF.md round-5 study)
-_BN_BARRIER = _os.environ.get("MXTPU_BN_BARRIER", "0") == "1"
 
 
 @jax.custom_vjp
@@ -622,6 +626,36 @@ def LRN(data, alpha=1e-4, beta=0.75, knorm=2.0, nsize=5):
 # ------------------------------------------------------------ dropout & rng
 
 
+def _hash_keep_mask(key, shape, keep_prob):
+    """Counter-hash keep mask: lowbias32 over the element's linear index
+    mixed with the key — the same PRNG the Pallas flash kernel uses for
+    in-kernel dropout (`pallas_kernels._keep_bits`). Deterministic in
+    (key, shape), platform-independent, and ~10x cheaper on the VPU than
+    threefry: the round-5 XPlane study measured threefry mask generation
+    at 21% of a BERT-base s128 training step (5 loop fusions of ~3 ms/step
+    emitting pred[64,128,768] masks)."""
+    kd = key
+    if jnp.issubdtype(kd.dtype, jax.dtypes.prng_key):
+        kd = jax.random.key_data(kd)
+    kd = kd.reshape(-1).astype(jnp.uint32)
+    s0, s1 = kd[0], kd[-1]
+    U = jnp.uint32
+    idx = jnp.zeros(shape, U)
+    stride = 1
+    for ax in range(len(shape) - 1, -1, -1):
+        idx = idx + lax.broadcasted_iota(U, tuple(shape), ax) * U(stride)
+        stride *= shape[ax]
+    c = idx * U(0x9E3779B9) ^ s0 * U(0x85EBCA6B) ^ s1 * U(0xC2B2AE35)
+    # lowbias32 (public-domain constants; see pallas_kernels._lowbias32)
+    c = c ^ (c >> U(16))
+    c = c * U(0x7FEB352D)
+    c = c ^ (c >> U(15))
+    c = c * U(0x846CA68B)
+    c = c ^ (c >> U(16))
+    thresh = U(min(int(keep_prob * 4294967296.0), 4294967295))
+    return c < thresh
+
+
 @register("Dropout", aliases=("dropout",),
           state_binders={"key": _bind_key, "train": _bind_train})
 def Dropout(data, p=0.5, mode="training", axes=(), cudnn_off=False,
@@ -634,7 +668,10 @@ def Dropout(data, p=0.5, mode="training", axes=(), cudnn_off=False,
     shape = list(data.shape)
     for ax in (axes or ()):
         shape[ax] = 1
-    keep = jax.random.bernoulli(key, 1.0 - p, tuple(shape))
+    if _DROPOUT_THREEFRY:
+        keep = jax.random.bernoulli(key, 1.0 - p, tuple(shape))
+    else:
+        keep = _hash_keep_mask(key, tuple(shape), 1.0 - p)
     return jnp.where(keep, data / (1.0 - p), jnp.zeros((), dtype=data.dtype))
 
 
