@@ -32,7 +32,19 @@ class Module(BaseModule):
         super().__init__(logger=logger)
         if context is None:
             context = cpu()
+        self._dp_contexts = None
         if isinstance(context, (list, tuple)):
+            if len(context) > 1:
+                # reference DataParallelExecutorGroup (executor_group.py:282)
+                # splits the batch across contexts; the TPU-native form is a
+                # dp mesh over the context devices — batches are sharded on
+                # the batch axis and GSPMD partitions the bound program
+                # (gradients all-reduce automatically under jax.vjp)
+                self._dp_contexts = list(context)
+                self.logger.info(
+                    "Module: %d contexts -> data-parallel mesh; batches "
+                    "shard across %s", len(context),
+                    [str(c) for c in context])
             context = context[0]
         self._context = context
         self._symbol = symbol
@@ -257,6 +269,8 @@ class Module(BaseModule):
         if data_batch.label is not None and self._label_names:
             for name, arr in zip(self._label_names, data_batch.label):
                 feed[name] = arr
+        if self._dp_contexts is not None:
+            feed = {n: self._dp_shard(v) for n, v in feed.items()}
         for n, v in feed.items():
             if self._exec.arg_dict[n].shape != v.shape:
                 # re-bind on batch-size change (reference module reshape)
@@ -264,6 +278,27 @@ class Module(BaseModule):
                     **{name: tuple(val.shape) for name, val in feed.items()})
             break
         self._exec.forward(is_train=is_train, **feed)
+
+    def _dp_shard(self, arr):
+        """device_put an input NDArray batch-sharded over the context mesh;
+        the executor's jit then compiles one GSPMD program across the
+        context devices (params stay replicated, gradients all-reduce)."""
+        import jax
+        import numpy as _onp
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec
+        from ..ndarray.ndarray import NDArray
+        mesh = getattr(self, "_dp_mesh", None)
+        if mesh is None:
+            devs = [c.jax_device for c in self._dp_contexts]
+            mesh = self._dp_mesh = Mesh(_onp.array(devs), ("dp",))
+        v = arr._data if isinstance(arr, NDArray) else arr
+        axis = getattr(self, "_batch_axis", 0)
+        if v.ndim <= axis or v.shape[axis] % len(self._dp_contexts):
+            return arr  # unsplittable batch: leave on the lead context
+        spec = [None] * v.ndim
+        spec[axis] = "dp"
+        out = jax.device_put(v, NamedSharding(mesh, PartitionSpec(*spec)))
+        return NDArray(out, ctx=self._context)
 
     def backward(self, out_grads=None):
         assert self.binded and self.params_initialized

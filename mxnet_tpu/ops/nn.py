@@ -645,13 +645,9 @@ def _hash_keep_mask(key, shape, keep_prob):
     for ax in range(len(shape) - 1, -1, -1):
         idx = idx + lax.broadcasted_iota(U, tuple(shape), ax) * U(stride)
         stride *= shape[ax]
+    from .pallas_kernels import _lowbias32
     c = idx * U(0x9E3779B9) ^ s0 * U(0x85EBCA6B) ^ s1 * U(0xC2B2AE35)
-    # lowbias32 (public-domain constants; see pallas_kernels._lowbias32)
-    c = c ^ (c >> U(16))
-    c = c * U(0x7FEB352D)
-    c = c ^ (c >> U(15))
-    c = c * U(0x846CA68B)
-    c = c ^ (c >> U(16))
+    c = _lowbias32(c)
     thresh = U(min(int(keep_prob * 4294967296.0), 4294967295))
     return c < thresh
 

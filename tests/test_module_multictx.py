@@ -1,0 +1,68 @@
+"""Module with a context LIST data-parallelizes over a dp mesh.
+
+VERDICT r4 item 9: `context=[ctx0, ctx1]` used to silently collapse to
+ctx0 (single-device training); the reference splits the batch across
+contexts (`executor_group.py:282` DataParallelExecutorGroup). The
+TPU-native route: batches are device_put batch-sharded over a Mesh of the
+context devices and GSPMD partitions the bound program.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.io import NDArrayIter
+
+
+def _fit(ctxs, epochs=3):
+    mx.random.seed(0)
+    np.random.seed(0)
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data, num_hidden=16, name="fc1")
+    act = mx.sym.Activation(fc1, act_type="relu")
+    fc2 = mx.sym.FullyConnected(act, num_hidden=3, name="fc2")
+    out = mx.sym.SoftmaxOutput(fc2, mx.sym.var("softmax_label"),
+                               name="softmax")
+    mod = mx.mod.Module(out, context=ctxs)
+    X = np.random.RandomState(7).randn(64, 8).astype(np.float32)
+    Y = np.random.RandomState(8).randint(0, 3, (64,)).astype(np.float32)
+    it = NDArrayIter(X, Y, batch_size=16, label_name="softmax_label")
+    mod.fit(it, num_epoch=epochs, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5})
+    args, _ = mod.get_params()
+    return mod, {k: v.asnumpy() for k, v in args.items()}
+
+
+def test_two_ctx_fit_matches_single_ctx():
+    import jax
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >=2 devices (virtual CPU mesh)")
+    _, p1 = _fit(mx.cpu(0))
+    mod2, p2 = _fit([mx.cpu(0), mx.cpu(1)])
+    for k in p1:
+        np.testing.assert_allclose(p1[k], p2[k], rtol=1e-4, atol=1e-5)
+    # the forward really shards: feed a batch and inspect the input sharding
+    batch = mx.io.DataBatch(
+        data=[mx.nd.array(np.zeros((16, 8), np.float32))],
+        label=[mx.nd.array(np.zeros((16,), np.float32))])
+    sharded = mod2._dp_shard(batch.data[0])
+    assert len(sharded._data.sharding.device_set) == 2
+
+
+def test_odd_batch_falls_back_to_lead_context():
+    import jax
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >=2 devices")
+    mod2, _ = _fit([mx.cpu(0), mx.cpu(1)], epochs=1)
+    odd = mx.nd.array(np.zeros((15, 8), np.float32))
+    out = mod2._dp_shard(odd)
+    assert out.shape == (15, 8)  # unsplittable: passes through
+
+
+def test_four_ctx_fit_runs():
+    import jax
+    if len(jax.devices()) < 4:
+        pytest.skip("needs >=4 devices")
+    _, p1 = _fit(mx.cpu(0))
+    _, p4 = _fit([mx.cpu(i) for i in range(4)])
+    for k in p1:
+        np.testing.assert_allclose(p1[k], p4[k], rtol=1e-4, atol=1e-5)

@@ -9,15 +9,63 @@ coordinator env, the analogue of the reference's local tracker used by
 `tests/nightly/dist_sync_kvstore.py`)."""
 import argparse
 import os
+import shlex
 import subprocess
 import sys
+
+
+def _rank_env(args, rank):
+    return {
+        "MXTPU_COORDINATOR": args.coordinator,
+        "MXTPU_NUM_PROCESSES": str(args.num_workers),
+        "MXTPU_PROCESS_ID": str(rank),
+        # jax distributed CPU backend envs
+        "JAX_COORDINATOR_ADDRESS": args.coordinator,
+        "JAX_NUM_PROCESSES": str(args.num_workers),
+        "JAX_PROCESS_ID": str(rank),
+    }
+
+
+def _ssh_procs(args):
+    """ssh launcher (reference tracker/ssh.py role): round-robin the
+    workers over the hostfile, forwarding the coordinator env and cwd on
+    the remote command line. The ssh binary is overridable via MXTPU_SSH
+    (CI substitutes a local shim where no sshd runs)."""
+    with open(args.hostfile) as f:
+        hosts = [ln.strip() for ln in f if ln.strip()
+                 and not ln.startswith("#")]
+    if not hosts:
+        raise SystemExit("hostfile %s is empty" % args.hostfile)
+    ssh = shlex.split(os.environ.get("MXTPU_SSH", "ssh"))
+    fwd = ["PYTHONPATH", "PATH", "JAX_PLATFORMS", "XLA_FLAGS"] + \
+        [v for v in (args.env or "").split(",") if v]
+    procs = []
+    for rank in range(args.num_workers):
+        host = hosts[rank % len(hosts)]
+        env = _rank_env(args, rank)
+        for var in fwd:
+            if var in os.environ:
+                env[var] = os.environ[var]
+        envs = " ".join("%s=%s" % (k, shlex.quote(v))
+                        for k, v in sorted(env.items()))
+        remote = "cd %s && %s %s" % (
+            shlex.quote(os.getcwd()), envs,
+            " ".join(shlex.quote(c) for c in args.command))
+        procs.append(subprocess.Popen(
+            ssh + ["-n", "-o", "BatchMode=yes",
+                   "-o", "StrictHostKeyChecking=no", host, remote]))
+    return procs
 
 
 def main():
     p = argparse.ArgumentParser(description="launch distributed training")
     p.add_argument("-n", "--num-workers", type=int, required=True)
     p.add_argument("--launcher", type=str, default="local",
-                   choices=["local", "tpu"])
+                   choices=["local", "ssh", "tpu"])
+    p.add_argument("-H", "--hostfile", type=str, default=None,
+                   help="one host per line (ssh launcher)")
+    p.add_argument("--env", type=str, default="",
+                   help="comma-separated extra env vars to forward (ssh)")
     p.add_argument("--coordinator", type=str, default="127.0.0.1:12346")
     p.add_argument("command", nargs=argparse.REMAINDER)
     args = p.parse_args()
@@ -26,19 +74,16 @@ def main():
         # On a pod slice every host runs the same binary; nothing to spawn.
         os.execvp(args.command[0], args.command)
 
-    procs = []
-    for rank in range(args.num_workers):
-        env = dict(os.environ)
-        env.update({
-            "MXTPU_COORDINATOR": args.coordinator,
-            "MXTPU_NUM_PROCESSES": str(args.num_workers),
-            "MXTPU_PROCESS_ID": str(rank),
-            # jax distributed CPU backend envs
-            "JAX_COORDINATOR_ADDRESS": args.coordinator,
-            "JAX_NUM_PROCESSES": str(args.num_workers),
-            "JAX_PROCESS_ID": str(rank),
-        })
-        procs.append(subprocess.Popen(args.command, env=env))
+    if args.launcher == "ssh":
+        if not args.hostfile:
+            raise SystemExit("--launcher ssh requires -H/--hostfile")
+        procs = _ssh_procs(args)
+    else:
+        procs = []
+        for rank in range(args.num_workers):
+            env = dict(os.environ)
+            env.update(_rank_env(args, rank))
+            procs.append(subprocess.Popen(args.command, env=env))
     code = 0
     for pr in procs:
         code = pr.wait() or code
