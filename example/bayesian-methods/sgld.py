@@ -1,0 +1,80 @@
+"""Stochastic Gradient Langevin Dynamics (reference
+`example/bayesian-methods/sgld.ipynb` + `algos.py` — SGLD posterior
+sampling: SGD steps plus Gaussian noise scaled to the step size, samples
+collected after burn-in approximate the Bayesian posterior).
+
+Port on Bayesian linear regression where the exact posterior is known in
+closed form: the test asserts the SGLD sample mean matches the
+analytical posterior mean and that the sample spread is nonzero (it is a
+SAMPLER, not an optimizer). Exercises the optimizer extension surface —
+SGLD is registered as a custom mx.optimizer.Optimizer.
+
+    python example/bayesian-methods/sgld.py [--steps 4000]
+"""
+import argparse
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd as ag, nd
+from mxnet_tpu import optimizer as opt
+
+
+@opt.register
+class SGLDToy(opt.Optimizer):
+    """reference algos.py SGLD: w += -lr/2 * grad + N(0, lr)."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+
+    def create_state(self, index, weight):
+        return None
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        g = grad + wd * weight
+        noise = nd.random.normal(0, np.sqrt(lr), weight.shape)
+        weight[:] = weight - 0.5 * lr * g + noise
+
+
+def train(steps=4000, burn_in=1000, lr=2e-3, seed=0, log=print):
+    rng = np.random.default_rng(seed)
+    mx.random.seed(seed)
+    # y = X w* + eps, eps ~ N(0, sigma^2); prior w ~ N(0, tau^2 I)
+    n, d, sigma, tau = 64, 3, 0.5, 10.0
+    w_true = np.array([1.5, -2.0, 0.5], np.float32)
+    X = rng.standard_normal((n, d)).astype(np.float32)
+    y = X @ w_true + sigma * rng.standard_normal(n).astype(np.float32)
+    # closed-form posterior: Sigma = (X'X/sig^2 + I/tau^2)^-1, mu = Sigma X'y/sig^2
+    Sigma = np.linalg.inv(X.T @ X / sigma ** 2 + np.eye(d) / tau ** 2)
+    mu_post = Sigma @ X.T @ y / sigma ** 2
+
+    w = nd.zeros((d,))
+    w.attach_grad()
+    optimizer = opt.create("sgldtoy", learning_rate=lr, rescale_grad=1.0)
+    updater = opt.get_updater(optimizer)
+    samples = []
+    for t in range(steps):
+        with ag.record():
+            # negative log joint (up to const): lik + prior
+            resid = nd.dot(nd.array(X), w) - nd.array(y)
+            nll = (resid ** 2).sum() / (2 * sigma ** 2) + \
+                (w ** 2).sum() / (2 * tau ** 2)
+        nll.backward()
+        updater(0, w.grad, w)
+        if t >= burn_in and t % 10 == 0:
+            samples.append(w.asnumpy().copy())
+        if t % 1000 == 0:
+            log("step %5d  nll %.2f" % (t, float(nll.asnumpy())))
+    S = np.stack(samples)
+    log("posterior mean (sgld): %s" % S.mean(0))
+    log("posterior mean (true): %s" % mu_post)
+    return S, mu_post, Sigma
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=4000)
+    train(steps=ap.parse_args().steps)

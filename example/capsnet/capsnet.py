@@ -1,0 +1,124 @@
+"""Capsule network with dynamic routing (reference
+`example/capsnet/capsulenet.py` + `capsulelayers.py` — primary caps,
+digit caps with routing-by-agreement, squash nonlinearity, margin loss).
+
+Port: the same three stages on small synthetic digits; the routing loop
+is a fixed-iteration agreement update (softmax coupling -> weighted vote
+-> squash -> agreement dot), fully traced into one XLA program.
+
+    python example/capsnet/capsnet.py [--epochs 6]
+"""
+import argparse
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd as ag, gluon, nd
+from mxnet_tpu.gluon import nn
+
+SIZE = 16
+N_CLASS = 4
+PRIM_CAPS, PRIM_DIM = 8, 8
+DIGIT_DIM = 16
+ROUTING_ITERS = 3
+
+
+def squash(s, axis=-1):
+    """reference capsulelayers.py:squash."""
+    sq = (s ** 2).sum(axis=axis, keepdims=True)
+    return sq / (1.0 + sq) * s / nd.sqrt(sq + 1e-9)
+
+
+class CapsNet(gluon.HybridBlock):
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        with self.name_scope():
+            self.conv = nn.Conv2D(32, 5, strides=2, padding=2,
+                                  activation="relu", in_channels=1)
+            self.primary = nn.Conv2D(PRIM_CAPS * PRIM_DIM, 5, strides=2,
+                                     padding=2, in_channels=32)
+            n_prim = PRIM_CAPS * (SIZE // 4) * (SIZE // 4)
+            self.routing_weight = self.params.get(
+                "routing_weight",
+                shape=(1, n_prim, N_CLASS, DIGIT_DIM, PRIM_DIM),
+                init=mx.init.Normal(0.05))
+
+    def hybrid_forward(self, F, x, routing_weight=None):
+        B = x.shape[0]
+        h = self.primary(self.conv(x))           # (B, C*D, S/4, S/4)
+        u = h.reshape((B, PRIM_CAPS, PRIM_DIM, -1))
+        u = u.transpose((0, 1, 3, 2)).reshape((B, -1, PRIM_DIM))
+        u = squash(u)                            # (B, P, prim_dim)
+        # predictions u_hat[b, i, j, :] = W_ij @ u_i
+        uh = (routing_weight *
+              u.reshape((B, -1, 1, 1, PRIM_DIM))).sum(axis=-1)
+        # (B, P, N_CLASS, DIGIT_DIM)
+        b_logits = nd.zeros((B, uh.shape[1], N_CLASS, 1))
+        for _ in range(ROUTING_ITERS):
+            c = nd.softmax(b_logits, axis=2)     # coupling over classes
+            s = (c * uh).sum(axis=1)             # (B, N_CLASS, DIGIT_DIM)
+            v = squash(s, axis=-1)
+            agree = (uh * v.reshape((B, 1, N_CLASS, DIGIT_DIM))
+                     ).sum(axis=-1, keepdims=True)
+            b_logits = b_logits + agree
+        return nd.sqrt((v ** 2).sum(axis=-1) + 1e-9)   # class lengths
+
+
+def margin_loss(lengths, onehot):
+    """reference capsulenet.py margin loss."""
+    pos = nd.maximum(0.0, 0.9 - lengths) ** 2
+    neg = nd.maximum(0.0, lengths - 0.1) ** 2
+    return (onehot * pos + 0.5 * (1 - onehot) * neg).sum(axis=1).mean()
+
+
+def make_digits(n, rng):
+    X = rng.normal(0, 0.15, (n, 1, SIZE, SIZE)).astype(np.float32)
+    y = rng.integers(0, N_CLASS, n)
+    for i in range(n):
+        if y[i] == 0:     # horizontal bar
+            X[i, 0, 7:9, 2:14] += 1.5
+        elif y[i] == 1:   # vertical bar
+            X[i, 0, 2:14, 7:9] += 1.5
+        elif y[i] == 2:   # diagonal
+            for d in range(12):
+                X[i, 0, 2 + d, 2 + d] += 1.5
+        else:             # box outline
+            X[i, 0, 3:13, 3] += 1.5
+            X[i, 0, 3:13, 12] += 1.5
+            X[i, 0, 3, 3:13] += 1.5
+            X[i, 0, 12, 3:13] += 1.5
+    return X, y.astype(np.int64)
+
+
+def train(epochs=6, batch=32, lr=2e-3, seed=0, log=print):
+    rng = np.random.default_rng(seed)
+    mx.random.seed(seed)
+    net = CapsNet()
+    net.initialize(mx.init.Xavier())
+    X, Y = make_digits(256, rng)
+    Xv, Yv = make_digits(96, rng)
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": lr})
+    for ep in range(epochs):
+        tot = 0.0
+        for i in range(0, len(X), batch):
+            yb = Y[i:i + batch]
+            onehot = np.zeros((len(yb), N_CLASS), np.float32)
+            onehot[np.arange(len(yb)), yb] = 1.0
+            with ag.record():
+                lengths = net(nd.array(X[i:i + batch]))
+                loss = margin_loss(lengths, nd.array(onehot))
+            loss.backward()
+            trainer.step(1)
+            tot += float(loss.asnumpy())
+        pred = net(nd.array(Xv)).asnumpy().argmax(1)
+        acc = float((pred == Yv).mean())
+        log("epoch %d  margin loss %.4f  acc %.3f"
+            % (ep, tot / (len(X) // batch), acc))
+    return acc
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=6)
+    train(epochs=ap.parse_args().epochs)

@@ -1,0 +1,116 @@
+"""FCN semantic segmentation (reference `example/fcn-xs/` — VGG-FCN with
+`symbol_fcnxs.py` score layers, Deconvolution bilinear upsampling and
+Crop to input size, per-pixel softmax).
+
+Port: conv encoder (stride 4 total) -> 1x1 score conv -> Deconvolution
+x4 upsample initialized bilinear (reference `init_fcnxs.py:29`) -> Crop
+to the input -> per-pixel softmax CE, on a synthetic shapes dataset.
+Exercises Deconvolution, Crop, bilinear kernel init, and NCHW per-pixel
+losses end-to-end.
+
+    python example/fcn-xs/fcn.py [--epochs 8]
+"""
+import argparse
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd as ag, gluon, nd
+from mxnet_tpu.gluon import nn
+
+SIZE = 32
+N_CLASSES = 3  # background / square / disk
+
+
+def bilinear_kernel(channels, k):
+    """reference init_fcnxs.py:29 bilinear filler."""
+    factor = (k + 1) // 2
+    center = factor - 1.0 if k % 2 == 1 else factor - 0.5
+    og = np.ogrid[:k, :k]
+    filt = (1 - abs(og[0] - center) / factor) * \
+        (1 - abs(og[1] - center) / factor)
+    w = np.zeros((channels, channels, k, k), np.float32)
+    for c in range(channels):
+        w[c, c] = filt
+    return w
+
+
+class FCN(gluon.HybridBlock):
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        with self.name_scope():
+            self.c1 = nn.Conv2D(16, 3, padding=1, activation="relu",
+                                in_channels=3)
+            self.p1 = nn.MaxPool2D(2, 2)
+            self.c2 = nn.Conv2D(32, 3, padding=1, activation="relu",
+                                in_channels=16)
+            self.p2 = nn.MaxPool2D(2, 2)
+            self.score = nn.Conv2D(N_CLASSES, 1, in_channels=32)
+            # fixed bilinear upsampling kernel (reference init_fcnxs.py:29
+            # initializes the deconv filter bilinear; grad_req null keeps
+            # it frozen like the reference's fixed filler)
+            self.up_weight = self.params.get(
+                "up_weight", shape=(N_CLASSES, N_CLASSES, 8, 8),
+                init=mx.init.Constant(bilinear_kernel(N_CLASSES, 8)),
+                grad_req="null")
+
+    def hybrid_forward(self, F, x, up_weight=None):
+        h = self.p2(self.c2(self.p1(self.c1(x))))
+        s = self.score(h)                       # (B, C, S/4, S/4)
+        up = F.Deconvolution(s, up_weight, kernel=(8, 8),
+                             stride=(4, 4), pad=(2, 2),
+                             num_filter=N_CLASSES, no_bias=True)
+        return F.Crop(up, x, offset=(0, 0))     # crop to input HxW
+
+
+def make_data(n, rng):
+    imgs = np.zeros((n, 3, SIZE, SIZE), np.float32)
+    labels = np.zeros((n, SIZE, SIZE), np.float32)
+    for i in range(n):
+        img = rng.normal(0, 0.1, (3, SIZE, SIZE)).astype(np.float32)
+        # a square of class 1
+        x0, y0 = rng.integers(2, SIZE - 12, 2)
+        img[0, y0:y0 + 10, x0:x0 + 10] += 1.0
+        labels[i, y0:y0 + 10, x0:x0 + 10] = 1
+        # a disk of class 2
+        cx, cy = rng.integers(8, SIZE - 8, 2)
+        yy, xx = np.ogrid[:SIZE, :SIZE]
+        disk = (yy - cy) ** 2 + (xx - cx) ** 2 <= 25
+        img[1][disk] += 1.0
+        labels[i][disk] = 2
+        imgs[i] = img
+    return imgs, labels
+
+
+def train(epochs=8, batch=8, lr=0.05, seed=0, log=print):
+    rng = np.random.default_rng(seed)
+    mx.random.seed(seed)
+    net = FCN()
+    net.initialize(mx.init.Xavier())
+    X, Y = make_data(64, rng)
+    Xv, Yv = make_data(16, rng)
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss(axis=1)
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": lr})
+    for ep in range(epochs):
+        tot = 0.0
+        for i in range(0, len(X), batch):
+            xb = nd.array(X[i:i + batch])
+            yb = nd.array(Y[i:i + batch])
+            with ag.record():
+                out = net(xb)
+                loss = loss_fn(out, yb).mean()
+            loss.backward()
+            trainer.step(1)
+            tot += float(loss.asnumpy())
+        pred = net(nd.array(Xv)).asnumpy().argmax(1)
+        acc = float((pred == Yv).mean())
+        log("epoch %d  loss %.4f  pixel-acc %.3f"
+            % (ep, tot / (len(X) // batch), acc))
+    return acc, pred
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=8)
+    train(epochs=ap.parse_args().epochs)

@@ -1,0 +1,102 @@
+"""Named-entity recognition (reference
+`example/named_entity_recognition/src/ner.py` — BiLSTM over embedded
+tokens, per-token softmax, entity-weighted loss on padded sequences).
+
+Synthetic entity data: PERSON tokens follow a trigger token ("mr"),
+LOCATION tokens follow "in" — so the tagger must use LEFT context
+(forward LSTM) while plain per-token classification fails; a second
+pattern needs RIGHT context (backward LSTM). Padding is masked out of
+the loss with SequenceMask like the reference's sample weighting.
+
+    python example/named_entity_recognition/ner.py [--epochs 10]
+"""
+import argparse
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd as ag, gluon, nd
+from mxnet_tpu.gluon import nn, rnn
+
+VOCAB, MAXLEN, EMBED, HIDDEN = 60, 14, 16, 32
+TAGS = 3            # O / PERSON / LOCATION
+MR, IN = 5, 6       # trigger tokens
+PAD = 0
+
+
+class NERTagger(gluon.HybridBlock):
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        with self.name_scope():
+            self.embed = nn.Embedding(VOCAB, EMBED)
+            self.lstm = rnn.LSTM(HIDDEN, bidirectional=True,
+                                 layout="NTC", input_size=EMBED)
+            self.out = nn.Dense(TAGS, flatten=False, in_units=2 * HIDDEN)
+
+    def hybrid_forward(self, F, tokens):
+        e = self.embed(tokens)          # (B, T, E)
+        h = self.lstm(e)                # (B, T, 2H)
+        return self.out(h)              # (B, T, TAGS)
+
+
+def make_data(n, rng):
+    X = rng.integers(10, VOCAB, (n, MAXLEN))
+    Y = np.zeros((n, MAXLEN), np.int64)
+    lengths = rng.integers(8, MAXLEN + 1, n)
+    for i in range(n):
+        X[i, lengths[i]:] = PAD
+        # "mr <PERSON>" somewhere
+        p = rng.integers(0, lengths[i] - 2)
+        X[i, p] = MR
+        Y[i, p + 1] = 1
+        # "<LOC> in" (right-context pattern: the entity PRECEDES it)
+        q = rng.integers(0, lengths[i] - 2)
+        if abs(int(q) - int(p)) > 2:
+            X[i, q + 1] = IN
+            Y[i, q] = 2
+    return (X.astype(np.float32), Y.astype(np.float32),
+            lengths.astype(np.float32))
+
+
+def train(epochs=10, batch=32, lr=5e-3, seed=0, log=print):
+    rng = np.random.default_rng(seed)
+    mx.random.seed(seed)
+    net = NERTagger()
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    X, Y, L = make_data(512, rng)
+    Xv, Yv, Lv = make_data(128, rng)
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": lr})
+    for ep in range(epochs):
+        tot = 0.0
+        for i in range(0, len(X), batch):
+            xb, yb = nd.array(X[i:i + batch]), nd.array(Y[i:i + batch])
+            lb = nd.array(L[i:i + batch])
+            with ag.record():
+                out = net(xb)                                  # (B,T,C)
+                # per-token NLL, padding masked out (reference ner.py
+                # weights the loss by a not-pad mask)
+                logp = nd.log_softmax(out, axis=-1)
+                per_tok = -nd.pick(logp, yb, axis=-1)          # (B,T)
+                masked = nd.SequenceMask(per_tok.transpose((1, 0)),
+                                         sequence_length=lb,
+                                         use_sequence_length=True)
+                loss = masked.sum() / lb.sum()
+            loss.backward()
+            trainer.step(1)
+            tot += float(loss.asnumpy())
+        pred = net(nd.array(Xv)).asnumpy().argmax(-1)
+        mask = (np.arange(MAXLEN)[None] < Lv[:, None])
+        ent = (Yv > 0) & mask
+        ent_recall = float((pred[ent] == Yv[ent]).mean())
+        acc = float((pred[mask] == Yv[mask]).mean())
+        log("epoch %d  loss %.4f  tok acc %.3f  entity recall %.3f"
+            % (ep, tot / (len(X) // batch), acc, ent_recall))
+    return acc, ent_recall
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=10)
+    train(epochs=ap.parse_args().epochs)

@@ -1,0 +1,120 @@
+"""Neural style transfer (reference `example/neural-style/nstyle.py` —
+optimize the INPUT image against content + Gram-matrix style losses from
+a pretrained VGG; `model_vgg19.py` loads fixed weights).
+
+Port on a compact fixed-weight CNN (pretrained-VGG stand-in, weights
+loaded from a deterministic file to exercise the load path): the
+variable being optimized is the image itself — `x.attach_grad()` +
+`autograd.record` + manual Adam on the pixel tensor, exactly the
+reference's training loop structure (nstyle.py:159 train loop).
+
+    python example/neural-style/nstyle.py [--steps 60]
+"""
+import argparse
+import os
+import tempfile
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd as ag, nd
+from mxnet_tpu.gluon import nn
+
+SIZE = 32
+
+
+def build_extractor(seed=0):
+    """3-stage conv feature extractor with FIXED (non-trainable) weights,
+    saved+loaded through the params file format like the reference loads
+    vgg19.params."""
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(16, 3, padding=1, activation="relu", in_channels=3),
+            nn.Conv2D(32, 3, strides=2, padding=1, activation="relu",
+                      in_channels=16),
+            nn.Conv2D(64, 3, strides=2, padding=1, activation="relu",
+                      in_channels=32))
+    mx.random.seed(seed)
+    net.initialize(mx.init.Xavier())
+    path = os.path.join(tempfile.gettempdir(), "nstyle_extractor.params")
+    net.save_parameters(path)
+    net2 = nn.HybridSequential()
+    net2.add(nn.Conv2D(16, 3, padding=1, activation="relu", in_channels=3),
+             nn.Conv2D(32, 3, strides=2, padding=1, activation="relu",
+                       in_channels=16),
+             nn.Conv2D(64, 3, strides=2, padding=1, activation="relu",
+                       in_channels=32))
+    net2.load_parameters(path)   # the pretrained-weight load path
+    for p in net2.collect_params().values():
+        p.grad_req = "null"      # frozen backbone
+    return net2
+
+
+def features(net, x):
+    """Per-stage activations (the reference taps relu1_1/relu2_1/...)."""
+    feats = []
+    h = x
+    for layer in net:
+        h = layer(h)
+        feats.append(h)
+    return feats
+
+
+def gram(f):
+    B, C, H, W = f.shape
+    m = f.reshape((C, H * W))
+    return nd.dot(m, m.T) / (C * H * W)
+
+
+def make_images(seed=0):
+    rng = np.random.default_rng(seed)
+    yy, xx = np.mgrid[0:SIZE, 0:SIZE] / SIZE
+    content = np.stack([yy, xx, (xx + yy) / 2]).astype(np.float32)[None]
+    stripes = np.sin(16 * np.pi * xx)[None].repeat(3, 0).astype(np.float32)
+    style = stripes[None] + 0.05 * rng.standard_normal(
+        (1, 3, SIZE, SIZE)).astype(np.float32)
+    return content, style
+
+
+def train(steps=60, content_weight=1.0, style_weight=50.0, lr=0.05,
+          log=print):
+    net = build_extractor()
+    content_np, style_np = make_images()
+    content_feats = [f.asnumpy() for f in features(net, nd.array(content_np))]
+    style_grams = [gram(f).asnumpy()
+                   for f in features(net, nd.array(style_np))]
+
+    x = nd.array(content_np.copy())
+    x.attach_grad()
+    losses = []
+    m = v = None
+    for it in range(steps):
+        with ag.record():
+            feats = features(net, x)
+            c_loss = ((feats[-1] - nd.array(content_feats[-1])) ** 2).mean()
+            s_loss = sum(((gram(f) - nd.array(g)) ** 2).sum()
+                         for f, g in zip(feats, style_grams))
+            loss = content_weight * c_loss + style_weight * s_loss
+        loss.backward()
+        g = x.grad.asnumpy()
+        # Adam on the image (reference uses mx.optimizer on the pixel blob)
+        m = g if m is None else 0.9 * m + 0.1 * g
+        v = g * g if v is None else 0.999 * v + 0.001 * g * g
+        x = nd.array(x.asnumpy() - lr * m / (np.sqrt(v) + 1e-8))
+        x.attach_grad()
+        losses.append(float(loss.asnumpy()))
+        if it % 20 == 0:
+            log("step %3d  loss %.4f (content %.4f style %.4f)"
+                % (it, losses[-1], float(c_loss.asnumpy()),
+                   float(s_loss.asnumpy())))
+    final_grams = [gram(f).asnumpy() for f in features(net, x)]
+    style_dist = sum(float(((a - b) ** 2).sum())
+                     for a, b in zip(final_grams, style_grams))
+    init_dist = sum(float(((gram(nd.array(f)).asnumpy() - g) ** 2).sum())
+                    for f, g in zip(content_feats, style_grams))
+    return losses, style_dist, init_dist
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=60)
+    train(steps=ap.parse_args().steps)

@@ -1,0 +1,105 @@
+"""Advantage actor-critic (reference
+`example/reinforcement-learning/a3c/a3c.py` and
+`parallel_actor_critic/train.py` — policy + value heads on a shared
+trunk, advantage-weighted policy gradient with entropy bonus).
+
+Single-process port on a stochastic corridor environment. Exercises:
+two-headed network, REINFORCE-style loss where the gradient signal is a
+detached advantage (no dataset labels), entropy regularization.
+
+    python example/reinforcement-learning/actor_critic.py
+"""
+import argparse
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd as ag, gluon, nd
+from mxnet_tpu.gluon import nn
+
+N_STATES = 10   # corridor positions; reward at the right end
+N_ACTIONS = 2   # left / right
+
+
+class ACNet(gluon.HybridBlock):
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        with self.name_scope():
+            self.trunk = nn.Dense(64, activation="relu", in_units=N_STATES)
+            self.policy = nn.Dense(N_ACTIONS, in_units=64)
+            self.value = nn.Dense(1, in_units=64)
+
+    def hybrid_forward(self, F, x):
+        h = self.trunk(x)
+        return self.policy(h), self.value(h)
+
+
+def env_step(state, action, rng):
+    # 10% chance the move slips; +5 at the right end, -0.1 per step
+    if rng.random() < 0.1:
+        action = 1 - action
+    state = max(0, min(N_STATES - 1, state + (1 if action == 1 else -1)))
+    done = state == N_STATES - 1
+    return state, (5.0 if done else -0.1), done
+
+
+def one_hot(s):
+    v = np.zeros((1, N_STATES), np.float32)
+    v[0, s] = 1.0
+    return v
+
+
+def train(episodes=300, gamma=0.97, lr=1e-2, seed=0, log=print):
+    rng = np.random.default_rng(seed)
+    mx.random.seed(seed)
+    net = ACNet()
+    net.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": lr})
+    returns = []
+    for ep in range(episodes):
+        s, done, steps = 0, False, 0
+        states, actions, rewards = [], [], []
+        while not done and steps < 50:
+            logits, _ = net(nd.array(one_hot(s)))
+            p = np.exp(logits.asnumpy()[0] - logits.asnumpy()[0].max())
+            p = p / p.sum()
+            a = int(rng.choice(N_ACTIONS, p=p))
+            s2, r, done = env_step(s, a, rng)
+            states.append(one_hot(s)[0])
+            actions.append(a)
+            rewards.append(r)
+            s = s2
+            steps += 1
+        # n-step discounted returns
+        G, rets = 0.0, []
+        for r in reversed(rewards):
+            G = r + gamma * G
+            rets.append(G)
+        rets = np.array(rets[::-1], np.float32)
+        X = nd.array(np.array(states, np.float32))
+        A = nd.array(np.array(actions, np.float32))
+        R = nd.array(rets)
+        with ag.record():
+            logits, values = net(X)
+            logp = nd.log_softmax(logits, axis=-1)
+            taken = nd.pick(logp, A, axis=1)
+            adv = R - values.reshape((-1,))
+            adv_detached = adv.detach()               # stop-gradient
+            policy_loss = -(taken * adv_detached).mean()
+            value_loss = (adv ** 2).mean()
+            entropy = -(nd.softmax(logits, axis=-1) * logp).sum(axis=1).mean()
+            loss = policy_loss + 0.5 * value_loss - 0.01 * entropy
+        loss.backward()
+        trainer.step(1)
+        returns.append(sum(rewards))
+        if ep % 50 == 0:
+            log("episode %3d  return %6.2f  len %d"
+                % (ep, returns[-1], steps))
+    return returns
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--episodes", type=int, default=300)
+    train(episodes=ap.parse_args().episodes)

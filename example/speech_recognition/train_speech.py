@@ -1,0 +1,109 @@
+"""Speech recognition with CTC (reference `example/speech_recognition/` —
+DeepSpeech-style: conv frontend over spectrograms, recurrent layers,
+CTC loss over unaligned label sequences; `arch_deepspeech.py`).
+
+Port on synthetic spectrograms: each "phoneme" is a band-limited energy
+burst, utterances are unaligned phoneme sequences, and the model must
+learn the alignment itself — exactly CTC's job. Conv frontend -> BiGRU
+-> per-frame softmax -> CTCLoss, greedy CTC decode for eval.
+
+    python example/speech_recognition/train_speech.py [--epochs 15]
+"""
+import argparse
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd as ag, gluon, nd
+from mxnet_tpu.gluon import nn, rnn
+
+N_MEL = 16          # spectrogram bins
+FRAMES = 32         # time frames
+N_PHONE = 5         # phoneme classes 0..4; CTC blank = index N_PHONE (last)
+MAX_LABEL = 3
+
+
+class SpeechNet(gluon.HybridBlock):
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        with self.name_scope():
+            self.conv = nn.Conv1D(24, 5, padding=2, activation="relu",
+                                  in_channels=N_MEL)
+            self.gru = rnn.GRU(32, bidirectional=True, layout="NTC",
+                               input_size=24)
+            self.out = nn.Dense(N_PHONE + 1, flatten=False, in_units=64)
+
+    def hybrid_forward(self, F, spec):
+        # spec: (B, N_MEL, T)
+        h = self.conv(spec).transpose((0, 2, 1))   # (B, T, C)
+        return self.out(self.gru(h))               # (B, T, N_PHONE+1)
+
+
+def make_utterances(n, rng):
+    specs = rng.normal(0, 0.3, (n, N_MEL, FRAMES)).astype(np.float32)
+    # pad with -1: gluon CTCLoss convention (labels < 0 mark padding;
+    # blank is the LAST class index)
+    labels = np.full((n, MAX_LABEL), -1.0, np.float32)
+    for i in range(n):
+        k = rng.integers(2, MAX_LABEL + 1)
+        phones = rng.integers(0, N_PHONE, k)
+        # spread bursts over time with jitter (unaligned!)
+        starts = np.sort(rng.choice(FRAMES - 8, k, replace=False))
+        for j, ph in enumerate(phones):
+            band = slice(ph * 3, ph * 3 + 3)
+            t0 = starts[j]
+            specs[i, band, t0:t0 + 6] += 2.0
+        labels[i, :k] = phones
+    return specs, labels
+
+
+def greedy_decode(logits):
+    """CTC greedy: argmax per frame, collapse repeats, drop blanks
+    (blank = N_PHONE, the last class)."""
+    path = logits.argmax(-1)
+    out = []
+    for seq in path:
+        prev, dec = -1, []
+        for t in seq:
+            if t != prev and t != N_PHONE:
+                dec.append(int(t))
+            prev = t
+        out.append(dec)
+    return out
+
+
+def train(epochs=15, batch=32, lr=1e-2, seed=0, log=print):
+    rng = np.random.default_rng(seed)
+    mx.random.seed(seed)
+    net = SpeechNet()
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    X, Y = make_utterances(256, rng)
+    Xv, Yv = make_utterances(96, rng)
+    ctc = gluon.loss.CTCLoss(layout="NTC", label_layout="NT")
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": lr})
+    for ep in range(epochs):
+        tot = 0.0
+        for i in range(0, len(X), batch):
+            with ag.record():
+                logits = net(nd.array(X[i:i + batch]))
+                loss = ctc(logits, nd.array(Y[i:i + batch])).mean()
+            loss.backward()
+            trainer.step(1)
+            tot += float(loss.asnumpy())
+        decoded = greedy_decode(net(nd.array(Xv)).asnumpy())
+        exact = 0
+        for d, lab in zip(decoded, Yv):
+            ref = [int(v) for v in lab if v >= 0]
+            exact += d == ref
+        ser = 1.0 - exact / len(Yv)
+        log("epoch %2d  ctc loss %.4f  seq err %.3f"
+            % (ep, tot / (len(X) // batch), ser))
+    return ser
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=15)
+    train(epochs=ap.parse_args().epochs)

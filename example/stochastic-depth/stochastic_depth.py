@@ -1,0 +1,115 @@
+"""Stochastic depth (reference `example/stochastic-depth/sd_module.py` /
+`sd_cifar10.py` — residual blocks randomly skipped at train time with
+depth-linear survival probabilities; at inference every block runs,
+scaled by its survival probability).
+
+Exercises train/inference mode divergence driven by framework RNG: the
+per-block Bernoulli gate uses mx.nd.Dropout's counter-hash stream, and
+eval is deterministic.
+
+    python example/stochastic-depth/stochastic_depth.py [--epochs 8]
+"""
+import argparse
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd as ag, gluon, nd
+from mxnet_tpu.gluon import nn
+
+SIZE = 16
+N_CLASS = 4
+
+
+class SDBlock(gluon.HybridBlock):
+    """Residual block skipped with prob 1-p_survive during training
+    (reference sd_module.py death_rate)."""
+
+    def __init__(self, channels, p_survive, **kw):
+        super().__init__(**kw)
+        self._p = p_survive
+        with self.name_scope():
+            self.body = nn.HybridSequential(prefix="body_")
+            self.body.add(
+                nn.Conv2D(channels, 3, padding=1, in_channels=channels),
+                nn.BatchNorm(),
+                nn.Activation("relu"),
+                nn.Conv2D(channels, 3, padding=1, in_channels=channels),
+                nn.BatchNorm())
+
+    def hybrid_forward(self, F, x):
+        out = self.body(x)
+        if ag.is_training():
+            # one Bernoulli per forward: Dropout on a scalar-ish gate
+            # (keep-prob p) zeroes or keeps the whole branch; Dropout's
+            # 1/p rescale is undone so the kept branch passes unscaled,
+            # matching the reference train-time semantics
+            gate = F.Dropout(F.ones((1, 1, 1, 1)), p=1.0 - self._p) \
+                * self._p
+            return F.Activation(x + out * gate, act_type="relu")
+        return F.Activation(x + self._p * out, act_type="relu")
+
+
+class SDNet(gluon.HybridBlock):
+    def __init__(self, n_blocks=6, **kw):
+        super().__init__(**kw)
+        with self.name_scope():
+            self.stem = nn.Conv2D(16, 3, padding=1, activation="relu",
+                                  in_channels=1)
+            self.blocks = nn.HybridSequential(prefix="blocks_")
+            for i in range(n_blocks):
+                # depth-linear survival: p_l = 1 - l/L * (1 - p_L)
+                p = 1.0 - (i + 1) / n_blocks * 0.5
+                self.blocks.add(SDBlock(16, p))
+            self.head = nn.HybridSequential(prefix="head_")
+            self.head.add(nn.GlobalAvgPool2D(), nn.Flatten(),
+                          nn.Dense(N_CLASS, in_units=16))
+
+    def hybrid_forward(self, F, x):
+        return self.head(self.blocks(self.stem(x)))
+
+
+def make_data(n, rng):
+    X = rng.normal(0, 0.2, (n, 1, SIZE, SIZE)).astype(np.float32)
+    y = rng.integers(0, N_CLASS, n)
+    for i in range(n):
+        q = y[i]
+        r0, c0 = (q // 2) * 8, (q % 2) * 8
+        X[i, 0, r0:r0 + 8, c0:c0 + 8] += 1.0
+    return X, y.astype(np.float32)
+
+
+def train(epochs=8, batch=32, lr=2e-3, seed=0, log=print):
+    rng = np.random.default_rng(seed)
+    mx.random.seed(seed)
+    net = SDNet()
+    net.initialize(mx.init.Xavier())
+    X, Y = make_data(256, rng)
+    Xv, Yv = make_data(96, rng)
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": lr})
+    for ep in range(epochs):
+        tot = 0.0
+        for i in range(0, len(X), batch):
+            with ag.record():
+                out = net(nd.array(X[i:i + batch]))
+                loss = loss_fn(out, nd.array(Y[i:i + batch])).mean()
+            loss.backward()
+            trainer.step(1)
+            tot += float(loss.asnumpy())
+        pred = net(nd.array(Xv)).asnumpy().argmax(1)
+        acc = float((pred == Yv.astype(np.int64)).mean())
+        log("epoch %d  loss %.4f  acc %.3f"
+            % (ep, tot / (len(X) // batch), acc))
+    # eval determinism: two eval passes must agree exactly
+    o1 = net(nd.array(Xv)).asnumpy()
+    o2 = net(nd.array(Xv)).asnumpy()
+    deterministic = bool(np.array_equal(o1, o2))
+    return acc, deterministic
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=8)
+    train(epochs=ap.parse_args().epochs)

@@ -1,0 +1,152 @@
+"""VAE-GAN (reference `example/vae-gan/vaegan_mxnet.py` — a VAE whose
+decoder doubles as the GAN generator: encoder -> reparameterized latent
+-> decoder, trained with KL + reconstruction + a discriminator
+feature-matching adversarial term).
+
+Port on synthetic two-mode image data; exercises the reparameterization
+trick (differentiable sampling through random_normal), joint multi-net
+training with separate Trainers, and detached-discriminator updates.
+
+    python example/vae-gan/vaegan.py [--epochs 10]
+"""
+import argparse
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd as ag, gluon, nd
+from mxnet_tpu.gluon import nn
+
+SIZE = 16
+LATENT = 8
+
+
+class Encoder(gluon.HybridBlock):
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        with self.name_scope():
+            self.body = nn.HybridSequential()
+            self.body.add(nn.Conv2D(8, 3, strides=2, padding=1,
+                                    activation="relu", in_channels=1),
+                          nn.Conv2D(16, 3, strides=2, padding=1,
+                                    activation="relu", in_channels=8),
+                          nn.Flatten())
+            self.mu = nn.Dense(LATENT, in_units=16 * 16)
+            self.logvar = nn.Dense(LATENT, in_units=16 * 16)
+
+    def hybrid_forward(self, F, x):
+        h = self.body(x)
+        return self.mu(h), self.logvar(h)
+
+
+class Decoder(gluon.HybridBlock):
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        with self.name_scope():
+            self.fc = nn.Dense(16 * 4 * 4, activation="relu",
+                               in_units=LATENT)
+            self.d1 = nn.Conv2DTranspose(8, 4, strides=2, padding=1,
+                                         activation="relu", in_channels=16)
+            self.d2 = nn.Conv2DTranspose(1, 4, strides=2, padding=1,
+                                         in_channels=8)
+
+    def hybrid_forward(self, F, z):
+        h = self.fc(z).reshape((z.shape[0], 16, 4, 4))
+        return F.sigmoid(self.d2(self.d1(h)))
+
+
+class Discriminator(gluon.HybridBlock):
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        with self.name_scope():
+            self.feat = nn.HybridSequential()
+            self.feat.add(nn.Conv2D(8, 3, strides=2, padding=1,
+                                    activation="relu", in_channels=1),
+                          nn.Conv2D(16, 3, strides=2, padding=1,
+                                    activation="relu", in_channels=8),
+                          nn.Flatten(),
+                          nn.Dense(32, activation="relu"))
+            self.out = nn.Dense(1, in_units=32)
+
+    def hybrid_forward(self, F, x):
+        f = self.feat(x)
+        return self.out(f), f
+
+
+def make_data(n, rng):
+    X = np.zeros((n, 1, SIZE, SIZE), np.float32)
+    mode = rng.integers(0, 2, n)
+    for i in range(n):
+        if mode[i]:
+            X[i, 0, 4:12, 4:12] = 1.0      # square mode
+        else:
+            yy, xx = np.ogrid[:SIZE, :SIZE]
+            X[i, 0][(yy - 8) ** 2 + (xx - 8) ** 2 <= 16] = 1.0  # disk
+    X += 0.05 * rng.standard_normal(X.shape).astype(np.float32)
+    return np.clip(X, 0, 1), mode
+
+
+def train(epochs=10, batch=32, lr=2e-3, seed=0, log=print):
+    rng = np.random.default_rng(seed)
+    mx.random.seed(seed)
+    enc, dec, dis = Encoder(), Decoder(), Discriminator()
+    for net in (enc, dec, dis):
+        net.initialize(mx.init.Xavier())
+    t_vae = gluon.Trainer(list(enc.collect_params().values()) +
+                          list(dec.collect_params().values()),
+                          "adam", {"learning_rate": lr})
+    t_dis = gluon.Trainer(dis.collect_params(), "adam",
+                          {"learning_rate": lr})
+    bce = gluon.loss.SigmoidBinaryCrossEntropyLoss(from_sigmoid=False)
+    X, _ = make_data(256, rng)
+    hist = []
+    for ep in range(epochs):
+        tot_rec = tot_kl = tot_adv = 0.0
+        for i in range(0, len(X), batch):
+            xb = nd.array(X[i:i + batch])
+            B = xb.shape[0]
+            # --- discriminator step (VAE side detached) ----------------
+            mu, logvar = enc(xb)
+            z = mu + nd.exp(0.5 * logvar) * \
+                nd.random.normal(0, 1, mu.shape)
+            fake = dec(z)
+            fake_d = fake.detach()
+            with ag.record():
+                real_logit, _ = dis(xb)
+                fake_logit, _ = dis(fake_d)
+                d_loss = bce(real_logit, nd.ones((B, 1))).mean() + \
+                    bce(fake_logit, nd.zeros((B, 1))).mean()
+            d_loss.backward()
+            t_dis.step(1)
+            # --- VAE step with adversarial feature matching ------------
+            _, real_feat = dis(xb)
+            real_feat = real_feat.detach()
+            with ag.record():
+                mu, logvar = enc(xb)
+                z = mu + nd.exp(0.5 * logvar) * \
+                    nd.random.normal(0, 1, mu.shape)
+                rec = dec(z)
+                rec_loss = ((rec - xb) ** 2).mean()
+                kl = (-0.5 * (1 + logvar - mu ** 2 -
+                              nd.exp(logvar))).mean()
+                _, fake_feat = dis(rec)
+                adv = ((fake_feat - real_feat) ** 2).mean()
+                loss = rec_loss + 0.05 * kl + 0.1 * adv
+            loss.backward()
+            t_vae.step(1)
+            tot_rec += float(rec_loss.asnumpy())
+            tot_kl += float(kl.asnumpy())
+            tot_adv += float(adv.asnumpy())
+        nb = len(X) // batch
+        hist.append((tot_rec / nb, tot_kl / nb, tot_adv / nb))
+        log("epoch %d  rec %.4f  kl %.4f  adv-feat %.4f" % (ep, *hist[-1]))
+    # sample from the prior through the decoder (the GAN-generator role)
+    z = nd.random.normal(0, 1, (16, LATENT))
+    samples = dec(z).asnumpy()
+    return hist, samples
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=10)
+    train(epochs=ap.parse_args().epochs)

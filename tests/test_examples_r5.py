@@ -1,0 +1,149 @@
+"""E2E tests for the round-5 example ports (VERDICT r4 item 2): each
+drives the example's `train` entry exactly as the CLI does and asserts
+the capability the reference example demonstrates — convergence, learned
+behavior, or structural properties (sparse updates, eval determinism,
+posterior statistics)."""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+for sub in ("reinforcement-learning", "neural-style", "fcn-xs", "nce-loss",
+            "cnn_text_classification", "named_entity_recognition",
+            "multi-task", "bi-lstm-sort", "capsnet", "stochastic-depth",
+            "bayesian-methods", "svrg_module", "vae-gan",
+            "speech_recognition"):
+    sys.path.insert(0, os.path.join(REPO, "example", sub))
+
+
+def test_rl_dqn_gridworld():
+    """DQN learns the optimal gridworld path: replay buffer + target
+    network + bootstrapped targets (no dataset labels)."""
+    from dqn import train
+    _, greedy_return, steps = train(episodes=150, log=lambda *a: None)
+    # optimal: 8 moves (-1 each except the final +10) = +3.0
+    assert greedy_return >= 2.0, greedy_return
+    assert steps <= 12, steps
+
+
+def test_rl_actor_critic_corridor():
+    """Advantage actor-critic improves the policy return."""
+    from actor_critic import train
+    rets = train(episodes=300, log=lambda *a: None)
+    first, last = np.mean(rets[:30]), np.mean(rets[-30:])
+    assert last > first, (first, last)
+    assert last > 3.0, last
+
+
+def test_neural_style_optimizes_input():
+    """Gradients w.r.t. the INPUT image: loss drops and the image's Gram
+    statistics move decisively toward the style target."""
+    from nstyle import train
+    losses, style_dist, init_dist = train(steps=60, log=lambda *a: None)
+    assert losses[-1] < 0.3 * losses[0], (losses[0], losses[-1])
+    assert style_dist < 0.3 * init_dist, (style_dist, init_dist)
+
+
+def test_fcn_segmentation():
+    """Deconvolution upsample + Crop + per-pixel softmax converge on
+    synthetic shapes."""
+    from fcn import train
+    acc, _ = train(epochs=8, log=lambda *a: None)
+    assert acc > 0.9, acc
+
+
+def test_nce_sparse_embedding_updates():
+    """NCE trains against sampled noise; embedding rows outside
+    (labels + noise head) keep their initial values — the gradient is
+    row-sparse."""
+    from toy_nce import train
+    losses, init_e, fin_e, touched = train(epochs=8, log=lambda *a: None)
+    assert losses[-1] < 0.3 * losses[0]
+    untouched = sorted(set(range(len(fin_e))) - touched)
+    assert len(untouched) > 50, len(untouched)
+    np.testing.assert_array_equal(fin_e[untouched], init_e[untouched])
+
+
+def test_cnn_text_classification():
+    """Kim-CNN separates order-sensitive trigrams a bag-of-words can't."""
+    from text_cnn import train
+    acc = train(epochs=6, log=lambda *a: None)
+    assert acc > 0.9, acc
+
+
+def test_named_entity_recognition():
+    """BiLSTM tagger uses left and right context; padding masked out."""
+    from ner import train
+    acc, ent_recall = train(epochs=8, log=lambda *a: None)
+    assert acc > 0.95, acc
+    assert ent_recall > 0.9, ent_recall
+
+
+def test_multitask_two_heads():
+    """Joint loss through a shared trunk trains both heads."""
+    from multitask import train
+    acc_digit, acc_parity = train(epochs=6, log=lambda *a: None)
+    assert acc_digit > 0.9, acc_digit
+    assert acc_parity > 0.9, acc_parity
+
+
+def test_bilstm_sort():
+    """Sorting needs global sequence context (the Bi in BiLSTM)."""
+    from sort import train
+    tok_acc, seq_acc = train(epochs=30, log=lambda *a: None)
+    assert tok_acc > 0.75, tok_acc
+    assert seq_acc > 0.1, seq_acc
+
+
+def test_capsnet_routing():
+    """Dynamic routing-by-agreement + margin loss converge."""
+    from capsnet import train
+    acc = train(epochs=5, log=lambda *a: None)
+    assert acc > 0.9, acc
+
+
+def test_stochastic_depth():
+    """Random block skipping trains; inference is deterministic with the
+    survival-probability scaling."""
+    from stochastic_depth import train
+    acc, deterministic = train(epochs=6, log=lambda *a: None)
+    assert acc > 0.9, acc
+    assert deterministic
+
+
+def test_sgld_posterior():
+    """SGLD samples match the closed-form Bayesian posterior mean and
+    genuinely spread (sampler, not optimizer)."""
+    from sgld import train
+    S, mu_post, Sigma = train(steps=3000, log=lambda *a: None)
+    assert np.abs(S.mean(0) - mu_post).max() < 0.1
+    # spread is within an order of magnitude of the posterior stddev
+    post_std = np.sqrt(np.diag(Sigma))
+    assert (S.std(0) > 0.3 * post_std).all(), (S.std(0), post_std)
+
+
+def test_svrg_beats_sgd():
+    """Variance reduction reaches a lower loss than SGD at the same lr
+    and step budget."""
+    from svrg import train
+    sgd_loss, svrg_loss = train(epochs=10, log=lambda *a: None)
+    assert svrg_loss < 0.8 * sgd_loss, (svrg_loss, sgd_loss)
+
+
+def test_vaegan():
+    """Reparameterized VAE with adversarial feature matching: recon
+    improves, KL stays finite, prior samples are in range."""
+    from vaegan import train
+    hist, samples = train(epochs=8, log=lambda *a: None)
+    assert hist[-1][0] < 0.5 * hist[0][0], (hist[0], hist[-1])
+    assert 0.0 < hist[-1][1] < 50.0
+    assert samples.min() >= 0.0 and samples.max() <= 1.0
+
+
+def test_speech_ctc():
+    """Conv+BiGRU+CTC learns unaligned phoneme sequences."""
+    from train_speech import train
+    ser = train(epochs=16, log=lambda *a: None)
+    assert ser < 0.5, ser
