@@ -1,0 +1,280 @@
+/* C++ binding over the flat C ABI (role of the reference `cpp-package/`:
+ * `include/mxnet-cpp/*.h`, which wraps include/mxnet/c_api.h with RAII
+ * classes and a code-GENERATED per-operator API, `OpWrapperGenerator.py`).
+ *
+ * This header is the hand-written core (~230 lines): NDArray / Symbol /
+ * Executor RAII wrappers plus the Operator composer. The per-op surface
+ * (mxtpu_ops.hpp) is NOT hand-written — `gen_ops.cc` emits it purely from
+ * MXSymbolListAtomicSymbolCreators + MXSymbolGetAtomicSymbolInfo, proving
+ * the ABI's §2.3 principle: new language bindings are mechanical.
+ */
+#ifndef MXTPU_CPP_HPP_
+#define MXTPU_CPP_HPP_
+
+#include <cctype>
+#include <cstdint>
+#include <map>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+#include "mxtpu_c.h"
+
+namespace mxtpu {
+
+inline void Check(int rc) {
+  if (rc != 0) throw std::runtime_error(MXGetLastError());
+}
+
+inline void Init(const char* repo_root = nullptr) {
+  Check(MXTpuInit(repo_root));
+}
+
+using KW = std::map<std::string, std::string>;
+
+// ------------------------------------------------------------- NDArray
+
+class NDArray {
+ public:
+  NDArray() : h_(nullptr) {}
+  explicit NDArray(NDArrayHandle h) : h_(h) {}
+  NDArray(const std::vector<int64_t>& shape,
+          const std::string& dtype = "float32") {
+    Check(MXNDArrayCreate(shape.data(), static_cast<int>(shape.size()),
+                          dtype.c_str(), &h_));
+  }
+  NDArray(const std::vector<float>& data,
+          const std::vector<int64_t>& shape)
+      : NDArray(shape) {
+    CopyFrom(data);
+  }
+  NDArray(const NDArray& o) : h_(o.h_ ? shallow(o.h_) : nullptr) {}
+  NDArray& operator=(const NDArray& o) {
+    if (this != &o) {
+      Free();
+      h_ = o.h_ ? shallow(o.h_) : nullptr;
+    }
+    return *this;
+  }
+  NDArray(NDArray&& o) noexcept : h_(o.h_) { o.h_ = nullptr; }
+  ~NDArray() { Free(); }
+
+  void CopyFrom(const std::vector<float>& data) {
+    Check(MXNDArraySyncCopyFromCPU(h_, data.data(),
+                                   static_cast<int64_t>(data.size())));
+  }
+  std::vector<float> ToVector() const {
+    std::vector<float> out(Size());
+    Check(MXNDArraySyncCopyToCPU(h_, out.data(),
+                                 static_cast<int64_t>(out.size())));
+    return out;
+  }
+  std::vector<int64_t> Shape() const {
+    int ndim = 0;
+    int64_t dims[16];
+    Check(MXNDArrayGetShape(h_, &ndim, dims, 16));
+    return std::vector<int64_t>(dims, dims + ndim);
+  }
+  int64_t Size() const {
+    int64_t n = 1;
+    for (int64_t d : Shape()) n *= d;
+    return n;
+  }
+  NDArrayHandle handle() const { return h_; }
+
+ private:
+  static NDArrayHandle shallow(NDArrayHandle h) {
+    NDArrayHandle out = nullptr;
+    Check(MXShallowCopyNDArray(h, &out));
+    return out;
+  }
+  void Free() {
+    if (h_) MXNDArrayFree(h_);
+    h_ = nullptr;
+  }
+  NDArrayHandle h_;
+};
+
+// imperative op call: out = op(inputs..., kw)
+inline NDArray Invoke(const std::string& op,
+                      const std::vector<NDArray>& inputs,
+                      const KW& kw = {}) {
+  std::string json = "{";
+  bool first = true;
+  for (const auto& it : kw) {
+    if (!first) json += ",";
+    first = false;
+    // numeric-looking values go in raw so the runtime sees real numbers
+    const std::string& v = it.second;
+    bool numeric = !v.empty();
+    for (char ch : v) {
+      if (!isdigit(ch) && ch != '.' && ch != '-' && ch != '+' &&
+          ch != 'e' && ch != 'E') {
+        numeric = false;
+        break;
+      }
+    }
+    json += "\"" + it.first + "\": " +
+            (numeric ? v : "\"" + v + "\"");
+  }
+  json += "}";
+  std::vector<NDArrayHandle> in;
+  for (const auto& a : inputs) in.push_back(a.handle());
+  NDArrayHandle out[8] = {nullptr};
+  int num_out = 8;
+  Check(MXImperativeInvoke(op.c_str(), in.data(),
+                           static_cast<int>(in.size()), json.c_str(), out,
+                           &num_out));
+  // first output is the result; release the rest (each is an owned ref)
+  for (int i = 1; i < num_out; ++i) {
+    if (out[i]) MXNDArrayFree(out[i]);
+  }
+  return NDArray(out[0]);
+}
+
+// -------------------------------------------------------------- Symbol
+
+class Symbol {
+ public:
+  Symbol() : h_(nullptr) {}
+  explicit Symbol(SymbolHandle h) : h_(h) {}
+  static Symbol Variable(const std::string& name) {
+    SymbolHandle h = nullptr;
+    Check(MXSymbolCreateVariable(name.c_str(), &h));
+    return Symbol(h);
+  }
+  Symbol(const Symbol& o) : h_(o.h_ ? shallow(o.h_) : nullptr) {}
+  Symbol& operator=(const Symbol& o) {
+    if (this != &o) {
+      if (h_) MXSymbolFree(h_);
+      h_ = o.h_ ? shallow(o.h_) : nullptr;
+    }
+    return *this;
+  }
+  ~Symbol() {
+    if (h_) MXSymbolFree(h_);
+  }
+
+  std::vector<std::string> ListArguments() const {
+    int n = 0;
+    const char** names = nullptr;
+    Check(MXSymbolListArguments(h_, &n, &names));
+    return std::vector<std::string>(names, names + n);
+  }
+  std::string ToJSON() const {
+    const char* js = nullptr;
+    Check(MXSymbolSaveToJSON(h_, &js));
+    return js;
+  }
+  SymbolHandle handle() const { return h_; }
+
+ private:
+  static SymbolHandle shallow(SymbolHandle h) {
+    SymbolHandle out = nullptr;
+    Check(MXShallowCopySymbol(h, &out));
+    return out;
+  }
+  SymbolHandle h_;
+};
+
+// Operator composer: CreateAtomicSymbol + Compose (missing tensor inputs
+// become fresh variables named <name>_<arg>, reference convention)
+class Operator {
+ public:
+  explicit Operator(const std::string& op) : op_(op) {}
+  Operator& SetParam(const std::string& k, const std::string& v) {
+    keys_.push_back(k);
+    vals_.push_back(v);
+    return *this;
+  }
+  Operator& AddInput(const Symbol& s) {
+    inputs_.push_back(s.handle());
+    return *this;
+  }
+  Symbol CreateSymbol(const std::string& name) {
+    std::vector<const char*> ck, cv;
+    for (auto& k : keys_) ck.push_back(k.c_str());
+    for (auto& v : vals_) cv.push_back(v.c_str());
+    SymbolHandle out = nullptr;
+    Check(MXSymbolCreateAtomicSymbol(op_.c_str(),
+                                     static_cast<int>(ck.size()),
+                                     ck.data(), cv.data(), &out));
+    std::vector<const char*> in_keys(inputs_.size(), nullptr);
+    Check(MXSymbolCompose(out, name.c_str(),
+                          static_cast<int>(inputs_.size()),
+                          in_keys.data(), inputs_.data()));
+    return Symbol(out);
+  }
+
+ private:
+  std::string op_;
+  std::vector<std::string> keys_, vals_;
+  std::vector<SymbolHandle> inputs_;
+};
+
+// ------------------------------------------------------------ Executor
+
+class Executor {
+ public:
+  Executor(const Symbol& sym, const std::string& ctx,
+           const std::string& grad_req,
+           const std::map<std::string, std::vector<int64_t>>& shapes) {
+    std::vector<const char*> keys;
+    std::vector<int> ndims;
+    std::vector<int64_t> flat;
+    for (const auto& it : shapes) {
+      keys.push_back(it.first.c_str());
+      ndims.push_back(static_cast<int>(it.second.size()));
+      flat.insert(flat.end(), it.second.begin(), it.second.end());
+    }
+    Check(MXExecutorSimpleBindEx(sym.handle(), ctx.c_str(),
+                                 grad_req.c_str(),
+                                 static_cast<int>(keys.size()),
+                                 keys.data(), ndims.data(), flat.data(),
+                                 &h_));
+  }
+  ~Executor() {
+    if (h_) MXExecutorFree(h_);
+  }
+
+  void Forward(bool is_train) {
+    Check(MXExecutorForward(h_, is_train ? 1 : 0));
+  }
+  void Backward() { Check(MXExecutorBackward(h_, 0, nullptr)); }
+
+  std::vector<NDArray> Outputs() const { return handles("outputs"); }
+  std::vector<NDArray> ArgArrays() const { return handles("args"); }
+  std::vector<NDArray> GradArrays() const { return handles("grads"); }
+  std::vector<std::string> ArgNames() const {
+    int n = 0;
+    const char** names = nullptr;
+    Check(MXExecutorArgNames(h_, &n, &names));
+    return std::vector<std::string>(names, names + n);
+  }
+  ExecutorHandle handle() const { return h_; }
+
+ private:
+  std::vector<NDArray> handles(const std::string& which) const {
+    int n = 0;
+    NDArrayHandle* arr = nullptr;
+    if (which == "outputs") {
+      Check(MXExecutorOutputs(h_, &n, &arr));
+    } else if (which == "args") {
+      Check(MXExecutorArgArrays(h_, &n, &arr));
+    } else {
+      Check(MXExecutorGradArrays(h_, &n, &arr));
+    }
+    // the ABI returns OWNED references to the executor's LIVE arrays
+    // (store_handlelist increfs the originals): wrap them directly, so
+    // CopyFrom mutates the bound buffers and grads update after each
+    // Backward — a shallow copy here would detach from the executor
+    std::vector<NDArray> out;
+    for (int i = 0; i < n; ++i) out.emplace_back(NDArray(arr[i]));
+    return out;
+  }
+  ExecutorHandle h_;
+};
+
+}  // namespace mxtpu
+
+#endif  // MXTPU_CPP_HPP_

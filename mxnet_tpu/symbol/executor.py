@@ -97,9 +97,33 @@ class Executor:
             self._fwd_cache[sig] = fn
         binds = self._bindings()
         vals = [binds[n] for n in key_names]
-        outs = fn(_random.next_key(), vals)
+        key = _random.next_key()
+        outs = fn(key, vals)
         self.outputs = [NDArray(o, ctx=self._ctx) for o in outs]
         self._last_train = is_train
+        if self._monitor is not None:
+            # debug path (reference MXExecutorSetMonitorCallback /
+            # GraphExecutor monitor): evaluate every internal node's
+            # output eagerly with the SAME rng key as the forward and
+            # hand (name, array) to the callback
+            internals = self._symbol.get_internals()
+            names = internals.list_outputs()
+            if not getattr(self, "_monitor_all", False):
+                # reference semantics: monitor OPERATOR outputs only,
+                # not bound inputs/weights
+                skip = set(self._arg_names) | set(self._aux_names)
+            else:
+                skip = set()
+            _random.push_trace_key(key)
+            try:
+                ivals = evaluate_graph(internals, binds, train=is_train,
+                                       placement=self._placement)
+            finally:
+                _random.pop_trace_key()
+            for n, v in zip(names, ivals):
+                if n in skip:
+                    continue
+                self._monitor(n, NDArray(v, ctx=self._ctx))
         return self.outputs
 
     # ---- backward ---------------------------------------------------------
@@ -190,6 +214,7 @@ class Executor:
 
     def set_monitor_callback(self, callback, monitor_all=False):
         self._monitor = callback
+        self._monitor_all = bool(monitor_all)
 
     def debug_str(self):
         return self._symbol.tojson()

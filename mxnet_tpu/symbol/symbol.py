@@ -200,8 +200,13 @@ class Symbol:
 
     def infer_type(self, *args, **kwargs):
         arg_names = self.list_arguments()
-        dt = [(_np.float32 if a is None else dtype_np(a))
-              for a in (list(args) + [None] * (len(arg_names) - len(args)))]
+        given = list(args) + [None] * (len(arg_names) - len(args))
+        # keyword form: dtypes by argument name (reference symbol.py
+        # infer_type accepts both)
+        for i, n in enumerate(arg_names):
+            if n in kwargs and kwargs[n] is not None:
+                given[i] = kwargs[n]
+        dt = [(_np.float32 if a is None else dtype_np(a)) for a in given]
         return dt, [_np.float32] * len(self._outputs_list()), \
             [_np.float32] * len(self.list_auxiliary_states())
 

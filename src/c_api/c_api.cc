@@ -583,9 +583,16 @@ MXTPU_API int MXNDArraySyncCopyFromCPU(NDArrayHandle handle,
   Py_DECREF(np);
   if (!flat) { set_error(py_error_string()); return -1; }
   PyObject* shp = PyObject_GetAttrString(arr, "shape");
-  PyObject* shaped = PyObject_CallMethod(flat, "reshape", "O", shp);
+  PyObject* view = PyObject_CallMethod(flat, "reshape", "O", shp);
   Py_DECREF(flat);
   Py_DECREF(shp);
+  if (!view) { set_error(py_error_string()); return -1; }
+  // OWNED copy: jax's CPU backend may alias a numpy buffer zero-copy, and
+  // `view` wraps the CALLER'S memory — aliasing it would leave the stored
+  // array pointing into a buffer the C host frees/reuses (observed as
+  // order-dependent zeros in the round-5 ABI tests)
+  PyObject* shaped = PyObject_CallMethod(view, "copy", nullptr);
+  Py_DECREF(view);
   if (!shaped) { set_error(py_error_string()); return -1; }
   PyObject* slice = PySlice_New(nullptr, nullptr, nullptr);
   int rc = PyObject_SetItem(arr, slice, shaped);
@@ -1520,4 +1527,782 @@ MXTPU_API int MXSetProfilerConfig(int num_params, const char** keys,
 MXTPU_API int MXDumpProfile(int finished) {
   GILGuard gil;
   return call_void("profiler_dump", Py_BuildValue("(i)", finished));
+}
+
+// =================================================================
+// Round-5 surface: binding-codegen introspection, cached ops, monitor
+// callbacks, kvstore updater/pushpull, Ex/64 variants, profiler tail.
+// Reference names: c_api.h:1076 (ListAtomicSymbolCreators), :1090
+// (GetAtomicSymbolInfo), :2205 (SetMonitorCallback), :1280 (CachedOp).
+// =================================================================
+
+namespace {
+// extra TLS string stores: GetAtomicSymbolInfo returns three string
+// lists that must stay valid simultaneously
+thread_local StrStore tls_names2;
+thread_local StrStore tls_names3;
+// creator handles: interned op-name strings, owned for process lifetime
+std::vector<PyObject*>* g_creators = nullptr;
+}  // namespace
+
+MXTPU_API int MXSymbolListAtomicSymbolCreators(int* out_size,
+                                               AtomicSymbolCreator** out) {
+  GILGuard gil;
+  static thread_local std::vector<void*> creator_store;
+  if (!g_creators) {
+    // impl_call may yield the GIL: build into a LOCAL vector and only
+    // install it if no other thread won the race meanwhile
+    PyObject* r = impl_call("atomic_symbol_creators", PyTuple_New(0));
+    if (!r) return -1;
+    auto* built = new std::vector<PyObject*>();
+    PyObject* seq = PySequence_Fast(r, "creator list");
+    if (!seq) {
+      delete built;
+      Py_DECREF(r);
+      set_error(py_error_string());
+      return -1;
+    }
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+    for (Py_ssize_t i = 0; i < n; ++i) {
+      PyObject* s = PySequence_Fast_GET_ITEM(seq, i);
+      Py_INCREF(s);
+      built->push_back(s);
+    }
+    Py_DECREF(seq);
+    Py_DECREF(r);
+    if (!g_creators) {   // GIL held from here on: safe check-and-set
+      g_creators = built;
+    } else {
+      for (PyObject* s : *built) Py_DECREF(s);
+      delete built;
+    }
+  }
+  creator_store.assign(g_creators->begin(), g_creators->end());
+  *out_size = static_cast<int>(creator_store.size());
+  *out = reinterpret_cast<AtomicSymbolCreator*>(creator_store.data());
+  return 0;
+}
+
+MXTPU_API int MXSymbolGetAtomicSymbolName(AtomicSymbolCreator creator,
+                                          const char** name) {
+  GILGuard gil;
+  const char* c = PyUnicode_AsUTF8(static_cast<PyObject*>(creator));
+  if (!c) { set_error(py_error_string()); return -1; }
+  *name = c;  // creator strings are immortal (g_creators)
+  return 0;
+}
+
+MXTPU_API int MXSymbolGetAtomicSymbolInfo(
+    AtomicSymbolCreator creator, const char** name,
+    const char** description, int* num_args, const char*** arg_names,
+    const char*** arg_type_infos, const char*** arg_descriptions,
+    const char** key_var_num_args, const char** return_type) {
+  GILGuard gil;
+  static thread_local std::string s_name, s_desc, s_kv, s_ret;
+  PyObject* r = impl_call(
+      "atomic_symbol_info",
+      PyTuple_Pack(1, static_cast<PyObject*>(creator)));
+  if (!r) return -1;
+  int rc = 0;
+  const char* c;
+  c = PyUnicode_AsUTF8(PyTuple_GetItem(r, 0));
+  s_name = c ? c : "";
+  c = PyUnicode_AsUTF8(PyTuple_GetItem(r, 1));
+  s_desc = c ? c : "";
+  c = PyUnicode_AsUTF8(PyTuple_GetItem(r, 5));
+  s_kv = c ? c : "";
+  c = PyUnicode_AsUTF8(PyTuple_GetItem(r, 6));
+  s_ret = c ? c : "";
+  if (name) *name = s_name.c_str();
+  if (description) *description = s_desc.c_str();
+  if (key_var_num_args) *key_var_num_args = s_kv.c_str();
+  if (return_type) *return_type = s_ret.c_str();
+  int n1 = 0, n2 = 0, n3 = 0;
+  rc = store_strlist(&tls_names, PyTuple_GetItem(r, 2), &n1, arg_names);
+  if (rc == 0) {
+    rc = store_strlist(&tls_names2, PyTuple_GetItem(r, 3), &n2,
+                       arg_type_infos);
+  }
+  if (rc == 0) {
+    rc = store_strlist(&tls_names3, PyTuple_GetItem(r, 4), &n3,
+                       arg_descriptions);
+  }
+  if (num_args) *num_args = n1;
+  Py_DECREF(r);
+  return rc;
+}
+
+// -------------------------------------------------------- symbol extras
+
+MXTPU_API int MXSymbolInferType(SymbolHandle sym, int num_args,
+                                const char** keys, const char** types,
+                                int partial, int* in_size,
+                                const char*** in_types, int* out_size,
+                                const char*** out_types, int* aux_size,
+                                const char*** aux_types, int* complete) {
+  GILGuard gil;
+  PyObject* args = PyTuple_New(4);
+  PyTuple_SET_ITEM(args, 0, handle_obj(sym));
+  PyTuple_SET_ITEM(args, 1, py_strlist(keys, num_args));
+  PyTuple_SET_ITEM(args, 2, py_strlist(types, num_args));
+  PyTuple_SET_ITEM(args, 3, PyLong_FromLong(partial));
+  PyObject* r = impl_call("symbol_infer_type", args);
+  if (!r) return -1;
+  int rc = store_strlist(&tls_names, PyTuple_GetItem(r, 0), in_size,
+                         in_types);
+  if (rc == 0) {
+    rc = store_strlist(&tls_names2, PyTuple_GetItem(r, 1), out_size,
+                       out_types);
+  }
+  if (rc == 0) {
+    rc = store_strlist(&tls_names3, PyTuple_GetItem(r, 2), aux_size,
+                       aux_types);
+  }
+  if (rc == 0 && complete) {
+    *complete = PyObject_IsTrue(PyTuple_GetItem(r, 3));
+  }
+  Py_DECREF(r);
+  return rc;
+}
+
+MXTPU_API int MXSymbolInferTypePartial(SymbolHandle sym, int num_args,
+                                       const char** keys,
+                                       const char** types, int* in_size,
+                                       const char*** in_types,
+                                       int* out_size,
+                                       const char*** out_types,
+                                       int* aux_size,
+                                       const char*** aux_types,
+                                       int* complete) {
+  return MXSymbolInferType(sym, num_args, keys, types, 1, in_size,
+                           in_types, out_size, out_types, aux_size,
+                           aux_types, complete);
+}
+
+MXTPU_API int MXSymbolGetChildren(SymbolHandle sym, SymbolHandle* out) {
+  GILGuard gil;
+  return call_to_handle("symbol_get_children",
+                        PyTuple_Pack(1, static_cast<PyObject*>(sym)), out);
+}
+
+MXTPU_API int MXSymbolRemoveAmpCast(SymbolHandle sym, SymbolHandle* out) {
+  GILGuard gil;
+  return call_to_handle("symbol_remove_amp_cast",
+                        PyTuple_Pack(1, static_cast<PyObject*>(sym)), out);
+}
+
+// 64/Ex shape variants: this ABI's canonical shapes are ALREADY int64
+// (header preamble); the variants alias the canonical entry so bindings
+// generated against the reference names link unchanged.
+MXTPU_API int MXSymbolInferShapeEx(
+    SymbolHandle sym, int num_args, const char** keys, const int* ndims,
+    const int64_t* shape_data, int partial, int* in_size,
+    const int** in_ndims, const int64_t** in_data, int* out_size,
+    const int** out_ndims, const int64_t** out_data, int* aux_size,
+    const int** aux_ndims, const int64_t** aux_data, int* complete) {
+  return MXSymbolInferShape(sym, num_args, keys, ndims, shape_data,
+                            partial, in_size, in_ndims, in_data, out_size,
+                            out_ndims, out_data, aux_size, aux_ndims,
+                            aux_data, complete);
+}
+
+MXTPU_API int MXSymbolInferShape64(
+    SymbolHandle sym, int num_args, const char** keys, const int* ndims,
+    const int64_t* shape_data, int partial, int* in_size,
+    const int** in_ndims, const int64_t** in_data, int* out_size,
+    const int** out_ndims, const int64_t** out_data, int* aux_size,
+    const int** aux_ndims, const int64_t** aux_data, int* complete) {
+  return MXSymbolInferShape(sym, num_args, keys, ndims, shape_data,
+                            partial, in_size, in_ndims, in_data, out_size,
+                            out_ndims, out_data, aux_size, aux_ndims,
+                            aux_data, complete);
+}
+
+MXTPU_API int MXSymbolInferShapePartial(
+    SymbolHandle sym, int num_args, const char** keys, const int* ndims,
+    const int64_t* shape_data, int* in_size, const int** in_ndims,
+    const int64_t** in_data, int* out_size, const int** out_ndims,
+    const int64_t** out_data, int* aux_size, const int** aux_ndims,
+    const int64_t** aux_data, int* complete) {
+  return MXSymbolInferShape(sym, num_args, keys, ndims, shape_data, 1,
+                            in_size, in_ndims, in_data, out_size,
+                            out_ndims, out_data, aux_size, aux_ndims,
+                            aux_data, complete);
+}
+
+MXTPU_API int MXSymbolInferShapePartial64(
+    SymbolHandle sym, int num_args, const char** keys, const int* ndims,
+    const int64_t* shape_data, int* in_size, const int** in_ndims,
+    const int64_t** in_data, int* out_size, const int** out_ndims,
+    const int64_t** out_data, int* aux_size, const int** aux_ndims,
+    const int64_t** aux_data, int* complete) {
+  return MXSymbolInferShape(sym, num_args, keys, ndims, shape_data, 1,
+                            in_size, in_ndims, in_data, out_size,
+                            out_ndims, out_data, aux_size, aux_ndims,
+                            aux_data, complete);
+}
+
+// ---------------------------------------------------------- executor
+
+MXTPU_API int MXExecutorSetMonitorCallback(ExecutorHandle exec,
+                                           ExecutorMonitorCallback cb,
+                                           void* cb_data) {
+  GILGuard gil;
+  return call_void(
+      "executor_set_monitor",
+      Py_BuildValue("(OKKi)", static_cast<PyObject*>(exec),
+                    (unsigned long long)(uintptr_t)cb,
+                    (unsigned long long)(uintptr_t)cb_data, 0));
+}
+
+MXTPU_API int MXExecutorSetMonitorCallbackEX(ExecutorHandle exec,
+                                             ExecutorMonitorCallback cb,
+                                             void* cb_data,
+                                             int monitor_all) {
+  GILGuard gil;
+  return call_void(
+      "executor_set_monitor",
+      Py_BuildValue("(OKKi)", static_cast<PyObject*>(exec),
+                    (unsigned long long)(uintptr_t)cb,
+                    (unsigned long long)(uintptr_t)cb_data, monitor_all));
+}
+
+MXTPU_API int MXExecutorReshape(int partial_shaping, int allow_up_sizing,
+                                const char* ctx, int num_provided,
+                                const char** keys, const int* ndims,
+                                const int64_t* shape_data,
+                                ExecutorHandle shared_exec,
+                                ExecutorHandle* out) {
+  GILGuard gil;
+  (void)partial_shaping; (void)allow_up_sizing; (void)ctx;
+  PyObject* args = PyTuple_New(3);
+  PyTuple_SET_ITEM(args, 0, handle_obj(shared_exec));
+  PyTuple_SET_ITEM(args, 1, py_strlist(keys, num_provided));
+  PyTuple_SET_ITEM(args, 2,
+                   py_shapelist(ndims, shape_data, num_provided));
+  return call_to_handle("executor_reshape", args, out);
+}
+
+MXTPU_API int MXExecutorReshapeEx(int partial_shaping, int allow_up_sizing,
+                                  const char* ctx, int num_provided,
+                                  const char** keys, const int* ndims,
+                                  const int64_t* shape_data,
+                                  ExecutorHandle shared_exec,
+                                  ExecutorHandle* out) {
+  return MXExecutorReshape(partial_shaping, allow_up_sizing, ctx,
+                           num_provided, keys, ndims, shape_data,
+                           shared_exec, out);
+}
+
+MXTPU_API int MXExecutorGetOptimizedSymbol(ExecutorHandle exec,
+                                           SymbolHandle* out) {
+  GILGuard gil;
+  return call_to_handle("executor_optimized_symbol",
+                        PyTuple_Pack(1, static_cast<PyObject*>(exec)),
+                        out);
+}
+
+MXTPU_API int MXExecutorSimpleBindEx(SymbolHandle sym, const char* ctx,
+                                     const char* grad_req,
+                                     int num_provided, const char** keys,
+                                     const int* ndims,
+                                     const int64_t* shape_data,
+                                     ExecutorHandle* out) {
+  return MXExecutorSimpleBind(sym, ctx, grad_req, num_provided, keys,
+                              ndims, shape_data, out);
+}
+
+MXTPU_API int MXExecutorSimpleBindEx64(SymbolHandle sym, const char* ctx,
+                                       const char* grad_req,
+                                       int num_provided,
+                                       const char** keys, const int* ndims,
+                                       const int64_t* shape_data,
+                                       ExecutorHandle* out) {
+  return MXExecutorSimpleBind(sym, ctx, grad_req, num_provided, keys,
+                              ndims, shape_data, out);
+}
+
+// ---------------------------------------------------------- cached op
+
+MXTPU_API int MXCreateCachedOp(SymbolHandle sym, CachedOpHandle* out) {
+  GILGuard gil;
+  PyObject* args = PyTuple_New(3);
+  PyTuple_SET_ITEM(args, 0, handle_obj(sym));
+  PyTuple_SET_ITEM(args, 1, py_strlist(nullptr, 0));
+  PyTuple_SET_ITEM(args, 2, py_strlist(nullptr, 0));
+  return call_to_handle("cached_op_create", args, out);
+}
+
+MXTPU_API int MXCreateCachedOpEx(SymbolHandle sym, int num_flags,
+                                 const char** keys, const char** vals,
+                                 CachedOpHandle* out) {
+  GILGuard gil;
+  PyObject* args = PyTuple_New(3);
+  PyTuple_SET_ITEM(args, 0, handle_obj(sym));
+  PyTuple_SET_ITEM(args, 1, py_strlist(keys, num_flags));
+  PyTuple_SET_ITEM(args, 2, py_strlist(vals, num_flags));
+  return call_to_handle("cached_op_create", args, out);
+}
+
+MXTPU_API int MXInvokeCachedOp(CachedOpHandle handle, int num_inputs,
+                               NDArrayHandle* inputs, int* num_outputs,
+                               NDArrayHandle** outputs) {
+  GILGuard gil;
+  PyObject* args = PyTuple_New(2);
+  PyTuple_SET_ITEM(args, 0, handle_obj(handle));
+  PyTuple_SET_ITEM(args, 1, py_handlelist(inputs, num_inputs));
+  PyObject* r = impl_call("cached_op_invoke", args);
+  if (!r) return -1;
+  int rc = store_handlelist(&tls_handles, r, num_outputs, outputs);
+  Py_DECREF(r);
+  return rc;
+}
+
+MXTPU_API int MXInvokeCachedOpEx(CachedOpHandle handle, int num_inputs,
+                                 NDArrayHandle* inputs, int* num_outputs,
+                                 NDArrayHandle** outputs,
+                                 const int** out_stypes) {
+  static thread_local std::vector<int> stypes;
+  int rc = MXInvokeCachedOp(handle, num_inputs, inputs, num_outputs,
+                            outputs);
+  if (rc == 0 && out_stypes) {
+    stypes.assign(*num_outputs, 0);  // dense
+    *out_stypes = stypes.data();
+  }
+  return rc;
+}
+
+MXTPU_API int MXFreeCachedOp(CachedOpHandle handle) {
+  GILGuard gil;
+  Py_XDECREF(static_cast<PyObject*>(handle));
+  return 0;
+}
+
+// ---------------------------------------------------------- autograd
+
+MXTPU_API int MXAutogradBackwardEx(int num_output,
+                                   NDArrayHandle* output_handles,
+                                   NDArrayHandle* ograd_handles,
+                                   int num_variables,
+                                   NDArrayHandle* var_handles,
+                                   int retain_graph, int create_graph,
+                                   int is_train, NDArrayHandle** grad_handles,
+                                   int** grad_stypes) {
+  GILGuard gil;
+  static thread_local std::vector<int> stypes;
+  PyObject* args = PyTuple_New(6);
+  PyTuple_SET_ITEM(args, 0, py_handlelist(output_handles, num_output));
+  if (ograd_handles) {
+    PyTuple_SET_ITEM(args, 1, py_handlelist(ograd_handles, num_output));
+  } else {
+    Py_INCREF(Py_None);
+    PyTuple_SET_ITEM(args, 1, Py_None);
+  }
+  PyTuple_SET_ITEM(args, 2, py_handlelist(var_handles, num_variables));
+  PyTuple_SET_ITEM(args, 3, PyLong_FromLong(retain_graph));
+  PyTuple_SET_ITEM(args, 4, PyLong_FromLong(create_graph));
+  PyTuple_SET_ITEM(args, 5, PyLong_FromLong(is_train));
+  PyObject* r = impl_call("autograd_backward_ex", args);
+  if (!r) return -1;
+  int n = 0;
+  int rc = store_handlelist(&tls_handles, r, &n, grad_handles);
+  if (rc == 0 && grad_stypes) {
+    stypes.assign(n, 0);
+    *grad_stypes = stypes.data();
+  }
+  Py_DECREF(r);
+  return rc;
+}
+
+// ----------------------------------------------------------- kvstore
+
+MXTPU_API int MXKVStoreIsWorkerNode(int* out) {
+  *out = 1;  // every process is a worker on a TPU mesh (SURVEY §3.5)
+  return 0;
+}
+
+MXTPU_API int MXKVStoreIsServerNode(int* out) {
+  *out = 0;
+  return 0;
+}
+
+MXTPU_API int MXKVStoreIsSchedulerNode(int* out) {
+  *out = 0;
+  return 0;
+}
+
+MXTPU_API int MXKVStoreSetBarrierBeforeExit(KVStoreHandle kv,
+                                            int do_barrier) {
+  (void)kv; (void)do_barrier;  // exit barrier rides jax.distributed
+  return 0;
+}
+
+MXTPU_API int MXKVStoreRunServer(KVStoreHandle kv, void* controller,
+                                 void* cb_data) {
+  (void)kv; (void)controller; (void)cb_data;
+  set_error("no server role on a TPU mesh: dist_tpu_sync reduces over "
+            "ICI collectives (SURVEY §3.5); workers call train directly");
+  return -1;
+}
+
+MXTPU_API int MXKVStoreSendCommmandToServers(KVStoreHandle kv, int head,
+                                             const char* body) {
+  (void)kv; (void)head; (void)body;  // no servers to command
+  return 0;
+}
+
+MXTPU_API int MXKVStoreSetUpdater(KVStoreHandle kv, MXKVStoreUpdater cb,
+                                  void* cb_data) {
+  GILGuard gil;
+  return call_void(
+      "kvstore_set_updater",
+      Py_BuildValue("(OKK)", static_cast<PyObject*>(kv),
+                    (unsigned long long)(uintptr_t)cb,
+                    (unsigned long long)(uintptr_t)cb_data));
+}
+
+MXTPU_API int MXKVStoreSetUpdaterEx(KVStoreHandle kv, MXKVStoreUpdater cb,
+                                    MXKVStoreStrUpdater str_cb,
+                                    void* cb_data) {
+  (void)str_cb;  // string-keyed callbacks route through the int path
+  return MXKVStoreSetUpdater(kv, cb, cb_data);
+}
+
+MXTPU_API int MXKVStorePushPull(KVStoreHandle kv, int num,
+                                const char** keys, NDArrayHandle* ins,
+                                NDArrayHandle* outs, int priority) {
+  GILGuard gil;
+  PyObject* args = PyTuple_New(5);
+  PyTuple_SET_ITEM(args, 0, handle_obj(kv));
+  PyTuple_SET_ITEM(args, 1, py_strlist(keys, num));
+  PyTuple_SET_ITEM(args, 2, py_handlelist(ins, num));
+  PyTuple_SET_ITEM(args, 3, py_handlelist(outs, num));
+  PyTuple_SET_ITEM(args, 4, PyLong_FromLong(priority));
+  return call_void("kvstore_pushpull", args);
+}
+
+MXTPU_API int MXKVStorePushPullEx(KVStoreHandle kv, int num,
+                                  const char** keys, NDArrayHandle* ins,
+                                  NDArrayHandle* outs, int priority) {
+  return MXKVStorePushPull(kv, num, keys, ins, outs, priority);
+}
+
+MXTPU_API int MXKVStorePullRowSparse(KVStoreHandle kv, int num,
+                                     const char** keys,
+                                     NDArrayHandle* outs,
+                                     NDArrayHandle* row_ids,
+                                     int priority) {
+  GILGuard gil;
+  PyObject* args = PyTuple_New(5);
+  PyTuple_SET_ITEM(args, 0, handle_obj(kv));
+  PyTuple_SET_ITEM(args, 1, py_strlist(keys, num));
+  PyTuple_SET_ITEM(args, 2, py_handlelist(outs, num));
+  PyTuple_SET_ITEM(args, 3, py_handlelist(row_ids, num));
+  PyTuple_SET_ITEM(args, 4, PyLong_FromLong(priority));
+  return call_void("kvstore_pull_row_sparse", args);
+}
+
+MXTPU_API int MXKVStorePullRowSparseEx(KVStoreHandle kv, int num,
+                                       const char** keys,
+                                       NDArrayHandle* outs,
+                                       NDArrayHandle* row_ids,
+                                       int priority) {
+  return MXKVStorePullRowSparse(kv, num, keys, outs, row_ids, priority);
+}
+
+// string-keyed "Ex" aliases: this ABI's canonical keys are ALREADY
+// strings (header preamble)
+MXTPU_API int MXKVStoreInitEx(KVStoreHandle kv, int num, const char** keys,
+                              NDArrayHandle* vals) {
+  return MXKVStoreInit(kv, num, keys, vals);
+}
+
+MXTPU_API int MXKVStorePushEx(KVStoreHandle kv, int num, const char** keys,
+                              NDArrayHandle* vals, int priority) {
+  return MXKVStorePush(kv, num, keys, vals, priority);
+}
+
+MXTPU_API int MXKVStorePullEx(KVStoreHandle kv, int num, const char** keys,
+                              NDArrayHandle* outs, int priority) {
+  return MXKVStorePull(kv, num, keys, outs, priority);
+}
+
+// ----------------------------------------------------------- ndarray
+
+MXTPU_API int MXNDArrayCreateNone(NDArrayHandle* out) {
+  GILGuard gil;
+  return call_to_handle("ndarray_create_none", PyTuple_New(0), out);
+}
+
+MXTPU_API int MXNDArrayWaitToWrite(NDArrayHandle handle) {
+  GILGuard gil;
+  return call_void("ndarray_wait_to_write",
+                   PyTuple_Pack(1, static_cast<PyObject*>(handle)));
+}
+
+MXTPU_API int MXNDArraySaveRawBytes(NDArrayHandle handle, size_t* out_size,
+                                    const char** out_buf) {
+  GILGuard gil;
+  static thread_local std::string buf;
+  PyObject* r = impl_call("ndarray_save_raw_bytes",
+                          PyTuple_Pack(1, static_cast<PyObject*>(handle)));
+  if (!r) return -1;
+  char* data = nullptr;
+  Py_ssize_t n = 0;
+  if (PyBytes_AsStringAndSize(r, &data, &n) != 0) {
+    Py_DECREF(r);
+    set_error(py_error_string());
+    return -1;
+  }
+  buf.assign(data, n);
+  Py_DECREF(r);
+  *out_size = buf.size();
+  *out_buf = buf.data();
+  return 0;
+}
+
+MXTPU_API int MXNDArrayLoadFromRawBytes(const void* buf, size_t size,
+                                        NDArrayHandle* out) {
+  GILGuard gil;
+  PyObject* args = PyTuple_New(1);
+  PyTuple_SET_ITEM(args, 0, PyBytes_FromStringAndSize(
+      static_cast<const char*>(buf), size));
+  return call_to_handle("ndarray_load_from_raw_bytes", args, out);
+}
+
+MXTPU_API int MXNDArrayLoadFromBuffer(const void* buf, size_t size,
+                                      int* out_size, NDArrayHandle** out,
+                                      int* out_name_size,
+                                      const char*** out_names) {
+  GILGuard gil;
+  PyObject* args = PyTuple_New(1);
+  PyTuple_SET_ITEM(args, 0, PyBytes_FromStringAndSize(
+      static_cast<const char*>(buf), size));
+  PyObject* r = impl_call("ndarray_load_from_buffer", args);
+  if (!r) return -1;
+  int rc = store_strlist(&tls_names, PyTuple_GetItem(r, 0),
+                         out_name_size, out_names);
+  if (rc == 0) {
+    rc = store_handlelist(&tls_handles, PyTuple_GetItem(r, 1), out_size,
+                          out);
+  }
+  Py_DECREF(r);
+  return rc;
+}
+
+MXTPU_API int MXNDArraySyncCopyFromNDArray(NDArrayHandle dst,
+                                           NDArrayHandle src, int i) {
+  GILGuard gil;
+  (void)i;
+  return call_void("ndarray_sync_copy_from",
+                   PyTuple_Pack(2, static_cast<PyObject*>(dst),
+                                static_cast<PyObject*>(src)));
+}
+
+MXTPU_API int MXNDArrayGetGradState(NDArrayHandle handle, int* out) {
+  GILGuard gil;
+  return call_to_int("ndarray_grad_state",
+                     PyTuple_Pack(1, static_cast<PyObject*>(handle)), out);
+}
+
+MXTPU_API int MXNDArraySetGradState(NDArrayHandle handle, int state) {
+  GILGuard gil;
+  return call_void(
+      "ndarray_set_grad_state",
+      Py_BuildValue("(Oi)", static_cast<PyObject*>(handle), state));
+}
+
+MXTPU_API int MXShallowCopyNDArray(NDArrayHandle src, NDArrayHandle* out) {
+  GILGuard gil;
+  return call_to_handle("shallow_copy_ndarray",
+                        PyTuple_Pack(1, static_cast<PyObject*>(src)), out);
+}
+
+MXTPU_API int MXShallowCopySymbol(SymbolHandle src, SymbolHandle* out) {
+  GILGuard gil;
+  PyObject* o = static_cast<PyObject*>(src);
+  Py_INCREF(o);  // symbols are immutable graphs: share the object
+  *out = o;
+  return 0;
+}
+
+// int64/Ex aliases over the canonical (already-64-bit) entries
+MXTPU_API int MXNDArrayGetShapeEx(NDArrayHandle handle, int* out_ndim,
+                                  int64_t* out_shape, int max_ndim) {
+  return MXNDArrayGetShape(handle, out_ndim, out_shape, max_ndim);
+}
+
+MXTPU_API int MXNDArrayGetShape64(NDArrayHandle handle, int* out_ndim,
+                                  int64_t* out_shape, int max_ndim) {
+  return MXNDArrayGetShape(handle, out_ndim, out_shape, max_ndim);
+}
+
+MXTPU_API int MXNDArrayGetShapeEx64(NDArrayHandle handle, int* out_ndim,
+                                    int64_t* out_shape, int max_ndim) {
+  return MXNDArrayGetShape(handle, out_ndim, out_shape, max_ndim);
+}
+
+MXTPU_API int MXNDArrayReshape64(NDArrayHandle handle, int ndim,
+                                 const int64_t* dims, int reverse,
+                                 NDArrayHandle* out) {
+  (void)reverse;
+  return MXNDArrayReshape(handle, ndim, dims, out);
+}
+
+MXTPU_API int MXNDArraySlice64(NDArrayHandle handle, int64_t begin,
+                               int64_t end, NDArrayHandle* out) {
+  return MXNDArraySlice(handle, begin, end, out);
+}
+
+MXTPU_API int MXNDArrayAt64(NDArrayHandle handle, int64_t idx,
+                            NDArrayHandle* out) {
+  return MXNDArrayAt(handle, idx, out);
+}
+
+MXTPU_API int MXNDArrayCreateEx64(const int64_t* shape, int ndim,
+                                  const char* dtype, const char* ctx,
+                                  int delay_alloc, NDArrayHandle* out) {
+  (void)delay_alloc;  // XLA allocates lazily regardless
+  return MXNDArrayCreateEx(shape, ndim, dtype, ctx, out);
+}
+
+MXTPU_API int MXImperativeInvokeEx(const char* op_name,
+                                   NDArrayHandle* inputs, int num_inputs,
+                                   const char* kwargs_json,
+                                   NDArrayHandle* out_array,
+                                   int* num_outputs,
+                                   const int** out_stypes) {
+  static thread_local std::vector<int> stypes;
+  int rc = MXImperativeInvoke(op_name, inputs, num_inputs, kwargs_json,
+                              out_array, num_outputs);
+  if (rc == 0 && out_stypes) {
+    stypes.assign(*num_outputs, 0);  // dense
+    *out_stypes = stypes.data();
+  }
+  return rc;
+}
+
+// ------------------------------------------------------ misc / profiler
+
+MXTPU_API int MXStorageEmptyCache(const char* ctx) {
+  GILGuard gil;
+  return call_void("storage_empty_cache",
+                   Py_BuildValue("(s)", ctx ? ctx : ""));
+}
+
+MXTPU_API int MXEngineSetBulkSize(int bulk_size, int* prev_bulk_size) {
+  GILGuard gil;
+  return call_to_int("engine_set_bulk_size",
+                     Py_BuildValue("(i)", bulk_size), prev_bulk_size);
+}
+
+MXTPU_API int MXRandomSeedContext(int seed, const char* ctx) {
+  GILGuard gil;
+  return call_void("random_seed_context",
+                   Py_BuildValue("(is)", seed, ctx ? ctx : ""));
+}
+
+MXTPU_API int MXLoadLib(const char* path, unsigned verbose) {
+  GILGuard gil;
+  (void)verbose;
+  return call_void("load_lib", Py_BuildValue("(s)", path));
+}
+
+MXTPU_API int MXProfilePause(int paused) {
+  GILGuard gil;
+  return call_void("profiler_pause", Py_BuildValue("(i)", paused));
+}
+
+MXTPU_API int MXProcessProfilePause(int paused, int profile_process) {
+  (void)profile_process;
+  return MXProfilePause(paused);
+}
+
+MXTPU_API int MXSetProcessProfilerState(int state, int profile_process) {
+  GILGuard gil;
+  (void)profile_process;
+  return call_void("profiler_set_state",
+                   Py_BuildValue("(s)", state ? "run" : "stop"));
+}
+
+MXTPU_API int MXSetProcessProfilerConfig(int num_params, const char** keys,
+                                         const char** vals,
+                                         KVStoreHandle kv) {
+  (void)kv;
+  return MXSetProfilerConfig(num_params, keys, vals);
+}
+
+MXTPU_API int MXDumpProcessProfile(int finished, int profile_process,
+                                   KVStoreHandle kv) {
+  (void)profile_process; (void)kv;
+  return MXDumpProfile(finished);
+}
+
+MXTPU_API int MXAggregateProfileStatsPrint(const char** out_str, int reset) {
+  GILGuard gil;
+  PyObject* r = impl_call("profiler_aggregate_stats",
+                          Py_BuildValue("(isss)", reset, "table", "total",
+                                        ""));
+  if (!r) return -1;
+  int rc = ret_string(r, out_str);
+  Py_DECREF(r);
+  return rc;
+}
+
+MXTPU_API int MXAggregateProfileStatsPrintEx(const char** out_str,
+                                             int reset, int format,
+                                             int sort_by, int ascending) {
+  (void)format; (void)sort_by; (void)ascending;
+  return MXAggregateProfileStatsPrint(out_str, reset);
+}
+
+// ------------------------------------------------- subgraph / data iter
+
+MXTPU_API int MXGenBackendSubgraph(SymbolHandle sym, const char* backend,
+                                   SymbolHandle* out) {
+  GILGuard gil;
+  return call_to_handle(
+      "gen_backend_subgraph",
+      Py_BuildValue("(Os)", static_cast<PyObject*>(sym), backend), out);
+}
+
+MXTPU_API int MXOptimizeForBackend(SymbolHandle sym, const char* backend,
+                                   SymbolHandle* out) {
+  return MXGenBackendSubgraph(sym, backend, out);
+}
+
+MXTPU_API int MXDataIterGetIterInfo(const char* iter_name,
+                                    const char** name,
+                                    const char** description,
+                                    int* num_args,
+                                    const char*** arg_names,
+                                    const char*** arg_type_infos,
+                                    const char*** arg_descriptions) {
+  GILGuard gil;
+  static thread_local std::string s_name, s_desc;
+  PyObject* r = impl_call("dataiter_info",
+                          Py_BuildValue("(s)", iter_name));
+  if (!r) return -1;
+  const char* c = PyUnicode_AsUTF8(PyTuple_GetItem(r, 0));
+  s_name = c ? c : "";
+  c = PyUnicode_AsUTF8(PyTuple_GetItem(r, 1));
+  s_desc = c ? c : "";
+  if (name) *name = s_name.c_str();
+  if (description) *description = s_desc.c_str();
+  int n1 = 0;
+  int rc = store_strlist(&tls_names, PyTuple_GetItem(r, 2), &n1,
+                         arg_names);
+  if (rc == 0) {
+    int n2 = 0;
+    rc = store_strlist(&tls_names2, PyTuple_GetItem(r, 3), &n2,
+                       arg_type_infos);
+  }
+  if (rc == 0) {
+    int n3 = 0;
+    rc = store_strlist(&tls_names3, PyTuple_GetItem(r, 4), &n3,
+                       arg_descriptions);
+  }
+  if (num_args) *num_args = n1;
+  Py_DECREF(r);
+  return rc;
 }

@@ -30,6 +30,7 @@
 #ifndef MXTPU_C_H_
 #define MXTPU_C_H_
 
+#include <stddef.h>
 #include <stdint.h>
 
 #ifdef __cplusplus
@@ -43,6 +44,14 @@ typedef void* KVStoreHandle;
 typedef void* DataIterHandle;
 typedef void* RecordIOHandle;
 typedef void* PredictorHandle;
+typedef void* AtomicSymbolCreator;
+typedef void* CachedOpHandle;
+/* monitor callback: (output name, array, closure) */
+typedef void (*ExecutorMonitorCallback)(const char*, NDArrayHandle, void*);
+/* store-side updater: (key, aggregated recv, stored local, closure) */
+typedef void (*MXKVStoreUpdater)(int, NDArrayHandle, NDArrayHandle, void*);
+typedef void (*MXKVStoreStrUpdater)(const char*, NDArrayHandle,
+                                    NDArrayHandle, void*);
 
 /* ------------------------------------------------------------ lifecycle */
 
@@ -299,6 +308,194 @@ int MXSetProfilerState(const char* state);
 int MXSetProfilerConfig(int num_params, const char** keys,
                         const char** vals);
 int MXDumpProfile(int finished);
+
+/* ---------------------------------------------------------------------
+ * Round-5 surface: binding-codegen introspection (what makes new
+ * language bindings mechanical, reference c_api.h:1076-1120), cached
+ * ops, monitor/updater callbacks, Ex/64 variants (aliases: canonical
+ * entries are already 64-bit/string-keyed, see preamble), profiler
+ * tail. */
+
+int MXSymbolListAtomicSymbolCreators(int* out_size,
+                                     AtomicSymbolCreator** out_array);
+int MXSymbolGetAtomicSymbolName(AtomicSymbolCreator creator,
+                                const char** name);
+int MXSymbolGetAtomicSymbolInfo(
+    AtomicSymbolCreator creator, const char** name,
+    const char** description, int* num_args, const char*** arg_names,
+    const char*** arg_type_infos, const char*** arg_descriptions,
+    const char** key_var_num_args, const char** return_type);
+
+int MXSymbolInferType(SymbolHandle sym, int num_args, const char** keys,
+                      const char** types, int partial, int* in_size,
+                      const char*** in_types, int* out_size,
+                      const char*** out_types, int* aux_size,
+                      const char*** aux_types, int* complete);
+int MXSymbolInferTypePartial(SymbolHandle sym, int num_args,
+                             const char** keys, const char** types,
+                             int* in_size, const char*** in_types,
+                             int* out_size, const char*** out_types,
+                             int* aux_size, const char*** aux_types,
+                             int* complete);
+int MXSymbolGetChildren(SymbolHandle sym, SymbolHandle* out);
+int MXSymbolRemoveAmpCast(SymbolHandle sym, SymbolHandle* out);
+int MXSymbolInferShapeEx(SymbolHandle sym, int num_args, const char** keys,
+                         const int* ndims, const int64_t* shape_data,
+                         int partial, int* in_size, const int** in_ndims,
+                         const int64_t** in_data, int* out_size,
+                         const int** out_ndims, const int64_t** out_data,
+                         int* aux_size, const int** aux_ndims,
+                         const int64_t** aux_data, int* complete);
+int MXSymbolInferShape64(SymbolHandle sym, int num_args, const char** keys,
+                         const int* ndims, const int64_t* shape_data,
+                         int partial, int* in_size, const int** in_ndims,
+                         const int64_t** in_data, int* out_size,
+                         const int** out_ndims, const int64_t** out_data,
+                         int* aux_size, const int** aux_ndims,
+                         const int64_t** aux_data, int* complete);
+int MXSymbolInferShapePartial(
+    SymbolHandle sym, int num_args, const char** keys, const int* ndims,
+    const int64_t* shape_data, int* in_size, const int** in_ndims,
+    const int64_t** in_data, int* out_size, const int** out_ndims,
+    const int64_t** out_data, int* aux_size, const int** aux_ndims,
+    const int64_t** aux_data, int* complete);
+int MXSymbolInferShapePartial64(
+    SymbolHandle sym, int num_args, const char** keys, const int* ndims,
+    const int64_t* shape_data, int* in_size, const int** in_ndims,
+    const int64_t** in_data, int* out_size, const int** out_ndims,
+    const int64_t** out_data, int* aux_size, const int** aux_ndims,
+    const int64_t** aux_data, int* complete);
+
+int MXExecutorSetMonitorCallback(ExecutorHandle exec,
+                                 ExecutorMonitorCallback cb, void* cb_data);
+int MXExecutorSetMonitorCallbackEX(ExecutorHandle exec,
+                                   ExecutorMonitorCallback cb,
+                                   void* cb_data, int monitor_all);
+int MXExecutorReshape(int partial_shaping, int allow_up_sizing,
+                      const char* ctx, int num_provided, const char** keys,
+                      const int* ndims, const int64_t* shape_data,
+                      ExecutorHandle shared_exec, ExecutorHandle* out);
+int MXExecutorReshapeEx(int partial_shaping, int allow_up_sizing,
+                        const char* ctx, int num_provided,
+                        const char** keys, const int* ndims,
+                        const int64_t* shape_data,
+                        ExecutorHandle shared_exec, ExecutorHandle* out);
+int MXExecutorGetOptimizedSymbol(ExecutorHandle exec, SymbolHandle* out);
+int MXExecutorSimpleBindEx(SymbolHandle sym, const char* ctx,
+                           const char* grad_req, int num_provided,
+                           const char** keys, const int* ndims,
+                           const int64_t* shape_data, ExecutorHandle* out);
+int MXExecutorSimpleBindEx64(SymbolHandle sym, const char* ctx,
+                             const char* grad_req, int num_provided,
+                             const char** keys, const int* ndims,
+                             const int64_t* shape_data,
+                             ExecutorHandle* out);
+
+/* cached op: inputs ordered as list_arguments() + list_auxiliary_states() */
+int MXCreateCachedOp(SymbolHandle sym, CachedOpHandle* out);
+int MXCreateCachedOpEx(SymbolHandle sym, int num_flags, const char** keys,
+                       const char** vals, CachedOpHandle* out);
+int MXInvokeCachedOp(CachedOpHandle handle, int num_inputs,
+                     NDArrayHandle* inputs, int* num_outputs,
+                     NDArrayHandle** outputs);
+int MXInvokeCachedOpEx(CachedOpHandle handle, int num_inputs,
+                       NDArrayHandle* inputs, int* num_outputs,
+                       NDArrayHandle** outputs, const int** out_stypes);
+int MXFreeCachedOp(CachedOpHandle handle);
+
+int MXAutogradBackwardEx(int num_output, NDArrayHandle* output_handles,
+                         NDArrayHandle* ograd_handles, int num_variables,
+                         NDArrayHandle* var_handles, int retain_graph,
+                         int create_graph, int is_train,
+                         NDArrayHandle** grad_handles, int** grad_stypes);
+
+int MXKVStoreIsWorkerNode(int* out);
+int MXKVStoreIsServerNode(int* out);
+int MXKVStoreIsSchedulerNode(int* out);
+int MXKVStoreSetBarrierBeforeExit(KVStoreHandle kv, int do_barrier);
+int MXKVStoreRunServer(KVStoreHandle kv, void* controller, void* cb_data);
+int MXKVStoreSendCommmandToServers(KVStoreHandle kv, int head,
+                                   const char* body);
+int MXKVStoreSetUpdater(KVStoreHandle kv, MXKVStoreUpdater cb,
+                        void* cb_data);
+int MXKVStoreSetUpdaterEx(KVStoreHandle kv, MXKVStoreUpdater cb,
+                          MXKVStoreStrUpdater str_cb, void* cb_data);
+int MXKVStorePushPull(KVStoreHandle kv, int num, const char** keys,
+                      NDArrayHandle* ins, NDArrayHandle* outs,
+                      int priority);
+int MXKVStorePushPullEx(KVStoreHandle kv, int num, const char** keys,
+                        NDArrayHandle* ins, NDArrayHandle* outs,
+                        int priority);
+int MXKVStorePullRowSparse(KVStoreHandle kv, int num, const char** keys,
+                           NDArrayHandle* outs, NDArrayHandle* row_ids,
+                           int priority);
+int MXKVStorePullRowSparseEx(KVStoreHandle kv, int num, const char** keys,
+                             NDArrayHandle* outs, NDArrayHandle* row_ids,
+                             int priority);
+int MXKVStoreInitEx(KVStoreHandle kv, int num, const char** keys,
+                    NDArrayHandle* vals);
+int MXKVStorePushEx(KVStoreHandle kv, int num, const char** keys,
+                    NDArrayHandle* vals, int priority);
+int MXKVStorePullEx(KVStoreHandle kv, int num, const char** keys,
+                    NDArrayHandle* outs, int priority);
+
+int MXNDArrayCreateNone(NDArrayHandle* out);
+int MXNDArrayWaitToWrite(NDArrayHandle handle);
+int MXNDArraySaveRawBytes(NDArrayHandle handle, size_t* out_size,
+                          const char** out_buf);
+int MXNDArrayLoadFromRawBytes(const void* buf, size_t size,
+                              NDArrayHandle* out);
+int MXNDArrayLoadFromBuffer(const void* buf, size_t size, int* out_size,
+                            NDArrayHandle** out, int* out_name_size,
+                            const char*** out_names);
+int MXNDArraySyncCopyFromNDArray(NDArrayHandle dst, NDArrayHandle src,
+                                 int i);
+int MXNDArrayGetGradState(NDArrayHandle handle, int* out);
+int MXNDArraySetGradState(NDArrayHandle handle, int state);
+int MXShallowCopyNDArray(NDArrayHandle src, NDArrayHandle* out);
+int MXShallowCopySymbol(SymbolHandle src, SymbolHandle* out);
+int MXNDArrayGetShapeEx(NDArrayHandle handle, int* out_ndim,
+                        int64_t* out_shape, int max_ndim);
+int MXNDArrayGetShape64(NDArrayHandle handle, int* out_ndim,
+                        int64_t* out_shape, int max_ndim);
+int MXNDArrayGetShapeEx64(NDArrayHandle handle, int* out_ndim,
+                          int64_t* out_shape, int max_ndim);
+int MXNDArrayReshape64(NDArrayHandle handle, int ndim, const int64_t* dims,
+                       int reverse, NDArrayHandle* out);
+int MXNDArraySlice64(NDArrayHandle handle, int64_t begin, int64_t end,
+                     NDArrayHandle* out);
+int MXNDArrayAt64(NDArrayHandle handle, int64_t idx, NDArrayHandle* out);
+int MXNDArrayCreateEx64(const int64_t* shape, int ndim, const char* dtype,
+                        const char* ctx, int delay_alloc,
+                        NDArrayHandle* out);
+int MXImperativeInvokeEx(const char* op_name, NDArrayHandle* inputs,
+                         int num_inputs, const char* kwargs_json,
+                         NDArrayHandle* out_array, int* num_outputs,
+                         const int** out_stypes);
+
+int MXStorageEmptyCache(const char* ctx);
+int MXEngineSetBulkSize(int bulk_size, int* prev_bulk_size);
+int MXRandomSeedContext(int seed, const char* ctx);
+int MXLoadLib(const char* path, unsigned verbose);
+int MXProfilePause(int paused);
+int MXProcessProfilePause(int paused, int profile_process);
+int MXSetProcessProfilerState(int state, int profile_process);
+int MXSetProcessProfilerConfig(int num_params, const char** keys,
+                               const char** vals, KVStoreHandle kv);
+int MXDumpProcessProfile(int finished, int profile_process,
+                         KVStoreHandle kv);
+int MXAggregateProfileStatsPrint(const char** out_str, int reset);
+int MXAggregateProfileStatsPrintEx(const char** out_str, int reset,
+                                   int format, int sort_by, int ascending);
+int MXGenBackendSubgraph(SymbolHandle sym, const char* backend,
+                         SymbolHandle* out);
+int MXOptimizeForBackend(SymbolHandle sym, const char* backend,
+                         SymbolHandle* out);
+int MXDataIterGetIterInfo(const char* iter_name, const char** name,
+                          const char** description, int* num_args,
+                          const char*** arg_names,
+                          const char*** arg_type_infos,
+                          const char*** arg_descriptions);
 
 #ifdef __cplusplus
 }

@@ -1,0 +1,269 @@
+"""Round-5 C ABI surface tests (ctypes in-process): binding-codegen
+introspection, cached ops, monitor/updater callbacks, kvstore pushpull,
+Ex/64 aliases, profiler tail. Reference names: c_api.h:1076-1120, :2205,
+:1280."""
+import ctypes
+import json
+import pathlib
+import subprocess
+
+import numpy as onp
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+LIB = REPO / "lib" / "libmxtpu_c.so"
+
+
+def _built():
+    if LIB.exists():
+        return True
+    r = subprocess.run(["make", "-C", str(REPO / "src")],
+                       capture_output=True, text=True)
+    return r.returncode == 0 and LIB.exists()
+
+
+pytestmark = pytest.mark.skipif(not _built(),
+                                reason="libmxtpu_c.so not built")
+
+c = ctypes
+
+
+@pytest.fixture(scope="module")
+def lib():
+    L = ctypes.CDLL(str(LIB))
+    L.MXGetLastError.restype = c.c_char_p
+    assert L.MXTpuInit(None) == 0, L.MXGetLastError()
+    return L
+
+
+def _arr(lib, np_arr):
+    np_arr = onp.ascontiguousarray(np_arr, onp.float32)
+    shape = (c.c_int64 * np_arr.ndim)(*np_arr.shape)
+    h = c.c_void_p()
+    assert lib.MXNDArrayCreate(shape, np_arr.ndim, b"float32",
+                               c.byref(h)) == 0
+    assert lib.MXNDArraySyncCopyFromCPU(
+        h, np_arr.ctypes.data_as(c.POINTER(c.c_float)),
+        c.c_int64(np_arr.size)) == 0
+    return h
+
+
+def _to_np(lib, h, shape):
+    out = onp.zeros(shape, onp.float32)
+    assert lib.MXNDArraySyncCopyToCPU(
+        h, out.ctypes.data_as(c.POINTER(c.c_float)),
+        c.c_int64(out.size)) == 0
+    return out
+
+
+def test_atomic_symbol_introspection(lib):
+    n = c.c_int()
+    creators = c.POINTER(c.c_void_p)()
+    assert lib.MXSymbolListAtomicSymbolCreators(c.byref(n),
+                                                c.byref(creators)) == 0
+    assert n.value > 400, n.value
+    # find Convolution and introspect it
+    name = c.c_char_p()
+    found = None
+    for i in range(n.value):
+        # creators[i] is a python int: re-wrap as c_void_p or ctypes
+        # passes a truncated 32-bit int
+        assert lib.MXSymbolGetAtomicSymbolName(c.c_void_p(creators[i]),
+                                               c.byref(name)) == 0
+        if name.value == b"Convolution":
+            found = c.c_void_p(creators[i])
+            break
+    assert found is not None
+    desc = c.c_char_p()
+    num_args = c.c_int()
+    arg_names = c.POINTER(c.c_char_p)()
+    arg_types = c.POINTER(c.c_char_p)()
+    arg_descs = c.POINTER(c.c_char_p)()
+    kv = c.c_char_p()
+    ret = c.c_char_p()
+    assert lib.MXSymbolGetAtomicSymbolInfo(
+        found, c.byref(name), c.byref(desc), c.byref(num_args),
+        c.byref(arg_names), c.byref(arg_types), c.byref(arg_descs),
+        c.byref(kv), c.byref(ret)) == 0
+    names = [arg_names[i].decode() for i in range(num_args.value)]
+    types = [arg_types[i].decode() for i in range(num_args.value)]
+    assert names[0] == "data" and types[0] == "NDArray-or-Symbol"
+    assert "weight" in names
+    assert b"conv" in desc.value.lower() or desc.value != b""
+
+
+def test_cached_op_invoke(lib):
+    # symbol: y = relu(data) * 2
+    data = c.c_void_p()
+    assert lib.MXSymbolCreateVariable(b"data", c.byref(data)) == 0
+    relu = c.c_void_p()
+    assert lib.MXSymbolCreateAtomicSymbol(b"relu", 0, None, None,
+                                          c.byref(relu)) == 0
+    ins = (c.c_void_p * 1)(data)
+    keys = (c.c_char_p * 1)(None)
+    assert lib.MXSymbolCompose(relu, b"r", 1, keys, ins) == 0
+    op = c.c_void_p()
+    assert lib.MXCreateCachedOp(relu, c.byref(op)) == 0, \
+        lib.MXGetLastError()
+    x = onp.array([[-1.0, 2.0], [3.0, -4.0]], onp.float32)
+    hx = _arr(lib, x)
+    inputs = (c.c_void_p * 1)(hx)
+    n_out = c.c_int()
+    outs = c.POINTER(c.c_void_p)()
+    assert lib.MXInvokeCachedOp(op, 1, inputs, c.byref(n_out),
+                                c.byref(outs)) == 0, lib.MXGetLastError()
+    assert n_out.value == 1
+    got = _to_np(lib, c.c_void_p(outs[0]), x.shape)
+    onp.testing.assert_allclose(got, onp.maximum(x, 0))
+    assert lib.MXFreeCachedOp(op) == 0
+
+
+def test_executor_monitor_callback(lib):
+    # net: relu(fc(data)); monitor must fire with intermediate outputs
+    data = c.c_void_p()
+    assert lib.MXSymbolCreateVariable(b"data", c.byref(data)) == 0
+    fc = c.c_void_p()
+    k = (c.c_char_p * 1)(b"num_hidden")
+    v = (c.c_char_p * 1)(b"3")
+    assert lib.MXSymbolCreateAtomicSymbol(b"FullyConnected", 1, k, v,
+                                          c.byref(fc)) == 0
+    ins = (c.c_void_p * 1)(data)
+    nk = (c.c_char_p * 1)(None)
+    assert lib.MXSymbolCompose(fc, b"fc", 1, nk, ins) == 0
+    relu = c.c_void_p()
+    assert lib.MXSymbolCreateAtomicSymbol(b"relu", 0, None, None,
+                                          c.byref(relu)) == 0
+    ins2 = (c.c_void_p * 1)(fc)
+    assert lib.MXSymbolCompose(relu, b"r", 1, nk, ins2) == 0
+
+    ex = c.c_void_p()
+    keys = (c.c_char_p * 1)(b"data")
+    ndims = (c.c_int * 1)(2)
+    shape = (c.c_int64 * 2)(2, 4)
+    assert lib.MXExecutorSimpleBindEx(relu, b"cpu", b"null", 1, keys,
+                                      ndims, shape, c.byref(ex)) == 0, \
+        lib.MXGetLastError()
+
+    seen = []
+    CB = c.CFUNCTYPE(None, c.c_char_p, c.c_void_p, c.c_void_p)
+
+    def cb(name, arr_handle, _data):
+        seen.append(name.decode())
+
+    cb_keep = CB(cb)
+    assert lib.MXExecutorSetMonitorCallbackEX(ex, cb_keep, None, 1) == 0, \
+        lib.MXGetLastError()
+    assert lib.MXExecutorForward(ex, 0) == 0, lib.MXGetLastError()
+    assert seen, "monitor callback never fired"
+
+
+def test_kvstore_pushpull_and_roles(lib):
+    out = c.c_int()
+    assert lib.MXKVStoreIsWorkerNode(c.byref(out)) == 0 and out.value == 1
+    assert lib.MXKVStoreIsServerNode(c.byref(out)) == 0 and out.value == 0
+    kv = c.c_void_p()
+    assert lib.MXKVStoreCreate(b"local", c.byref(kv)) == 0
+    val = _arr(lib, onp.ones((2, 2), onp.float32))
+    keys = (c.c_char_p * 1)(b"w")
+    vals = (c.c_void_p * 1)(val)
+    assert lib.MXKVStoreInitEx(kv, 1, keys, vals) == 0
+    push = _arr(lib, 3 * onp.ones((2, 2), onp.float32))
+    outh = _arr(lib, onp.zeros((2, 2), onp.float32))
+    ins = (c.c_void_p * 1)(push)
+    outs = (c.c_void_p * 1)(outh)
+    assert lib.MXKVStorePushPull(kv, 1, keys, ins, outs, 0) == 0, \
+        lib.MXGetLastError()
+    got = _to_np(lib, outh, (2, 2))
+    onp.testing.assert_allclose(got, 3 * onp.ones((2, 2)))
+
+
+def test_kvstore_updater_callback(lib):
+    kv = c.c_void_p()
+    assert lib.MXKVStoreCreate(b"local", c.byref(kv)) == 0
+    calls = []
+    CB = c.CFUNCTYPE(None, c.c_int, c.c_void_p, c.c_void_p, c.c_void_p)
+
+    def updater(key, recv, local, _data):
+        calls.append(key)
+
+    keep = CB(updater)
+    assert lib.MXKVStoreSetUpdater(kv, keep, None) == 0, \
+        lib.MXGetLastError()
+    val = _arr(lib, onp.ones((2,), onp.float32))
+    keys = (c.c_char_p * 1)(b"3")
+    vals = (c.c_void_p * 1)(val)
+    assert lib.MXKVStoreInit(kv, 1, keys, vals) == 0
+    assert lib.MXKVStorePush(kv, 1, keys, vals, 0) == 0
+    assert calls, "custom updater never invoked"
+    assert calls[0] == 3
+
+
+def test_shape_and_invoke_aliases(lib):
+    x = onp.arange(6, dtype=onp.float32).reshape(2, 3)
+    h = _arr(lib, x)
+    ndim = c.c_int()
+    dims = (c.c_int64 * 8)()
+    assert lib.MXNDArrayGetShapeEx64(h, c.byref(ndim), dims, 8) == 0
+    assert list(dims[:ndim.value]) == [2, 3]
+    # imperative Ex with stypes
+    outs = (c.c_void_p * 4)()
+    n_out = c.c_int(4)
+    stypes = c.POINTER(c.c_int)()
+    ins = (c.c_void_p * 1)(h)
+    assert lib.MXImperativeInvokeEx(b"relu", ins, 1, b"{}", outs,
+                                    c.byref(n_out), c.byref(stypes)) == 0
+    assert n_out.value == 1 and stypes[0] == 0
+    # raw-bytes round trip
+    size = c.c_size_t()
+    buf = c.POINTER(c.c_char)()
+    assert lib.MXNDArraySaveRawBytes(h, c.byref(size), c.byref(buf)) == 0
+    raw = c.string_at(buf, size.value)
+    h2 = c.c_void_p()
+    assert lib.MXNDArrayLoadFromRawBytes(raw, len(raw), c.byref(h2)) == 0
+    onp.testing.assert_allclose(_to_np(lib, h2, (2, 3)), x)
+
+
+def test_autograd_backward_ex_variables(lib):
+    x = _arr(lib, onp.array([2.0, 3.0], onp.float32))
+    g = _arr(lib, onp.zeros(2, onp.float32))
+    handles = (c.c_void_p * 1)(x)
+    grads = (c.c_void_p * 1)(g)
+    reqs = (c.c_int * 1)(1)
+    assert lib.MXAutogradMarkVariables(1, handles, reqs, grads) == 0
+    prev = c.c_int()
+    assert lib.MXAutogradSetIsRecording(1, c.byref(prev)) == 0
+    outs = (c.c_void_p * 4)()
+    n_out = c.c_int(4)
+    assert lib.MXImperativeInvoke(b"square", handles, 1, b"{}", outs,
+                                  c.byref(n_out)) == 0
+    assert lib.MXAutogradSetIsRecording(0, c.byref(prev)) == 0
+    y = (c.c_void_p * 1)(outs[0])
+    var_grads = c.POINTER(c.c_void_p)()
+    stypes = c.POINTER(c.c_int)()
+    assert lib.MXAutogradBackwardEx(1, y, None, 1, handles, 0, 0, 1,
+                                    c.byref(var_grads),
+                                    c.byref(stypes)) == 0, \
+        lib.MXGetLastError()
+    got = _to_np(lib, c.c_void_p(var_grads[0]), (2,))
+    onp.testing.assert_allclose(got, [4.0, 6.0])
+
+
+def test_dataiter_info_and_misc(lib):
+    name = c.c_char_p()
+    desc = c.c_char_p()
+    num_args = c.c_int()
+    an = c.POINTER(c.c_char_p)()
+    at = c.POINTER(c.c_char_p)()
+    ad = c.POINTER(c.c_char_p)()
+    assert lib.MXDataIterGetIterInfo(b"NDArrayIter", c.byref(name),
+                                     c.byref(desc), c.byref(num_args),
+                                     c.byref(an), c.byref(at),
+                                     c.byref(ad)) == 0, lib.MXGetLastError()
+    names = [an[i].decode() for i in range(num_args.value)]
+    assert "batch_size" in names
+    prev = c.c_int()
+    assert lib.MXEngineSetBulkSize(20, c.byref(prev)) == 0
+    assert lib.MXRandomSeedContext(5, b"cpu") == 0
+    assert lib.MXStorageEmptyCache(b"cpu") == 0
+    h = c.c_void_p()
+    assert lib.MXNDArrayCreateNone(c.byref(h)) == 0
