@@ -33,7 +33,7 @@ int main(int argc, char** argv) {
   Symbol f1_w = Symbol::Variable("fc1_weight");
   Symbol f2_w = Symbol::Variable("fc2_weight");
   Symbol c1 = mxtpu::op::Convolution(
-      "conv1", data, c1_w,
+      "conv1", data, c1_w, Symbol() /* no bias */,
       {{"num_filter", "8"}, {"kernel", "(3, 3)"}, {"no_bias", "True"}});
   Symbol a1 = mxtpu::op::Activation("act1", c1, {{"act_type", "tanh"}});
   Symbol p1 = mxtpu::op::Pooling(
@@ -41,10 +41,12 @@ int main(int argc, char** argv) {
       {{"pool_type", "max"}, {"kernel", "(2, 2)"}, {"stride", "(2, 2)"}});
   Symbol fl = mxtpu::op::Flatten("flat", p1);
   Symbol f1 = mxtpu::op::FullyConnected(
-      "fc1", fl, f1_w, {{"num_hidden", "32"}, {"no_bias", "True"}});
+      "fc1", fl, f1_w, Symbol(),
+      {{"num_hidden", "32"}, {"no_bias", "True"}});
   Symbol a2 = mxtpu::op::Activation("act2", f1, {{"act_type", "relu"}});
   Symbol f2 = mxtpu::op::FullyConnected(
-      "fc2", a2, f2_w, {{"num_hidden", "10"}, {"no_bias", "True"}});
+      "fc2", a2, f2_w, Symbol(),
+      {{"num_hidden", "10"}, {"no_bias", "True"}});
 
   // SoftmaxOutput composes (data, label) — both tensor inputs are
   // introspected, so the generated signature takes both

@@ -13,6 +13,8 @@
 
 #include <cctype>
 #include <cstdint>
+#include <cstdlib>
+#include <cstring>
 #include <map>
 #include <stdexcept>
 #include <string>
@@ -104,18 +106,24 @@ inline NDArray Invoke(const std::string& op,
   for (const auto& it : kw) {
     if (!first) json += ",";
     first = false;
-    // numeric-looking values go in raw so the runtime sees real numbers
+    // numbers and booleans go in raw so the runtime sees typed values
+    // (the imperative path does NOT re-parse strings); everything else
+    // is escaped and quoted
     const std::string& v = it.second;
-    bool numeric = !v.empty();
-    for (char ch : v) {
-      if (!isdigit(ch) && ch != '.' && ch != '-' && ch != '+' &&
-          ch != 'e' && ch != 'E') {
-        numeric = false;
-        break;
+    char* end = nullptr;
+    std::strtod(v.c_str(), &end);
+    bool numeric = !v.empty() && end && *end == '\0';
+    bool boolean = (v == "true" || v == "false");
+    if (numeric || boolean) {
+      json += "\"" + it.first + "\": " + v;
+    } else {
+      std::string esc;
+      for (char ch : v) {
+        if (ch == '"' || ch == '\\') esc += '\\';
+        esc += ch;
       }
+      json += "\"" + it.first + "\": \"" + esc + "\"";
     }
-    json += "\"" + it.first + "\": " +
-            (numeric ? v : "\"" + v + "\"");
   }
   json += "}";
   std::vector<NDArrayHandle> in;
@@ -188,7 +196,7 @@ class Operator {
     return *this;
   }
   Operator& AddInput(const Symbol& s) {
-    inputs_.push_back(s.handle());
+    if (s.handle()) inputs_.push_back(s.handle());
     return *this;
   }
   Symbol CreateSymbol(const std::string& name) {
@@ -199,11 +207,12 @@ class Operator {
     Check(MXSymbolCreateAtomicSymbol(op_.c_str(),
                                      static_cast<int>(ck.size()),
                                      ck.data(), cv.data(), &out));
+    Symbol owned(out);   // RAII before compose: no leak on compose error
     std::vector<const char*> in_keys(inputs_.size(), nullptr);
     Check(MXSymbolCompose(out, name.c_str(),
                           static_cast<int>(inputs_.size()),
                           in_keys.data(), inputs_.data()));
-    return Symbol(out);
+    return owned;
   }
 
  private:
@@ -236,6 +245,10 @@ class Executor {
   ~Executor() {
     if (h_) MXExecutorFree(h_);
   }
+  // owning handle: copying would double-free
+  Executor(const Executor&) = delete;
+  Executor& operator=(const Executor&) = delete;
+  Executor(Executor&& o) noexcept : h_(o.h_) { o.h_ = nullptr; }
 
   void Forward(bool is_train) {
     Check(MXExecutorForward(h_, is_train ? 1 : 0));

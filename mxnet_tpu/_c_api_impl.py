@@ -658,6 +658,16 @@ def atomic_symbol_info(name):
     except (TypeError, ValueError):
         sig = None
     if sig is not None:
+        # tensor prefix: leading params with no default are tensor inputs.
+        # None-defaulted params INSIDE that prefix are OPTIONAL tensor
+        # inputs only when their NAME is a conventional tensor slot
+        # (bias/gamma/...): signatures interleave None-defaulted config
+        # params (num_hidden=None) with the tensor prefix, so name is the
+        # only reliable discriminator without per-op arity metadata
+        tensor_slots = {"bias", "gamma", "beta", "moving_mean",
+                        "moving_var", "weight", "label", "state_cell",
+                        "aux_states"}
+        in_tensor_prefix = True
         for pname, p in sig.parameters.items():
             if pname in ("key", "train"):      # state-binder internals
                 continue
@@ -673,7 +683,13 @@ def atomic_symbol_info(name):
                 arg_names.append(pname)
                 arg_types.append("NDArray-or-Symbol")
                 arg_descs.append("tensor input")
+            elif (p.default is None and in_tensor_prefix
+                  and pname in tensor_slots):
+                arg_names.append(pname)
+                arg_types.append("NDArray-or-Symbol, optional")
+                arg_descs.append("optional tensor input")
             else:
+                in_tensor_prefix = False
                 arg_names.append(pname)
                 d = p.default
                 t = ("boolean" if isinstance(d, bool) else
@@ -712,22 +728,11 @@ def symbol_get_children(h):
     return sym_mod.Group(kids) if kids else sym_mod.Group([])
 
 
-def symbol_get_inputs(h):
-    s = _sym_unwrap(h)
-    from .symbol.symbol import Symbol
-    names = s.list_inputs() if hasattr(s, "list_inputs") else \
-        s.list_arguments() + s.list_auxiliary_states()
-    from .symbol import symbol as sym_mod
-    return [sym_mod.var(n) for n in names]
-
-
 def symbol_remove_amp_cast(h):
-    """MXSymbolRemoveAmpCast: strip amp_cast/amp_multicast nodes. Our
-    graphs never materialize amp casts as nodes (AMP rides dtype policy),
-    so this is a structural copy."""
-    s = _sym_unwrap(h)
-    from .symbol.symbol import Symbol
-    return s.load_json(s.tojson()) if hasattr(s, "load_json") else s
+    """MXSymbolRemoveAmpCast: our graphs never materialize amp casts as
+    nodes (AMP rides dtype policy), so the symbol is returned as-is
+    (symbols are immutable graphs)."""
+    return _sym_unwrap(h)
 
 
 def executor_set_monitor(ex, cb_addr, cb_data_addr, monitor_all):
@@ -813,12 +818,6 @@ def autograd_backward_ex(heads, head_grads, variables, retain_graph,
     ag.backward(heads, head_grads=hg, retain_graph=bool(retain_graph),
                 train_mode=bool(is_train))
     return []
-
-
-def kvstore_role(kv, role):
-    """IsWorkerNode/IsServerNode/IsSchedulerNode: every process is a
-    worker on a TPU mesh (no parameter-server roles, SURVEY §3.5)."""
-    return 1 if role == "worker" else 0
 
 
 def kvstore_set_updater(kv, cb_addr, cb_data_addr):

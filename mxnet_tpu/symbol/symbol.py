@@ -187,8 +187,14 @@ class Symbol:
         if shapes is None:
             return None, None, None
         arg_shapes = [shapes.get(n) for n in arg_names]
-        out_shapes = [shapes[_out_key(s, i)]
-                      for s, i in self._outputs_list()]
+        out_shapes = []
+        for node, i in self._outputs_list():
+            k = _out_key(node, i)
+            if k in shapes:
+                out_shapes.append(shapes[k])
+            else:
+                # bare-variable output: its shape IS the bound argument's
+                out_shapes.append(shapes.get(getattr(node, "_name", None)))
         aux_shapes = [shapes.get(n) for n in self.list_auxiliary_states()]
         return arg_shapes, out_shapes, aux_shapes
 

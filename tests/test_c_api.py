@@ -15,19 +15,9 @@ import subprocess
 import numpy as onp
 import pytest
 
-REPO = pathlib.Path(__file__).resolve().parent.parent
-LIB = REPO / "lib" / "libmxtpu_c.so"
+from _capi_testlib import REPO, LIB, built
 
-
-def _built():
-    if LIB.exists():
-        return True
-    r = subprocess.run(["make", "-C", str(REPO / "src")],
-                       capture_output=True, text=True)
-    return r.returncode == 0 and LIB.exists()
-
-
-pytestmark = pytest.mark.skipif(not _built(),
+pytestmark = pytest.mark.skipif(not built(),
                                 reason="libmxtpu_c.so not built")
 
 

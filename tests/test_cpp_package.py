@@ -8,27 +8,10 @@ import subprocess
 
 import pytest
 
-REPO = pathlib.Path(__file__).resolve().parent.parent
-LIB = REPO / "lib" / "libmxtpu_c.so"
+from _capi_testlib import REPO, built, host_env as _env
 
-
-def _built():
-    if LIB.exists():
-        return True
-    r = subprocess.run(["make", "-C", str(REPO / "src")],
-                       capture_output=True, text=True)
-    return r.returncode == 0 and LIB.exists()
-
-
-pytestmark = pytest.mark.skipif(not _built(),
+pytestmark = pytest.mark.skipif(not built(),
                                 reason="libmxtpu_c.so not built")
-
-
-def _env():
-    env = dict(os.environ)
-    env["JAX_PLATFORMS"] = "cpu"   # hosts must not dial the TPU tunnel
-    env.pop("XLA_FLAGS", None)
-    return env
 
 
 @pytest.fixture(scope="module")
