@@ -113,6 +113,15 @@ inline NDArray Invoke(const std::string& op,
     char* end = nullptr;
     std::strtod(v.c_str(), &end);
     bool numeric = !v.empty() && end && *end == '\0';
+    // strtod accepts inf/nan/hex, which are NOT valid JSON: also require
+    // the plain decimal character set
+    for (char ch : v) {
+      if (!isdigit(static_cast<unsigned char>(ch)) && ch != '.' &&
+          ch != '-' && ch != '+' && ch != 'e' && ch != 'E') {
+        numeric = false;
+        break;
+      }
+    }
     bool boolean = (v == "true" || v == "false");
     if (numeric || boolean) {
       json += "\"" + it.first + "\": " + v;

@@ -76,6 +76,20 @@ def _try_load():
         ctypes.POINTER(ctypes.c_int64), ctypes.c_int, ctypes.c_int,
         ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
         ctypes.c_uint64, ctypes.c_void_p, ctypes.c_void_p]
+    lib.mxtpu_assemble_batch_aug.restype = ctypes.c_int
+    lib.mxtpu_assemble_batch_aug.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_void_p,
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_uint64, ctypes.c_int,
+        ctypes.c_int, ctypes.c_int, ctypes.c_void_p, ctypes.c_void_p]
+    lib.mxtpu_assemble_batch_u8_aug.restype = ctypes.c_int
+    lib.mxtpu_assemble_batch_u8_aug.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.c_uint64, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.c_void_p, ctypes.c_void_p]
     lib.mxtpu_pump_create.restype = ctypes.c_void_p
     lib.mxtpu_pump_create.argtypes = [
         ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
@@ -141,8 +155,10 @@ def recordio_scan(path):
 
 
 def assemble_batch(blob, offsets, lengths, c, h, w, resize=0, mean=None,
-                   std=None, aug_flags=0, seed=0):
-    """Parallel native decode of `len(offsets)` records into float32 NCHW."""
+                   std=None, aug_flags=0, seed=0, random_h=0, random_s=0,
+                   random_l=0):
+    """Parallel native decode of `len(offsets)` records into float32 NCHW.
+    random_h/s/l: HLS jitter ranges (reference ImageRecordIter params)."""
     l = lib()
     n = len(offsets)
     out = np.empty((n, c, h, w), np.float32)
@@ -157,20 +173,22 @@ def assemble_batch(blob, offsets, lengths, c, h, w, resize=0, mean=None,
     if std is not None:
         std = np.ascontiguousarray(std, np.float32)
         std_p = std.ctypes.data_as(ctypes.c_void_p)
-    check_call(l.mxtpu_assemble_batch(
+    check_call(l.mxtpu_assemble_batch_aug(
         blob.ctypes.data_as(ctypes.c_void_p) if isinstance(blob, np.ndarray)
         else ctypes.cast(ctypes.create_string_buffer(blob, len(blob)),
                          ctypes.c_void_p),
         offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
         lengths.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
         n, c, h, w, resize, mean_p, std_p, aug_flags, seed,
+        int(random_h), int(random_s), int(random_l),
         out.ctypes.data_as(ctypes.c_void_p),
         labels.ctypes.data_as(ctypes.c_void_p)))
     return out, labels
 
 
 def assemble_batch_u8(blob, offsets, lengths, c, h, w, resize=0,
-                      aug_flags=0, seed=0):
+                      aug_flags=0, seed=0, random_h=0, random_s=0,
+                      random_l=0):
     """uint8 NHWC native decode — the TPU fast path (normalize on device)."""
     l = lib()
     n = len(offsets)
@@ -178,13 +196,14 @@ def assemble_batch_u8(blob, offsets, lengths, c, h, w, resize=0,
     labels = np.empty(n, np.float32)
     offsets = np.ascontiguousarray(offsets, np.int64)
     lengths = np.ascontiguousarray(lengths, np.int64)
-    check_call(l.mxtpu_assemble_batch_u8(
+    check_call(l.mxtpu_assemble_batch_u8_aug(
         blob.ctypes.data_as(ctypes.c_void_p) if isinstance(blob, np.ndarray)
         else ctypes.cast(ctypes.create_string_buffer(blob, len(blob)),
                          ctypes.c_void_p),
         offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
         lengths.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
         n, c, h, w, resize, aug_flags, seed,
+        int(random_h), int(random_s), int(random_l),
         out.ctypes.data_as(ctypes.c_void_p),
         labels.ctypes.data_as(ctypes.c_void_p)))
     return out, labels
@@ -195,13 +214,18 @@ class Pump:
 
     def __init__(self, path, batch_size, data_shape, resize=0, mean=None,
                  std=None, rand_crop=False, rand_mirror=False, shuffle=False,
-                 seed=0, depth=2, u8_output=False):
+                 seed=0, depth=2, u8_output=False, random_h=0, random_s=0,
+                 random_l=0):
         l = lib()
         c, h, w = data_shape
         self._u8 = bool(u8_output)
         self._shape = (batch_size, h, w, c) if self._u8 \
             else (batch_size, c, h, w)
-        aug = (1 if rand_mirror else 0) | (2 if rand_crop else 0)
+        # bits 0-7: crop/mirror; 8-15/16-23/24-31: HLS jitter ranges
+        # (packed so the pump ABI stays unchanged — unpacked in pump.cc)
+        aug = (1 if rand_mirror else 0) | (2 if rand_crop else 0) | \
+            ((int(random_h) & 0xff) << 8) | ((int(random_s) & 0xff) << 16) | \
+            ((int(random_l) & 0xff) << 24)
         mean_p = std_p = None
         if mean is not None:
             self._mean = np.ascontiguousarray(mean, np.float32)

@@ -133,6 +133,11 @@ def quantize_model(sym, arg_params, aux_params, data_names=("data",),
     return sym, qargs, aux_params
 
 
+def _int8_identity_base():
+    from ..gluon.block import Block
+    return Block
+
+
 def _int8_blocks():
     """Lazily-built int8 inference Blocks over the quantized op family
     (reference's int8 graph rewrite, `quantize_graph_pass.cc`, done here as
@@ -251,11 +256,14 @@ def _int8_blocks():
             return y if self._act is None else self._act(y)
 
     class _Int8Conv(_Int8Layer):
-        def __init__(self, conv):
-            super().__init__(conv.weight.data().asnumpy(),
-                             None if conv.bias is None
-                             else conv.bias.data().asnumpy(),
-                             getattr(conv, "act", None))
+        def __init__(self, conv, weight_override=None, bias_override=None):
+            w = (weight_override if weight_override is not None
+                 else conv.weight.data().asnumpy())
+            if bias_override is not None:
+                b = bias_override
+            else:
+                b = None if conv.bias is None else conv.bias.data().asnumpy()
+            super().__init__(w, b, getattr(conv, "act", None))
             self._kwargs = dict(conv._kwargs)
 
         def forward(self, x):
@@ -294,11 +302,67 @@ def quantize_net(network, quantized_dtype="int8", quantize_mode="full",
     def _excluded(name):
         return name in exclude or any(m in name for m in match)
 
+    class _FoldedIdentity(_int8_identity_base()):
+        """Placeholder for a BatchNorm folded into the preceding conv
+        (reference quantize_graph_pass.cc folds BN before quantizing so
+        no float normalization sits between int8 layers)."""
+
+        def forward(self, x):
+            return x
+
+    def _fold_bn(conv, bn):
+        """Return (weight', bias') with the BN's inference transform
+        folded into the conv: w' = w * g/sqrt(v+eps) per out-channel,
+        b' = beta + (b - mean) * g/sqrt(v+eps)."""
+        w = conv.weight.data().asnumpy().astype(np.float32)
+        b = (np.zeros(w.shape[0], np.float32) if conv.bias is None
+             else conv.bias.data().asnumpy().astype(np.float32))
+        gamma = bn.gamma.data().asnumpy().astype(np.float32)
+        beta = bn.beta.data().asnumpy().astype(np.float32)
+        mean = bn.running_mean.data().asnumpy().astype(np.float32)
+        var = bn.running_var.data().asnumpy().astype(np.float32)
+        eps = bn._kwargs.get("eps", 1e-5)
+        scale = gamma / np.sqrt(var + eps)
+        w2 = w * scale.reshape((-1,) + (1,) * (w.ndim - 1))
+        b2 = beta + (b - mean) * scale
+        return w2, b2
+
     def visit(block):
         nonlocal count
-        for key, child in list(block._children.items()):
+        items = list(block._children.items())
+        # pass 1: fold Conv2D -> BatchNorm adjacencies (inference-mode BN
+        # is an affine transform absorbable into the conv; keeping it
+        # float between int8 layers was the measured perf pessimization,
+        # PERF.md round-2 int8 study). Registration order == dataflow
+        # ONLY inside Sequential containers, and a conv with a fused
+        # activation computes act BEFORE the BN, so neither folds.
+        folds = {}
+        folded_keys = set()
+        sequential = isinstance(block, (gnn.HybridSequential,
+                                        gnn.Sequential))
+        if sequential:
+            for (k1, c1), (k2, c2) in zip(items, items[1:]):
+                if (isinstance(c1, gnn.Conv2D)
+                        and isinstance(c2, gnn.BatchNorm)
+                        and getattr(c1, "act", None) is None
+                        and c1.weight._data is not None
+                        and c2.gamma._data is not None
+                        and c1._kwargs.get("num_group", 1) == 1
+                        and not _excluded(c1.name)
+                        and not _excluded(c2.name)):
+                    folds[k1] = (c1, c2, k2)
+                    folded_keys.add(k2)
+        for key, child in items:
             qb = None
-            if _excluded(child.name):
+            if key in folds:
+                c1, c2, k2 = folds[key]
+                w2, b2 = _fold_bn(c1, c2)
+                qb = _Int8Conv(c1, weight_override=w2, bias_override=b2)
+                ident = _FoldedIdentity()
+                block._children[k2] = ident
+                if getattr(block, k2, None) is c2:
+                    object.__setattr__(block, k2, ident)
+            elif _excluded(child.name):
                 pass
             elif isinstance(child, gnn.Dense) and \
                     child.weight._data is not None:
@@ -313,7 +377,7 @@ def quantize_net(network, quantized_dtype="int8", quantize_mode="full",
                     object.__setattr__(block, key, qb)
                 swapped.append(qb)
                 count += 1
-            else:
+            elif key not in folded_keys:
                 visit(child)
 
     visit(network)

@@ -514,7 +514,8 @@ class ImageRecordIter(DataIter):
                  label_width=1, shuffle=False, resize=0, mean_r=0.0,
                  mean_g=0.0, mean_b=0.0, std_r=1.0, std_g=1.0, std_b=1.0,
                  rand_crop=False, rand_mirror=False, preprocess_threads=None,
-                 prefetch_buffer=4, **kwargs):
+                 prefetch_buffer=4, random_h=0, random_s=0, random_l=0,
+                 **kwargs):
         if preprocess_threads is None:
             from .. import config as _config
             preprocess_threads = _config.get("MXNET_CPU_WORKER_NTHREADS",
@@ -538,9 +539,17 @@ class ImageRecordIter(DataIter):
                     mean=[mean_r, mean_g, mean_b],
                     std=[std_r, std_g, std_b], rand_crop=rand_crop,
                     rand_mirror=rand_mirror, shuffle=shuffle,
-                    depth=int(prefetch_buffer))
+                    depth=int(prefetch_buffer), random_h=random_h,
+                    random_s=random_s, random_l=random_l)
         except Exception:
             self._pump = None
+        if self._pump is None and (random_h or random_s or random_l):
+            import logging
+            logging.warning(
+                "ImageRecordIter: native pipeline unavailable; the "
+                "pure-python fallback does not implement HLS jitter — "
+                "random_h/random_s/random_l are IGNORED (build "
+                "lib/libmxtpu.so for augmentation parity)")
         if self._pump is not None:
             self._data_shape = tuple(data_shape)
             self._batch_size = batch_size

@@ -67,6 +67,22 @@ int mxtpu_assemble_batch_u8(const uint8_t *blob, const int64_t *offsets,
                             int aug_flags, uint64_t seed,
                             uint8_t *out_data, float *out_labels);
 
+/* Augmentation-complete variants: random_h/s/l are HLS jitter ranges
+ * (reference image_aug_default.cc random_h/random_s/random_l). */
+int mxtpu_assemble_batch_aug(const uint8_t *blob, const int64_t *offsets,
+                             const int64_t *lengths, int n, int c, int h,
+                             int w, int resize, const float *mean,
+                             const float *std_, int aug_flags,
+                             uint64_t seed, int random_h, int random_s,
+                             int random_l, float *out_data,
+                             float *out_labels);
+int mxtpu_assemble_batch_u8_aug(const uint8_t *blob, const int64_t *offsets,
+                                const int64_t *lengths, int n, int c, int h,
+                                int w, int resize, int aug_flags,
+                                uint64_t seed, int random_h, int random_s,
+                                int random_l, uint8_t *out_data,
+                                float *out_labels);
+
 /* ---- prefetch pump ----------------------------------------------------- */
 /* Opaque double-buffered producer running on a native thread. The producer
  * repeatedly assembles batches from a record blob (above), cycling through

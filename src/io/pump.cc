@@ -74,16 +74,23 @@ struct Pump {
           offs[i] = offsets[j];
           lens[i] = lengths[j];
         }
+        /* aug_flags packing: bits 0-7 = crop/mirror flags, 8-15 =
+         * random_h, 16-23 = random_s, 24-31 = random_l (HLS jitter,
+         * image_aug_default.cc) — keeps the pump ABI stable */
+        int flags = aug_flags & 0xff;
+        int rh = (aug_flags >> 8) & 0xff;
+        int rs = (aug_flags >> 16) & 0xff;
+        int rl = (aug_flags >> 24) & 0xff;
         int r = u8
-            ? mxtpu_assemble_batch_u8(
+            ? mxtpu_assemble_batch_u8_aug(
                   blob.data(), offs.data(), lens.data(), batch, c, h, w,
-                  resize, aug_flags, seed + epoch * 1315423911ull + b,
-                  out.data.data(), out.labels.data())
-            : mxtpu_assemble_batch(
+                  resize, flags, seed + epoch * 1315423911ull + b,
+                  rh, rs, rl, out.data.data(), out.labels.data())
+            : mxtpu_assemble_batch_aug(
                   blob.data(), offs.data(), lens.data(), batch, c, h, w,
                   resize,
                   has_mean ? mean : nullptr, has_std ? stdv : nullptr,
-                  aug_flags, seed + epoch * 1315423911ull + b,
+                  flags, seed + epoch * 1315423911ull + b, rh, rs, rl,
                   reinterpret_cast<float *>(out.data.data()),
                   out.labels.data());
         if (r != 0) {

@@ -260,10 +260,121 @@ void pick_crop(int ih, int iw, int h, int w, int aug_flags, std::mt19937 *rng,
   }
 }
 
+/* ---- HLS color jitter (reference image_aug_default.cc:485-509:
+ * convert to 8-bit HLS (H in [0,180], L/S in [0,255]), add per-image
+ * offsets drawn from a pseudo-gaussian (u1+4*u2)/5 over
+ * [-random_x, +random_x], clamp, convert back). Runs on the CROPPED
+ * uint8 HWC buffer inside the OpenMP worker, so jitter costs h*w work
+ * per image, not full-decode work. */
+
+inline void rgb_to_hls(uint8_t r, uint8_t g, uint8_t b, int *H, int *L,
+                       int *S) {
+  float rf = r / 255.f, gf = g / 255.f, bf = b / 255.f;
+  float vmax = std::max(rf, std::max(gf, bf));
+  float vmin = std::min(rf, std::min(gf, bf));
+  float l = (vmax + vmin) * 0.5f;
+  float h = 0.f, sL = 0.f;
+  float d = vmax - vmin;
+  if (d > 1e-7f) {
+    sL = l < 0.5f ? d / (vmax + vmin) : d / (2.f - vmax - vmin);
+    if (vmax == rf)       h = 60.f * (gf - bf) / d;
+    else if (vmax == gf)  h = 120.f + 60.f * (bf - rf) / d;
+    else                  h = 240.f + 60.f * (rf - gf) / d;
+    if (h < 0.f) h += 360.f;
+  }
+  *H = static_cast<int>(h * 0.5f + 0.5f);        /* [0,180] */
+  *L = static_cast<int>(l * 255.f + 0.5f);
+  *S = static_cast<int>(sL * 255.f + 0.5f);
+}
+
+inline float hue_to_rgb(float p, float q, float t) {
+  if (t < 0.f) t += 1.f;
+  if (t > 1.f) t -= 1.f;
+  if (t < 1.f / 6.f) return p + (q - p) * 6.f * t;
+  if (t < 0.5f) return q;
+  if (t < 2.f / 3.f) return p + (q - p) * (2.f / 3.f - t) * 6.f;
+  return p;
+}
+
+inline void hls_to_rgb(int H, int L, int S, uint8_t *r, uint8_t *g,
+                       uint8_t *b) {
+  float h = H * 2.f / 360.f, l = L / 255.f, sL = S / 255.f;
+  float rf, gf, bf;
+  if (sL <= 1e-7f) {
+    rf = gf = bf = l;
+  } else {
+    float q = l < 0.5f ? l * (1.f + sL) : l + sL - l * sL;
+    float p = 2.f * l - q;
+    rf = hue_to_rgb(p, q, h + 1.f / 3.f);
+    gf = hue_to_rgb(p, q, h);
+    bf = hue_to_rgb(p, q, h - 1.f / 3.f);
+  }
+  *r = static_cast<uint8_t>(std::max(0.f, std::min(255.f, rf * 255.f + .5f)));
+  *g = static_cast<uint8_t>(std::max(0.f, std::min(255.f, gf * 255.f + .5f)));
+  *b = static_cast<uint8_t>(std::max(0.f, std::min(255.f, bf * 255.f + .5f)));
+}
+
+inline int clampi(int v, int lo, int hi) {
+  return v < lo ? lo : (v > hi ? hi : v);
+}
+
+/* Per-image offsets: the reference's pseudo-gaussian (u1 + 4*u2)/5 mapped
+ * to [-rng_x, rng_x] (image_aug_default.cc:490-495). */
+inline int hls_offset(std::mt19937 *rng, int range) {
+  if (range == 0 || !rng) return 0;
+  const float inv = 1.0f / 4294967296.0f;
+  float u1 = (*rng)() * inv, u2 = (*rng)() * inv;
+  float r = (u1 + 4.f * u2) / 5.f;
+  return static_cast<int>(r * range * 2) - range;
+}
+
+void apply_hls(uint8_t *hwc, int h, int w, int c, int dh, int ds, int dl) {
+  if (c < 3 || (dh == 0 && ds == 0 && dl == 0)) return;
+  for (int64_t i = 0; i < static_cast<int64_t>(h) * w; ++i) {
+    uint8_t *px = hwc + i * c;
+    int H, L, S;
+    rgb_to_hls(px[0], px[1], px[2], &H, &L, &S);
+    H = clampi(H + dh, 0, 180);
+    L = clampi(L + dl, 0, 255);
+    S = clampi(S + ds, 0, 255);
+    hls_to_rgb(H, L, S, &px[0], &px[1], &px[2]);
+  }
+}
+
+int decode_one_u8(const uint8_t *rec, int64_t len, int c, int h, int w,
+                  int resize, int aug_flags, std::mt19937 *rng,
+                  uint8_t *out, float *label,
+                  int random_h = 0, int random_s = 0, int random_l = 0);
+
 /* Decode one record into a float32 CHW plane with crop/mirror/normalize. */
 int decode_one(const uint8_t *rec, int64_t len, int c, int h, int w,
                int resize, const float *mean, const float *stdv,
-               int aug_flags, std::mt19937 *rng, float *out, float *label) {
+               int aug_flags, std::mt19937 *rng, float *out, float *label,
+               int random_h = 0, int random_s = 0, int random_l = 0) {
+  if (random_h || random_s || random_l) {
+    /* HLS jitter operates on the uint8 crop: decode through the u8 path
+     * into scratch, then normalize+transpose (reference order: crop ->
+     * color-space aug -> normalize, image_aug_default.cc) */
+    std::vector<uint8_t> crop(static_cast<size_t>(h) * w * c);
+    int r = decode_one_u8(rec, len, c, h, w, resize, aug_flags, rng,
+                          crop.data(), label, random_h, random_s,
+                          random_l);
+    if (r != 0) return r;
+    for (int ch = 0; ch < c; ++ch) {
+      float m = mean ? mean[ch < 3 ? ch : 2] : 0.f;
+      float sdv = stdv ? stdv[ch < 3 ? ch : 2] : 1.f;
+      float inv = sdv != 0.f ? 1.f / sdv : 1.f;
+      for (int y = 0; y < h; ++y) {
+        const uint8_t *srow = crop.data() +
+            (static_cast<int64_t>(y) * w) * c + ch;
+        float *dst = out + (static_cast<int64_t>(ch) * h + y) * w;
+        for (int x = 0; x < w; ++x)
+          dst[x] = (static_cast<float>(srow[static_cast<int64_t>(x) * c])
+                    - m) * inv;
+      }
+    }
+    return 0;
+  }
   std::vector<uint8_t> decoded;
   const uint8_t *p;
   int ih, iw, ic;
@@ -298,7 +409,8 @@ int decode_one(const uint8_t *rec, int64_t len, int c, int h, int w,
  * step on device where HBM bandwidth is ~100× the host link). */
 int decode_one_u8(const uint8_t *rec, int64_t len, int c, int h, int w,
                   int resize, int aug_flags, std::mt19937 *rng,
-                  uint8_t *out, float *label) {
+                  uint8_t *out, float *label,
+                  int random_h, int random_s, int random_l) {
   std::vector<uint8_t> decoded;
   const uint8_t *p;
   int ih, iw, ic;
@@ -331,6 +443,10 @@ int decode_one_u8(const uint8_t *rec, int64_t len, int c, int h, int w,
       for (int ch = 0; ch < c; ++ch)
         dst[x * c + ch] = px[ic == 1 ? 0 : (ch < ic ? ch : ic - 1)];
     }
+  }
+  if (random_h || random_s || random_l) {
+    apply_hls(out, h, w, c, hls_offset(rng, random_h),
+              hls_offset(rng, random_s), hls_offset(rng, random_l));
   }
   return 0;
 }
@@ -375,17 +491,32 @@ int mxtpu_assemble_batch(const uint8_t *blob, const int64_t *offsets,
                          int resize, const float *mean, const float *std_,
                          int aug_flags, uint64_t seed, float *out_data,
                          float *out_labels) {
+  return mxtpu_assemble_batch_aug(blob, offsets, lengths, n, c, h, w,
+                                  resize, mean, std_, aug_flags, seed,
+                                  0, 0, 0, out_data, out_labels);
+}
+
+/* Augmentation-complete variant: random_h/s/l are the reference
+ * ImageRecordIter's HLS jitter ranges (image_aug_default.cc). */
+int mxtpu_assemble_batch_aug(const uint8_t *blob, const int64_t *offsets,
+                             const int64_t *lengths, int n, int c, int h,
+                             int w, int resize, const float *mean,
+                             const float *std_, int aug_flags,
+                             uint64_t seed, int random_h, int random_s,
+                             int random_l, float *out_data,
+                             float *out_labels) {
   int err = 0, nfail = 0;
 #ifdef _OPENMP
 #pragma omp parallel for schedule(dynamic) reduction(+:nfail)
 #endif
   for (int i = 0; i < n; ++i) {
     std::mt19937 rng(static_cast<uint32_t>(seed + i * 2654435761u));
+    bool need_rng = aug_flags || random_h || random_s || random_l;
     int r = decode_one(blob + offsets[i], lengths[i], c, h, w, resize,
                        mean, std_,
-                       aug_flags, aug_flags ? &rng : nullptr,
+                       aug_flags, need_rng ? &rng : nullptr,
                        out_data + static_cast<int64_t>(i) * c * h * w,
-                       out_labels + i);
+                       out_labels + i, random_h, random_s, random_l);
     if (r != 0) {
       // Corrupt record -> zero image, label -1. Deviation from the
       // reference, which CHECK-fails the whole run on an undecodable
@@ -416,16 +547,28 @@ int mxtpu_assemble_batch_u8(const uint8_t *blob, const int64_t *offsets,
                             const int64_t *lengths, int n, int c, int h,
                             int w, int resize, int aug_flags, uint64_t seed,
                             uint8_t *out_data, float *out_labels) {
+  return mxtpu_assemble_batch_u8_aug(blob, offsets, lengths, n, c, h, w,
+                                     resize, aug_flags, seed, 0, 0, 0,
+                                     out_data, out_labels);
+}
+
+int mxtpu_assemble_batch_u8_aug(const uint8_t *blob, const int64_t *offsets,
+                                const int64_t *lengths, int n, int c, int h,
+                                int w, int resize, int aug_flags,
+                                uint64_t seed, int random_h, int random_s,
+                                int random_l, uint8_t *out_data,
+                                float *out_labels) {
   int err = 0, nfail = 0;
 #ifdef _OPENMP
 #pragma omp parallel for schedule(dynamic) reduction(+:nfail)
 #endif
   for (int i = 0; i < n; ++i) {
     std::mt19937 rng(static_cast<uint32_t>(seed + i * 2654435761u));
+    bool need_rng = aug_flags || random_h || random_s || random_l;
     int r = decode_one_u8(blob + offsets[i], lengths[i], c, h, w, resize,
-                          aug_flags, aug_flags ? &rng : nullptr,
+                          aug_flags, need_rng ? &rng : nullptr,
                           out_data + static_cast<int64_t>(i) * h * w * c,
-                          out_labels + i);
+                          out_labels + i, random_h, random_s, random_l);
     if (r != 0) {
       std::memset(out_data + static_cast<int64_t>(i) * h * w * c, 0,
                   static_cast<size_t>(h) * w * c);
