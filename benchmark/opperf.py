@@ -135,13 +135,163 @@ def bench_op(name, n=512, reps=20):
     return fwd_ms, bwd_ms
 
 
+def _generic_inputs(name, n):
+    """Candidate generic input sets for the registry sweep, tried in
+    order (the reference opperf maintains hand-written shapes per op
+    family in nd_operations/*.py; a candidate ladder gets systematic
+    coverage without 400 hand entries)."""
+    import jax.numpy as jnp
+    rng = np.random.default_rng(0)
+
+    def t(*s):
+        return jnp.asarray(rng.random(s).astype("float32") + 0.1)
+
+    def idx(*s):
+        return jnp.asarray(rng.integers(0, 4, s).astype("int32"))
+
+    return [
+        ((t(n, n),), {}),
+        ((t(n, n), t(n, n)), {}),
+        ((t(8, n),), {}),
+        ((t(8, 4, n),), {}),
+        ((t(n, n), t(n, n), t(n, n)), {}),
+        ((t(8, 8, 16, 16),), {}),
+        ((t(n, n), idx(n)), {}),
+        ((idx(n),), {}),
+        ((t(n),), {}),
+    ]
+
+
+def sweep_registry(n=128, reps=5, out_path=None):
+    """Time fwd/bwd of EVERY registered operator (reference opperf.py
+    run_all_mxnet_operator_benchmarks role); ops whose generic inputs
+    don't apply are recorded as skipped with the reason — the artifact
+    reports coverage, not silence."""
+    import jax
+    from mxnet_tpu.ops.registry import list_ops, get_op
+
+    names = sorted({get_op(nm).name for nm in list_ops()})
+    rows = []
+    n_ok = 0
+    for name in names:
+        op = get_op(name)
+        candidates = []
+        try:
+            candidates.append(_inputs_for(name, n)
+                              if name in DEFAULT_OPS else None)
+        except Exception:
+            pass
+        cands = [c for c in candidates if c] + _generic_inputs(name, n)
+        # resolve state binders (RNG key / train flag) the way invoke()
+        # does, so samplers and dropout-family ops are timeable
+        bound = {}
+        for bk, binder in (op.state_binders or {}).items():
+            try:
+                bound[bk] = binder()
+            except Exception:
+                pass
+        row = {"op": name, "status": "skip", "fwd_ms": None,
+               "bwd_ms": None}
+        for args_, kw0 in cands:
+            kwargs_ = dict(kw0, **bound)
+            try:
+                fwd = jax.jit(lambda *a: op.fn(*a, **kwargs_))
+                jax.eval_shape(fwd, *args_)
+            except Exception as e:
+                row["error"] = str(e)[:120]
+                continue
+            try:
+                fwd_ms, bwd_ms = _time_callable(op, args_, kwargs_, reps)
+            except Exception as e:
+                row["error"] = str(e)[:120]
+                continue
+            row.update(status="ok", fwd_ms=round(fwd_ms, 4),
+                       bwd_ms=(round(bwd_ms, 4)
+                               if bwd_ms is not None else None))
+            row.pop("error", None)
+            n_ok += 1
+            break
+        rows.append(row)
+        print("%-40s %s  fwd=%s bwd=%s"
+              % (name, row["status"], row["fwd_ms"], row["bwd_ms"]),
+              file=sys.stderr)
+    artifact = {"n": n, "reps": reps,
+                "platform": _platform_name(),
+                "total_ops": len(names), "timed_ops": n_ok,
+                "rows": rows}
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(artifact, f, indent=1)
+        print("wrote %s: %d/%d ops timed"
+              % (out_path, n_ok, len(names)), file=sys.stderr)
+    return artifact
+
+
+def _platform_name():
+    import jax
+    return jax.devices()[0].platform
+
+
+def _time_callable(op, args_, kwargs_, reps):
+    import jax
+    import jax.numpy as jnp
+
+    fwd = jax.jit(lambda *a: op.fn(*a, **kwargs_))
+
+    def sync(x):
+        while isinstance(x, (tuple, list)):
+            x = x[0]
+        return jax.device_get(jnp.ravel(x)[0])
+
+    sync(fwd(*args_))
+    sync(fwd(*args_))
+    t0 = time.perf_counter()
+    r = None
+    for _ in range(reps):
+        r = fwd(*args_)
+    sync(r)
+    fwd_ms = (time.perf_counter() - t0) / reps * 1e3
+
+    bwd_ms = None
+    if op.differentiable:
+        def loss(*a):
+            out = op.fn(*a, **kwargs_)
+            while isinstance(out, (tuple, list)):
+                out = out[0]
+            return jnp.sum(out.astype(jnp.float32))
+
+        argnums = tuple(i for i, a in enumerate(args_)
+                        if jnp.issubdtype(a.dtype, jnp.floating))
+        if argnums:
+            try:
+                grad = jax.jit(jax.grad(loss, argnums=argnums))
+                sync(grad(*args_))
+                t0 = time.perf_counter()
+                for _ in range(reps):
+                    r = grad(*args_)
+                sync(r)
+                bwd_ms = (time.perf_counter() - t0) / reps * 1e3
+            except Exception:
+                bwd_ms = None
+    return fwd_ms, bwd_ms
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("ops", nargs="*", default=None)
     ap.add_argument("--json", action="store_true")
     ap.add_argument("-n", type=int, default=512, help="problem size")
     ap.add_argument("--reps", type=int, default=20)
+    ap.add_argument("--all", action="store_true",
+                    help="sweep the ENTIRE op registry and write an "
+                         "artifact (benchmark/OPPERF.json)")
+    ap.add_argument("--out", default=os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "OPPERF.json"))
     args = ap.parse_args()
+    if args.all:
+        sweep_registry(n=min(args.n, 128), reps=min(args.reps, 5),
+                       out_path=args.out)
+        return
     ops = args.ops or DEFAULT_OPS
     for name in ops:
         fwd_ms, bwd_ms = bench_op(name, n=args.n, reps=args.reps)
