@@ -1,5 +1,10 @@
 """Per-operator forward/backward latency harness.
 
+The committed benchmark/OPPERF.json artifact is the CPU-oracle sweep
+(``"platform"`` is recorded inside); rerun ``--all`` on a TPU host for
+chip latencies — the timing protocol (jit + D2H scalar sync) is
+platform-correct either way.
+
 Role parity: reference ``benchmark/opperf/opperf.py`` (per-op fwd/bwd
 latency across the registry, SURVEY §6). TPU-native notes: each op is
 timed as a jitted program (steady-state, compile excluded) and synced via
@@ -271,7 +276,12 @@ def _time_callable(op, args_, kwargs_, reps):
                     r = grad(*args_)
                 sync(r)
                 bwd_ms = (time.perf_counter() - t0) / reps * 1e3
-            except Exception:
+            except Exception as e:
+                # a crashed backward on a differentiable op is a finding,
+                # not silence (the artifact stays ok/fwd-only, stderr
+                # carries the reason)
+                print("WARNING: backward of %s failed: %s"
+                      % (op.name, str(e)[:160]), file=sys.stderr)
                 bwd_ms = None
     return fwd_ms, bwd_ms
 
@@ -289,8 +299,11 @@ def main():
         os.path.dirname(os.path.abspath(__file__)), "OPPERF.json"))
     args = ap.parse_args()
     if args.all:
-        sweep_registry(n=min(args.n, 128), reps=min(args.reps, 5),
-                       out_path=args.out)
+        # sweep defaults are smaller than the single-op defaults; honor
+        # explicit flags, only downscale the UNSET argparse defaults
+        n = 128 if args.n == 512 else args.n
+        reps = 5 if args.reps == 20 else args.reps
+        sweep_registry(n=n, reps=reps, out_path=args.out)
         return
     ops = args.ops or DEFAULT_OPS
     for name in ops:
