@@ -68,6 +68,7 @@ _LAZY = {
     "tvmop": ".tvmop",
     "th": ".torch_bridge",
     "torch_bridge": ".torch_bridge",
+    "serving": ".serving",
 }
 
 

@@ -24,12 +24,39 @@ instead).
 """
 from __future__ import annotations
 
+import threading
+from collections import OrderedDict
+
 import jax
 
 from . import _tape
+from . import config as _config
 from . import random as _random
 
-__all__ = ["CachedOp"]
+__all__ = ["CachedOp", "cache_stats", "reset_cache_stats"]
+
+# Process-wide executor-cache counters, aggregated across every CachedOp
+# instance (the serving layer exports these through /metrics). A "miss" is
+# an XLA compile; an "eviction" frees a compiled executable under the LRU
+# bound (role of the reference's GetCachedOp registry bookkeeping).
+_GLOBAL_STATS = {"hits": 0, "misses": 0, "evictions": 0}
+_STATS_LOCK = threading.Lock()
+
+
+def cache_stats():
+    """Process-wide executor-cache counters summed over all CachedOps:
+    ``{"hits", "misses", "evictions"}``. ``misses`` == number of XLA
+    compiles issued by CachedOp dispatch since the last reset."""
+    with _STATS_LOCK:
+        return dict(_GLOBAL_STATS)
+
+
+def reset_cache_stats():
+    """Zero the process-wide counters (per-instance counters are reset by
+    dropping the instance)."""
+    with _STATS_LOCK:
+        for k in _GLOBAL_STATS:
+            _GLOBAL_STATS[k] = 0
 
 
 class CachedOp:
@@ -44,7 +71,7 @@ class CachedOp:
 
     def __init__(self, fn, static_alloc=False, static_shape=False,
                  inline_limit=2, forward_bulk_size=None,
-                 backward_bulk_size=None, name="CachedOp"):
+                 backward_bulk_size=None, name="CachedOp", capacity=None):
         self._fn = fn
         self._name = name
         # flags kept for API parity (cached_op.h:33-52); XLA makes them no-ops
@@ -53,7 +80,22 @@ class CachedOp:
                            inline_limit=inline_limit,
                            forward_bulk_size=forward_bulk_size,
                            backward_bulk_size=backward_bulk_size)
-        self._cache = {}
+        # LRU-bounded executor cache: each entry holds a compiled XLA
+        # executable, so unbounded shape churn (dynamic batch/seq sizes)
+        # is a memory leak without a cap. capacity <= 0 disables the bound.
+        if capacity is None:
+            capacity = _config.get("MXNET_CACHED_OP_CAPACITY")
+        self._capacity = int(capacity)
+        self._cache = OrderedDict()
+        self._stats = {"hits": 0, "misses": 0, "evictions": 0}
+
+    def cache_stats(self):
+        """This instance's executor-cache counters plus occupancy:
+        ``{"size", "capacity", "hits", "misses", "evictions"}``."""
+        out = dict(self._stats)
+        out["size"] = len(self._cache)
+        out["capacity"] = self._capacity
+        return out
 
     def _signature(self, args):
         return (tuple((a.shape, str(a.dtype)) for a in args),
@@ -112,6 +154,21 @@ class CachedOp:
         if entry is None:
             entry = self._compile(args)
             self._cache[sig] = entry
+            self._stats["misses"] += 1
+            evicted = 0
+            if self._capacity > 0:
+                while len(self._cache) > self._capacity:
+                    self._cache.popitem(last=False)
+                    evicted += 1
+            self._stats["evictions"] += evicted
+            with _STATS_LOCK:
+                _GLOBAL_STATS["misses"] += 1
+                _GLOBAL_STATS["evictions"] += evicted
+        else:
+            self._cache.move_to_end(sig)
+            self._stats["hits"] += 1
+            with _STATS_LOCK:
+                _GLOBAL_STATS["hits"] += 1
         jitted, n_out, multi, aux_handles = entry
 
         key = _random.next_key()

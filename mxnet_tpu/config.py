@@ -48,6 +48,9 @@ KNOBS = dict([
        "bulk-dispatch span size hint (engine.py bulk context)"),
     _k("MXNET_PROFILER_AUTOSTART", 0, int, "wired",
        "start the profiler at import (profiler.py)"),
+    _k("MXNET_CACHED_OP_CAPACITY", 64, int, "wired",
+       "max compiled signatures retained per CachedOp (LRU; <=0 means "
+       "unbounded) — bounds XLA executable memory under shape churn"),
     _k("MXNET_PROFILER_MODE", 0, int, "wired",
        "profile symbolic-only (0) or all (1) operators"),
     _k("MXNET_UPDATE_ON_KVSTORE", 0, int, "wired",

@@ -15,10 +15,50 @@ from collections import defaultdict
 
 __all__ = ["set_config", "profiler_set_config", "set_state",
            "profiler_set_state", "dump", "dumps", "pause", "resume",
+           "get_aggregate_stats", "register_stats_provider",
+           "unregister_stats_provider",
            "Domain", "Task", "Frame", "Event", "Counter", "Marker"]
 
 _state = {"running": False, "dir": "/tmp/mxnet_tpu_profile",
           "aggregate": defaultdict(lambda: [0, 0.0])}
+
+# External subsystems (e.g. mxnet_tpu.serving metrics, the CachedOp
+# executor cache) contribute rows to the aggregate table by registering a
+# zero-arg provider returning ``{name: (calls, total_seconds)}`` — the
+# host-side analogue of the reference's per-device aggregate merge in
+# `src/profiler/aggregate_stats.cc`.
+_stats_providers = []
+
+
+def register_stats_provider(fn):
+    """Register a zero-arg callable returning ``{name: (calls, total_s)}``;
+    its rows appear in :func:`get_aggregate_stats` and :func:`dumps`."""
+    if fn not in _stats_providers:
+        _stats_providers.append(fn)
+    return fn
+
+
+def unregister_stats_provider(fn):
+    if fn in _stats_providers:
+        _stats_providers.remove(fn)
+
+
+def get_aggregate_stats():
+    """The host-side aggregate table as a dict:
+    ``{name: {"calls": int, "total_ms": float}}`` — the programmatic
+    counterpart of the :func:`dumps` string, merged with every registered
+    stats provider (a provider failing never breaks the table)."""
+    out = {}
+    for name, (calls, total) in _state["aggregate"].items():
+        out[name] = {"calls": int(calls), "total_ms": total * 1e3}
+    for fn in list(_stats_providers):
+        try:
+            rows = fn() or {}
+        except Exception:
+            continue
+        for name, (calls, total) in rows.items():
+            out[name] = {"calls": int(calls), "total_ms": total * 1e3}
+    return out
 
 # MXNET_PROFILER_AUTOSTART=1 (reference env_var.md): begin profiling at
 # import and flush the trace at interpreter exit
@@ -71,12 +111,14 @@ def dump(finished=True, profile_process="worker"):
 
 
 def dumps(reset=False, format="table", sort_by="total", ascending=False):
-    """Aggregate stats table (role of aggregate_stats.cc Dump)."""
+    """Aggregate stats table (role of aggregate_stats.cc Dump) — includes
+    rows contributed by registered stats providers (serving, caches)."""
     lines = ["Profile Statistics:",
              "%-40s %10s %14s" % ("Name", "Calls", "Total ms")]
-    for name, (calls, total) in sorted(_state["aggregate"].items(),
-                                       key=lambda kv: -kv[1][1]):
-        lines.append("%-40s %10d %14.3f" % (name, calls, total * 1e3))
+    stats = get_aggregate_stats()
+    for name in sorted(stats, key=lambda n: -stats[n]["total_ms"]):
+        lines.append("%-40s %10d %14.3f"
+                     % (name, stats[name]["calls"], stats[name]["total_ms"]))
     if reset:
         _state["aggregate"].clear()
     return "\n".join(lines)

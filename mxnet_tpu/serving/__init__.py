@@ -1,0 +1,34 @@
+"""mxnet_tpu.serving — dynamic-batching inference subsystem.
+
+The TPU-native answer to MXNet Model Server / the C Predict API
+(`src/c_api/c_predict_api.cc`): a request path for exported models where
+
+- :class:`InferenceEngine` (``engine.py``) bounds XLA compiles with a
+  shape-bucketed executor cache (pad to a bucket ladder, CachedOp LRU);
+- :class:`DynamicBatcher` (``batcher.py``) coalesces concurrent requests
+  into batched executions with deadlines and :class:`ServerBusy`
+  backpressure;
+- :class:`ServingMetrics` (``metrics.py``) exports QPS / latency
+  percentiles / occupancy / cache counters, programmatically and through
+  the profiler aggregate table;
+- :class:`ModelServer` (``server.py``) exposes the whole path over stdlib
+  HTTP (``/predict``, ``/healthz``, ``/metrics``).
+
+Quickstart::
+
+    import mxnet_tpu as mx
+    net(sample)                      # shape the block, then
+    net.export("/tmp/model")         # -> model-symbol.json + params
+    eng = mx.serving.InferenceEngine.load("/tmp/model")
+    srv = mx.serving.ModelServer(eng, port=8080).start()
+    # curl -X POST :8080/predict -d '{"data": [...]}'
+"""
+from .batcher import (DeadlineExceeded, DynamicBatcher, ServerBusy,
+                      ServerClosed, ServingError)
+from .engine import DEFAULT_BUCKETS, InferenceEngine
+from .metrics import ServingMetrics
+from .server import ModelServer
+
+__all__ = ["InferenceEngine", "DynamicBatcher", "ModelServer",
+           "ServingMetrics", "ServingError", "ServerBusy",
+           "DeadlineExceeded", "ServerClosed", "DEFAULT_BUCKETS"]

@@ -1,0 +1,269 @@
+"""DynamicBatcher: adaptive micro-batching with backpressure.
+
+Role parity: MXNet Model Server's BatchAggregator / TF-Serving's
+``BasicBatchScheduler`` (Clipper-style adaptive batching). Concurrent
+single-sample ``predict`` calls are coalesced by a background worker into
+one model execution of up to ``max_batch_size`` rows, flushing early after
+``max_latency_ms`` so a lone request is never stuck waiting for peers.
+Combined with the engine's bucket ladder this turns serving traffic into a
+small, compile-bounded set of XLA programs at high MXU occupancy.
+
+Robustness contract (the part load balancers care about):
+
+- **Bounded queue**: when ``max_queue_size`` requests are waiting, new
+  submissions fail fast with :class:`ServerBusy` (HTTP 503) instead of
+  growing an unbounded backlog — graceful degradation under overload.
+- **Deadlines**: a request that waits past its ``timeout_ms`` is failed
+  with :class:`DeadlineExceeded` (HTTP 504) *before* wasting device time.
+- **Drain on shutdown**: ``close()`` stops intake, lets the worker finish
+  everything already queued, then joins — in-flight requests complete;
+  ``close(drain=False)`` fails queued requests with :class:`ServerClosed`.
+
+Requests carry ONE sample each (no batch axis); results come back as the
+matching row of the model output, as numpy (host) arrays — the batcher is
+the device→host boundary of the serving path.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+
+import numpy as _np
+
+__all__ = ["DynamicBatcher", "ServingError", "ServerBusy",
+           "DeadlineExceeded", "ServerClosed"]
+
+
+class ServingError(RuntimeError):
+    """Base class for typed serving failures."""
+
+
+class ServerBusy(ServingError):
+    """Bounded request queue is full — shed load (HTTP 503)."""
+
+
+class DeadlineExceeded(ServingError):
+    """Request expired in queue before execution (HTTP 504)."""
+
+
+class ServerClosed(ServingError):
+    """Batcher is shut down and no longer accepts work."""
+
+
+class _Request:
+    __slots__ = ("inputs", "future", "enqueue_t", "deadline", "sig")
+
+    def __init__(self, inputs, timeout_ms):
+        self.inputs = inputs
+        self.future = Future()
+        self.enqueue_t = time.monotonic()
+        self.deadline = (self.enqueue_t + timeout_ms / 1e3
+                         if timeout_ms else None)
+        self.sig = tuple((a.shape, str(a.dtype)) for a in inputs)
+
+
+class DynamicBatcher:
+    """Coalesce concurrent single-sample predictions into batched calls.
+
+    Parameters
+    ----------
+    fn : callable
+        Batched executor: ``fn(*batched_inputs)`` with each input
+        ``(rows, ...)``, returning an output (or list/tuple of outputs)
+        whose axis 0 is the same ``rows``. An :class:`InferenceEngine`
+        fits directly.
+    max_batch_size : int
+        Max rows coalesced into one execution.
+    max_latency_ms : float
+        How long the worker holds an open batch waiting for more requests
+        (measured from the oldest request's arrival).
+    max_queue_size : int
+        Bound on waiting requests; beyond it, submissions raise
+        :class:`ServerBusy`.
+    default_timeout_ms : float, optional
+        Per-request deadline applied when ``submit`` doesn't pass one;
+        ``None`` = no deadline.
+    metrics : ServingMetrics, optional
+        Records request latency, batch occupancy, rejections, expiries,
+        and exposes live queue depth.
+    """
+
+    def __init__(self, fn, max_batch_size=32, max_latency_ms=5.0,
+                 max_queue_size=128, default_timeout_ms=None, metrics=None,
+                 name="dynamic_batcher"):
+        if max_batch_size < 1:
+            raise ValueError("max_batch_size must be >= 1")
+        if max_queue_size < 1:
+            raise ValueError("max_queue_size must be >= 1")
+        self._fn = fn
+        self._max_batch = int(max_batch_size)
+        self._max_latency_s = max_latency_ms / 1e3
+        self._max_queue = int(max_queue_size)
+        self._default_timeout_ms = default_timeout_ms
+        self._metrics = metrics
+        self._queue = deque()
+        self._lock = threading.Lock()
+        self._not_empty = threading.Condition(self._lock)
+        self._closing = False
+        self._drain = True
+        if metrics is not None:
+            metrics.set_queue_depth_fn(lambda: self.queue_depth)
+        self._worker = threading.Thread(target=self._run, daemon=True,
+                                        name=name + "-worker")
+        self._worker.start()
+
+    # ---- client side ------------------------------------------------------
+    @property
+    def queue_depth(self):
+        with self._lock:
+            return len(self._queue)
+
+    def submit(self, *inputs, timeout_ms=None):
+        """Enqueue one sample (each input WITHOUT batch axis); returns a
+        ``concurrent.futures.Future`` resolving to the sample's output row
+        (numpy), or a tuple of rows for multi-output models. Raises
+        :class:`ServerBusy` / :class:`ServerClosed` synchronously."""
+        if timeout_ms is None:
+            timeout_ms = self._default_timeout_ms
+        arrays = tuple(_np.asarray(x) for x in inputs)
+        req = _Request(arrays, timeout_ms)
+        with self._lock:
+            if self._closing:
+                raise ServerClosed("batcher is shut down")
+            if len(self._queue) >= self._max_queue:
+                if self._metrics is not None:
+                    self._metrics.record_rejected()
+                raise ServerBusy(
+                    "request queue full (%d waiting)" % len(self._queue))
+            self._queue.append(req)
+            self._not_empty.notify()
+        return req.future
+
+    def predict(self, *inputs, timeout_ms=None):
+        """Blocking single-sample prediction through the shared batch."""
+        return self.submit(*inputs, timeout_ms=timeout_ms).result()
+
+    def close(self, drain=True, timeout=None):
+        """Stop intake; with ``drain`` the worker finishes the backlog
+        before exiting, otherwise queued requests fail with
+        :class:`ServerClosed`. Idempotent."""
+        with self._lock:
+            self._closing = True
+            self._drain = drain
+            if not drain:
+                while self._queue:
+                    req = self._queue.popleft()
+                    req.future.set_exception(
+                        ServerClosed("batcher shut down before execution"))
+            self._not_empty.notify_all()
+        self._worker.join(timeout)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # ---- worker side ------------------------------------------------------
+    def _take_batch(self):
+        """Block until work exists, hold the batch window open, then pop up
+        to ``max_batch`` signature-compatible requests. Returns (requests,
+        expired) or (None, expired) at shutdown."""
+        expired = []
+        with self._not_empty:
+            while True:
+                self._drop_expired_locked(expired)
+                if expired and not self._queue:
+                    # resolve expiries promptly: hand them to _run now
+                    # instead of holding them until new work arrives
+                    return [], expired
+                if self._queue:
+                    break
+                if self._closing:
+                    return None, expired
+                self._not_empty.wait(0.05)
+            head_t = self._queue[0].enqueue_t
+            flush_at = head_t + self._max_latency_s
+            # hold the window open for stragglers (closing flushes now)
+            while not self._closing and len(self._queue) < self._max_batch:
+                rem = flush_at - time.monotonic()
+                if rem <= 0:
+                    break
+                # cap the wait so queued deadlines are enforced promptly
+                # even while the batch window is held open
+                self._not_empty.wait(min(rem, 0.05))
+                self._drop_expired_locked(expired)
+                if not self._queue:
+                    # everything expired while waiting; start over
+                    return [], expired
+            # pop the head run of signature-compatible requests; mixed
+            # trailing shapes stay queued for the next cycle
+            sig = self._queue[0].sig
+            batch = []
+            leftover = deque()
+            while self._queue and len(batch) < self._max_batch:
+                req = self._queue.popleft()
+                (batch if req.sig == sig else leftover).append(req)
+            leftover.extend(self._queue)
+            self._queue.clear()
+            self._queue.extend(leftover)
+            return batch, expired
+
+    def _drop_expired_locked(self, expired):
+        now = time.monotonic()
+        kept = deque()
+        while self._queue:
+            req = self._queue.popleft()
+            if req.deadline is not None and now > req.deadline:
+                expired.append(req)
+            else:
+                kept.append(req)
+        self._queue.extend(kept)
+
+    def _run(self):
+        while True:
+            batch, expired = self._take_batch()
+            for req in expired:
+                if self._metrics is not None:
+                    self._metrics.record_expired()
+                req.future.set_exception(DeadlineExceeded(
+                    "request expired after queueing %.1f ms"
+                    % ((time.monotonic() - req.enqueue_t) * 1e3)))
+            if batch is None:
+                return  # closed and (if draining) queue empty
+            if not batch:
+                continue
+            self._execute(batch)
+
+    def _execute(self, batch):
+        try:
+            n_inputs = len(batch[0].inputs)
+            stacked = [_np.stack([r.inputs[i] for r in batch], axis=0)
+                       for i in range(n_inputs)]
+            out = self._fn(*stacked)
+            multi = isinstance(out, (list, tuple))
+            outs = [_np.asarray(o.asnumpy() if hasattr(o, "asnumpy") else o)
+                    for o in (out if multi else [out])]
+            for o in outs:
+                if o.shape[0] != len(batch):
+                    raise ValueError(
+                        "model output axis 0 (%d) != batch rows (%d); "
+                        "outputs must carry the batch on axis 0"
+                        % (o.shape[0], len(batch)))
+        except Exception as exc:  # noqa: BLE001 — fail the whole batch
+            for req in batch:
+                if self._metrics is not None:
+                    self._metrics.record_request(
+                        time.monotonic() - req.enqueue_t, ok=False)
+                req.future.set_exception(exc)
+            return
+        if self._metrics is not None:
+            self._metrics.record_batch(len(batch), self._max_batch)
+        done_t = time.monotonic()
+        for i, req in enumerate(batch):
+            row = tuple(o[i] for o in outs) if multi else outs[0][i]
+            if self._metrics is not None:
+                self._metrics.record_request(done_t - req.enqueue_t, ok=True)
+            req.future.set_result(row)

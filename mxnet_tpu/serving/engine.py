@@ -1,0 +1,204 @@
+"""InferenceEngine: shape-bucketed, compile-bounded model execution.
+
+Role parity: the reference's deployment executors — the C Predict API and
+MXNet Model Server both run a loaded symbol through a bound executor whose
+shapes are fixed at bind time (`src/c_api/c_predict_api.cc`). On the TPU
+stack every *new* input signature is an XLA recompile (seconds, not
+microseconds), so serving traffic with arbitrary batch sizes would melt the
+compile cache. The classic fix (TF-Serving batching, Clipper) is a bucket
+ladder: pad the batch axis up to the nearest configured bucket so the number
+of live executables is bounded by ``len(buckets)`` regardless of traffic.
+
+The executor cache itself is the CachedOp LRU (``mxnet_tpu.cached_op``):
+the engine wraps the model in one CachedOp, the bucket ladder bounds the
+signatures it can see, and ``CachedOp.cache_stats()`` provides the
+compile/hit/eviction counters surfaced at ``/metrics``.
+
+Padding invariant: pad rows are zeros appended on axis 0 and sliced back
+off every output's axis 0 — the same pad/unpad contract as
+``BaseModule.predict`` with ``NDArrayIter(last_batch_handle="pad")``.
+Models whose outputs don't carry the batch on axis 0 can't be served
+through bucket padding.
+"""
+from __future__ import annotations
+
+import bisect
+import threading
+
+import numpy as _np
+
+from ..cached_op import CachedOp
+from ..ndarray import ndarray as _nd
+
+__all__ = ["InferenceEngine", "DEFAULT_BUCKETS"]
+
+DEFAULT_BUCKETS = (1, 2, 4, 8, 16, 32)
+
+
+def _as_ndarray(x, dtype=None):
+    if isinstance(x, _nd.NDArray):
+        return x
+    return _nd.array(_np.asarray(x), dtype=dtype)
+
+
+class InferenceEngine:
+    """Run a model with batch-axis bucketing and a bounded executor cache.
+
+    Parameters
+    ----------
+    model : callable
+        Anything mapping NDArray inputs to an NDArray (or list/tuple of
+        NDArrays): a gluon ``Block``/``HybridBlock``, a ``SymbolBlock``
+        loaded from export artifacts (see :meth:`load`), or a plain
+        function over NDArrays. All inputs and outputs must carry the
+        batch on axis 0.
+    buckets : sequence of int
+        The batch-size ladder. Incoming batches are padded up to the
+        smallest bucket >= n; batches larger than ``max(buckets)`` are
+        split into ``max(buckets)``-row chunks. Compiles are bounded by
+        ``len(buckets)``.
+    jit : bool
+        Compile through CachedOp (default). ``jit=False`` calls the model
+        eagerly — for python-level models in tests, or models that are
+        already internally hybridized.
+    metrics : ServingMetrics, optional
+        If given, its executor-cache gauge is wired to :meth:`stats`.
+    """
+
+    def __init__(self, model, buckets=DEFAULT_BUCKETS, jit=True,
+                 metrics=None, name="inference_engine"):
+        if not buckets:
+            raise ValueError("need at least one bucket size")
+        self._buckets = sorted(set(int(b) for b in buckets))
+        if self._buckets[0] < 1:
+            raise ValueError("bucket sizes must be >= 1")
+        self._model = model
+        self._name = name
+        self._jit = bool(jit)
+        self._lock = threading.Lock()
+        self._buckets_seen = set()
+        if jit:
+            def _fn(*args):
+                out = model(*args)
+                return out
+            self._op = CachedOp(_fn, name=name)
+        else:
+            self._op = None
+        self._metrics = metrics
+        if metrics is not None:
+            metrics.set_cache_stats_fn(self.stats)
+
+    # ---- loading ----------------------------------------------------------
+    @staticmethod
+    def load(path, input_names=("data",), epoch=0, ctx=None, **kwargs):
+        """Build an engine from ``block.export`` artifacts
+        (``path-symbol.json`` + ``path-%04d.params``) via
+        ``SymbolBlock.imports`` — the deployment entry point."""
+        from ..gluon.block import SymbolBlock
+        symbol_file = "%s-symbol.json" % path
+        params_file = "%s-%04d.params" % (path, epoch)
+        import os
+        if not os.path.exists(params_file):
+            params_file = None
+        block = SymbolBlock.imports(symbol_file, list(input_names),
+                                    params_file, ctx=ctx)
+        return InferenceEngine(block, **kwargs)
+
+    # ---- bucketing --------------------------------------------------------
+    @property
+    def buckets(self):
+        return tuple(self._buckets)
+
+    def bucket_for(self, n):
+        """Smallest bucket >= n (or max bucket when n exceeds the ladder —
+        callers chunk first)."""
+        i = bisect.bisect_left(self._buckets, n)
+        return self._buckets[min(i, len(self._buckets) - 1)]
+
+    def _run_bucketed(self, arrays):
+        """Pad ``arrays`` (each (n, ...)) up to the bucket, run, unpad."""
+        n = arrays[0].shape[0]
+        bucket = self.bucket_for(n)
+        with self._lock:
+            self._buckets_seen.add(bucket)
+        padded = []
+        for a in arrays:
+            if a.shape[0] != n:
+                raise ValueError(
+                    "all inputs must share batch size: got %d vs %d"
+                    % (a.shape[0], n))
+            if n < bucket:
+                fill = _nd.zeros((bucket - n,) + tuple(a.shape[1:]),
+                                 dtype=a.dtype)
+                a = _nd.concat(a, fill, dim=0)
+            padded.append(a)
+        if self._op is not None:
+            out = self._op(*padded)
+        else:
+            out = self._model(*padded)
+        multi = isinstance(out, (list, tuple))
+        outs = list(out) if multi else [out]
+        if n < bucket:
+            outs = [o[0:n] for o in outs]
+        return outs, multi
+
+    # ---- execution --------------------------------------------------------
+    def predict(self, *inputs):
+        """Run a batch: each input is (n, ...) (NDArray or array-like).
+        Returns outputs with exactly n rows — pad rows never leak out.
+        Batches above ``max(buckets)`` are executed in max-bucket chunks
+        and re-concatenated."""
+        if not inputs:
+            raise ValueError("predict() needs at least one input")
+        arrays = [_as_ndarray(x) for x in inputs]
+        n = arrays[0].shape[0]
+        if n == 0:
+            raise ValueError("empty batch")
+        cap = self._buckets[-1]
+        if n <= cap:
+            outs, multi = self._run_bucketed(arrays)
+            return (outs if multi else outs[0])
+        chunks = []
+        multi = False
+        for start in range(0, n, cap):
+            part = [a[start:min(start + cap, n)] for a in arrays]
+            outs, multi = self._run_bucketed(part)
+            chunks.append(outs)
+        merged = [_nd.concat(*[c[i] for c in chunks], dim=0)
+                  for i in range(len(chunks[0]))]
+        return merged if multi else merged[0]
+
+    def __call__(self, *inputs):
+        return self.predict(*inputs)
+
+    # ---- warmup & stats ---------------------------------------------------
+    def warmup(self, example, dtype=None):
+        """Eagerly compile every bucket at load time so first-request
+        latency never pays an XLA compile. ``example`` is one input (or a
+        tuple of inputs, for multi-input models) whose trailing (non-batch)
+        dims and dtypes are representative; its batch size is ignored."""
+        examples = example if isinstance(example, (list, tuple)) \
+            else (example,)
+        arrays = [_as_ndarray(x, dtype=dtype) for x in examples]
+        for bucket in self._buckets:
+            batch = [_nd.zeros((bucket,) + tuple(a.shape[1:]),
+                               dtype=a.dtype) for a in arrays]
+            self._run_bucketed(batch)
+        return self
+
+    def stats(self):
+        """Executor-cache counters for /metrics: bucket ladder, buckets
+        actually hit, and the CachedOp LRU's hit/miss/evict counts
+        (``compiles`` == misses == XLA compiles issued)."""
+        with self._lock:
+            seen = sorted(self._buckets_seen)
+        out = {"buckets": list(self._buckets), "buckets_seen": seen}
+        if self._op is not None:
+            cs = self._op.cache_stats()
+            out.update(cs)
+            out["compiles"] = cs["misses"]
+        else:
+            out.update({"size": len(seen), "capacity": 0, "hits": 0,
+                        "misses": len(seen), "evictions": 0,
+                        "compiles": len(seen)})
+        return out

@@ -1,0 +1,177 @@
+"""Serving observability: QPS, latency percentiles, batch occupancy.
+
+Role parity: MXNet Model Server's metrics endpoint (``mms/metrics``) — the
+reference ecosystem's serving front-end reported requests/sec, latency
+percentiles, and worker queue depth per model. Here the counters live
+in-process (no sidecar), are exported three ways: programmatically via
+:meth:`ServingMetrics.snapshot`, as JSON through the HTTP ``/metrics``
+endpoint (``serving.server``), and as rows in the profiler's host-side
+aggregate table (``profiler.get_aggregate_stats`` /
+``profiler.dumps`` — the analogue of `src/profiler/aggregate_stats.cc`).
+
+Percentiles are computed over a sliding window of recent requests (ring
+buffer) so a long-running server reports current behaviour, not lifetime
+averages; QPS is likewise measured over the window span.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+
+__all__ = ["ServingMetrics"]
+
+
+class ServingMetrics:
+    """Thread-safe serving counters shared by engine, batcher, and server.
+
+    ``window`` bounds the ring buffer used for latency percentiles and QPS
+    (the last N completed requests). Gauges that belong to other components
+    (queue depth, executor-cache stats) are pulled through registered
+    callbacks at snapshot time so the metrics object never holds locks of
+    other subsystems.
+    """
+
+    def __init__(self, window=2048, name="serving"):
+        self.name = name
+        self._lock = threading.Lock()
+        self._window = deque(maxlen=window)  # (done_t, latency_s)
+        self._c = {"requests": 0, "ok": 0, "errors": 0, "rejected": 0,
+                   "expired": 0, "batches": 0, "batched_rows": 0}
+        self._latency_total = 0.0
+        self._occupancy_total = 0.0  # sum over batches of rows/capacity
+        self._t0 = time.time()
+        self._queue_depth_fn = None
+        self._cache_stats_fn = None
+        self._bound_provider = None
+
+    # ---- recording (hot path) ---------------------------------------------
+    def record_request(self, latency_s, ok=True):
+        with self._lock:
+            self._c["requests"] += 1
+            self._c["ok" if ok else "errors"] += 1
+            self._latency_total += latency_s
+            self._window.append((time.time(), latency_s))
+
+    def record_rejected(self):
+        """Request refused with ServerBusy (bounded-queue backpressure)."""
+        with self._lock:
+            self._c["rejected"] += 1
+
+    def record_expired(self):
+        """Request dropped because its deadline passed while queued."""
+        with self._lock:
+            self._c["expired"] += 1
+
+    def record_batch(self, rows, capacity):
+        """One coalesced execution of ``rows`` requests (capacity =
+        max_batch_size); occupancy = rows/capacity."""
+        with self._lock:
+            self._c["batches"] += 1
+            self._c["batched_rows"] += rows
+            self._occupancy_total += rows / float(max(capacity, 1))
+
+    # ---- gauge hookups ----------------------------------------------------
+    def set_queue_depth_fn(self, fn):
+        self._queue_depth_fn = fn
+
+    def set_cache_stats_fn(self, fn):
+        """``fn()`` -> executor-cache dict (``InferenceEngine.stats``)."""
+        self._cache_stats_fn = fn
+
+    # ---- reading ----------------------------------------------------------
+    def percentiles(self, qs=(50, 95, 99)):
+        """Latency percentiles (ms) over the sliding window; nearest-rank."""
+        with self._lock:
+            lats = sorted(l for _, l in self._window)
+        if not lats:
+            return {("p%d" % q): 0.0 for q in qs}
+        import math
+        out = {}
+        for q in qs:
+            idx = min(len(lats) - 1,
+                      max(0, math.ceil(q / 100.0 * len(lats)) - 1))
+            out["p%d" % q] = lats[idx] * 1e3
+        return out
+
+    def snapshot(self):
+        """All counters + derived gauges as one JSON-able dict."""
+        with self._lock:
+            c = dict(self._c)
+            latency_total = self._latency_total
+            occupancy_total = self._occupancy_total
+            window = list(self._window)
+        now = time.time()
+        if len(window) >= 2:
+            span = max(window[-1][0] - window[0][0], 1e-9)
+            qps = (len(window) - 1) / span
+        elif c["requests"]:
+            qps = c["requests"] / max(now - self._t0, 1e-9)
+        else:
+            qps = 0.0
+        lat = self.percentiles()
+        lat["mean"] = (latency_total / c["requests"] * 1e3
+                       if c["requests"] else 0.0)
+        out = {
+            "name": self.name,
+            "uptime_s": now - self._t0,
+            "qps": qps,
+            "latency_ms": lat,
+            "batch_occupancy": (occupancy_total / c["batches"]
+                                if c["batches"] else 0.0),
+            "avg_batch_size": (c["batched_rows"] / c["batches"]
+                               if c["batches"] else 0.0),
+        }
+        out.update(c)
+        if self._queue_depth_fn is not None:
+            try:
+                out["queue_depth"] = self._queue_depth_fn()
+            except Exception:
+                out["queue_depth"] = None
+        if self._cache_stats_fn is not None:
+            try:
+                out["executor_cache"] = self._cache_stats_fn()
+            except Exception:
+                out["executor_cache"] = None
+        return out
+
+    # ---- profiler integration ---------------------------------------------
+    def profiler_rows(self):
+        """Rows for the profiler aggregate table:
+        ``{name: (calls, total_seconds)}``."""
+        with self._lock:
+            c = dict(self._c)
+            latency_total = self._latency_total
+        prefix = self.name
+        rows = {
+            prefix + ".requests": (c["requests"], latency_total),
+            prefix + ".batches": (c["batches"], 0.0),
+            prefix + ".rejected": (c["rejected"], 0.0),
+            prefix + ".expired": (c["expired"], 0.0),
+        }
+        if self._cache_stats_fn is not None:
+            try:
+                cs = self._cache_stats_fn() or {}
+                for key in ("hits", "misses", "evictions"):
+                    if key in cs:
+                        rows["%s.cache_%s" % (prefix, key)] = \
+                            (int(cs[key]), 0.0)
+            except Exception:
+                pass
+        return rows
+
+    def bind_profiler(self):
+        """Register these counters into ``mxnet_tpu.profiler``'s aggregate
+        table (idempotent); they then show up in ``profiler.dumps()`` and
+        ``profiler.get_aggregate_stats()``."""
+        from .. import profiler as _profiler
+        if self._bound_provider is None:
+            self._bound_provider = self.profiler_rows
+            _profiler.register_stats_provider(self._bound_provider)
+        return self
+
+    def unbind_profiler(self):
+        from .. import profiler as _profiler
+        if self._bound_provider is not None:
+            _profiler.unregister_stats_provider(self._bound_provider)
+            self._bound_provider = None

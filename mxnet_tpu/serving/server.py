@@ -1,0 +1,179 @@
+"""ModelServer: stdlib HTTP front-end for the serving stack.
+
+Role parity: MXNet Model Server's REST surface (``/predictions``,
+``/ping``, ``/metrics``), reduced to the stdlib so the whole serving path —
+HTTP → DynamicBatcher → InferenceEngine → XLA — is exercisable end-to-end
+with zero extra dependencies. ``ThreadingHTTPServer`` gives one thread per
+in-flight request, which is exactly the concurrency shape the batcher
+coalesces.
+
+Endpoints (JSON):
+
+- ``POST /predict`` — body ``{"data": [...]}`` (one sample, no batch
+  axis) or ``{"inputs": [[...], ...]}`` for multi-input models; optional
+  ``"dtype"`` (default float32) and ``"timeout_ms"``. Response
+  ``{"output": [...]}`` (or ``{"outputs": [...]}``). Typed failures map
+  to load-balancer-friendly codes: ServerBusy→503, DeadlineExceeded→504,
+  malformed input→400.
+- ``GET /healthz`` — liveness.
+- ``GET /metrics`` — ``ServingMetrics.snapshot()`` (QPS, latency
+  percentiles, occupancy, queue depth, executor-cache counters).
+"""
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as _np
+
+from .batcher import (DeadlineExceeded, DynamicBatcher, ServerBusy,
+                      ServerClosed)
+from .engine import InferenceEngine
+from .metrics import ServingMetrics
+
+__all__ = ["ModelServer"]
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "mxnet_tpu_serving/0.1"
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):  # quiet: metrics replace access logs
+        pass
+
+    def _reply(self, code, payload):
+        body = json.dumps(payload).encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):  # noqa: N802 (http.server API)
+        srv = self.server.model_server
+        if self.path == "/healthz":
+            self._reply(200, {"status": "ok"})
+        elif self.path == "/metrics":
+            self._reply(200, srv.metrics.snapshot())
+        else:
+            self._reply(404, {"error": "unknown path %s" % self.path})
+
+    def do_POST(self):  # noqa: N802
+        srv = self.server.model_server
+        if self.path != "/predict":
+            self._reply(404, {"error": "unknown path %s" % self.path})
+            return
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            payload = json.loads(self.rfile.read(length) or b"{}")
+            if "inputs" in payload:
+                raw = payload["inputs"]
+            elif "data" in payload:
+                raw = [payload["data"]]
+            else:
+                raise ValueError('body needs "data" or "inputs"')
+            dtype = payload.get("dtype", "float32")
+            inputs = [_np.asarray(x, dtype=dtype) for x in raw]
+            timeout_ms = payload.get("timeout_ms")
+        except (ValueError, TypeError, KeyError, json.JSONDecodeError) as e:
+            self._reply(400, {"error": str(e)})
+            return
+        try:
+            row = srv.batcher.predict(*inputs, timeout_ms=timeout_ms)
+        except ServerBusy as e:
+            self._reply(503, {"error": str(e)})
+            return
+        except DeadlineExceeded as e:
+            self._reply(504, {"error": str(e)})
+            return
+        except ServerClosed as e:
+            self._reply(503, {"error": str(e)})
+            return
+        except Exception as e:  # noqa: BLE001 — model failure
+            self._reply(500, {"error": "%s: %s" % (type(e).__name__, e)})
+            return
+        if isinstance(row, tuple):
+            self._reply(200, {"outputs": [_np.asarray(r).tolist()
+                                          for r in row]})
+        else:
+            self._reply(200, {"output": _np.asarray(row).tolist()})
+
+
+class ModelServer:
+    """Wire engine + batcher + metrics behind one HTTP listener.
+
+    ``model`` may be an :class:`InferenceEngine` (pre-configured buckets /
+    warmup) or any batched callable, in which case an engine is built with
+    ``buckets``. ``port=0`` picks an ephemeral port (tests).
+    """
+
+    def __init__(self, model, host="127.0.0.1", port=8080,
+                 buckets=None, jit=True, max_batch_size=32,
+                 max_latency_ms=5.0, max_queue_size=128,
+                 default_timeout_ms=None, metrics=None,
+                 bind_profiler=True):
+        self.metrics = metrics or ServingMetrics()
+        if isinstance(model, InferenceEngine):
+            self.engine = model
+            self.metrics.set_cache_stats_fn(self.engine.stats)
+        else:
+            from .engine import DEFAULT_BUCKETS
+            self.engine = InferenceEngine(
+                model, buckets=buckets or DEFAULT_BUCKETS, jit=jit,
+                metrics=self.metrics)
+        if bind_profiler:
+            self.metrics.bind_profiler()
+        self.batcher = DynamicBatcher(
+            self.engine, max_batch_size=max_batch_size,
+            max_latency_ms=max_latency_ms, max_queue_size=max_queue_size,
+            default_timeout_ms=default_timeout_ms, metrics=self.metrics)
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.daemon_threads = True
+        self._httpd.model_server = self
+        self._thread = None
+
+    @property
+    def address(self):
+        """(host, port) actually bound — resolves port=0."""
+        return self._httpd.server_address[:2]
+
+    @property
+    def url(self):
+        host, port = self.address
+        return "http://%s:%d" % (host, port)
+
+    def start(self):
+        """Serve in a background thread; returns self (chainable)."""
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._httpd.serve_forever, daemon=True,
+                name="model-server")
+            self._thread.start()
+        return self
+
+    def serve(self):
+        """Blocking serve (Ctrl-C to stop)."""
+        try:
+            self._httpd.serve_forever()
+        except KeyboardInterrupt:
+            pass
+        finally:
+            self.stop()
+
+    def stop(self, drain=True):
+        """Stop the listener, then shut the batcher down (draining
+        in-flight work by default)."""
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(5.0)
+            self._thread = None
+        self.batcher.close(drain=drain)
+        self.metrics.unbind_profiler()
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()

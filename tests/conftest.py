@@ -75,6 +75,13 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long end-to-end tests excluded from the tier-1 sweep "
+        "(run explicitly with -m slow)")
+
+
 @pytest.fixture(autouse=True)
 def _seed_everything():
     import mxnet_tpu as mx

@@ -197,3 +197,36 @@ def test_python_module_compute():
         label=None)
     m.forward(batch)
     np.testing.assert_allclose(m.get_outputs()[0].asnumpy(), [1.0, 4.0])
+
+
+def test_module_predict_pad_last_batch():
+    """Regression: dataset size not divisible by batch_size — pad rows from
+    NDArrayIter(last_batch_handle="pad") must be sliced off by predict /
+    iter_predict, and per-row values must match an unpadded full-batch run
+    (the serving DynamicBatcher relies on the same pad/unpad invariant)."""
+    np.random.seed(0)
+    N, C = 19, 4
+    X = np.random.randn(N, 10).astype("float32")
+    Y = np.zeros(N, "float32")
+    it = NDArrayIter(X, Y, batch_size=8)  # last batch carries pad=5
+    mod = mx.mod.Module(_mlp_symbol(C), context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params()
+    pred = mod.predict(it)
+    assert pred.shape == (N, C)
+
+    # iter_predict: yielded rows must total N, never leaking pad rows
+    it.reset()
+    rows = sum(outs[0].shape[0] for outs, _, _ in mod.iter_predict(it))
+    assert rows == N
+
+    # value correctness: batch_size == N (no padding) with the same params
+    arg_p, aux_p = mod.get_params()
+    it_full = NDArrayIter(X, Y, batch_size=N)
+    mod2 = mx.mod.Module(_mlp_symbol(C), context=mx.cpu())
+    mod2.bind(data_shapes=it_full.provide_data,
+              label_shapes=it_full.provide_label)
+    mod2.init_params(arg_params=arg_p, aux_params=aux_p)
+    ref = mod2.predict(it_full)
+    np.testing.assert_allclose(pred.asnumpy(), ref.asnumpy(),
+                               rtol=1e-5, atol=1e-6)
