@@ -1,0 +1,184 @@
+"""Resilience benchmark: serving latency & success rate under injected faults.
+
+Measures what the retry layer costs and what it buys: the same request
+stream is driven through the serving path (InferenceEngine under a
+DynamicBatcher) twice —
+
+- **baseline**: chaos disarmed; the no-fault numbers.
+- **faulted**: a seeded 5% transient-fault rate armed on the
+  ``serving.execute`` chaos point, absorbed by a RetryPolicy.
+
+Reported per run: success rate, QPS, and per-request p50/p95/p99 latency
+(each future timestamped by its own done-callback, so one retried request
+cannot inflate its wave-mates' samples), plus the retry counters. The
+headline claim the committed ``benchmark/RESILIENCE.json`` artifact
+backs: at a 5% injected fault rate the success rate stays 100% (every
+fault absorbed by retry), with the penalty confined to the tail — a
+retried request pays its backoff (<= 1+2+4 ms here) plus re-running the
+coalesced batch, while the median is untouched. On the 2-core CI oracle
+host scheduler jitter adds noise, so compare ``success_rate`` and
+``retry`` counters across runs, not single p99 samples.
+
+Usage::
+
+    python benchmark/resilience_bench.py            # write RESILIENCE.json
+    python benchmark/resilience_bench.py --quick    # fewer requests (smoke)
+    python benchmark/resilience_bench.py --fault-rate 0.10
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+import numpy as np
+
+if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+    # this host's TPU plugin captures JAX_PLATFORMS at interpreter start;
+    # only jax.config reliably forces the CPU platform (conftest recipe)
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+from mxnet_tpu import nd  # noqa: E402
+from mxnet_tpu.resilience import RetryPolicy, chaos  # noqa: E402
+from mxnet_tpu.serving import (DynamicBatcher, InferenceEngine,  # noqa: E402
+                               ServingMetrics)
+
+D_IN, D_HID, D_OUT = 256, 512, 64
+BUCKETS = (1, 2, 4, 8)
+
+
+def _model():
+    rng = np.random.default_rng(0)
+    W1 = nd.array(rng.standard_normal((D_IN, D_HID)).astype("float32"))
+    W2 = nd.array(rng.standard_normal((D_HID, D_OUT)).astype("float32"))
+
+    def fn(x):
+        return nd.dot(nd.relu(nd.dot(x, W1)), W2)
+    return fn
+
+
+def pct(lats, q):
+    if not lats:
+        return 0.0
+    s = sorted(lats)
+    idx = min(len(s) - 1, max(0, int(round(q / 100.0 * len(s))) - 1))
+    return s[idx] * 1e3
+
+
+def drive(eng, n, concurrency, policy):
+    """n requests, `concurrency` kept in flight, through a fresh batcher."""
+    metrics = ServingMetrics()
+    sample = np.zeros((D_IN,), "float32")
+    ok = failed = 0
+    lats = []
+    with DynamicBatcher(eng, max_batch_size=concurrency,
+                        max_latency_ms=3.0, metrics=metrics,
+                        retry_policy=policy) as b:
+        # prime the worker path and the coalesced-batch shape untimed, so
+        # measured percentiles reflect steady state, not cold start
+        for _ in range(3):
+            futs = [b.submit(sample) for _ in range(concurrency)]
+            for f in futs:
+                try:
+                    f.result(timeout=60)
+                except Exception:  # noqa: BLE001 — warmup faults don't count
+                    pass
+        t0 = time.perf_counter()
+        done = 0
+        while done < n:
+            wave = min(concurrency, n - done)
+            t1 = time.perf_counter()
+            futs = [b.submit(sample) for _ in range(wave)]
+            # per-request latency via done-callbacks: a single retried
+            # request must not inflate its wave-mates' samples
+            for f in futs:
+                f.add_done_callback(
+                    lambda _f, _t1=t1: lats.append(time.perf_counter() - _t1))
+            for f in futs:
+                try:
+                    f.result(timeout=60)
+                    ok += 1
+                except Exception:  # noqa: BLE001 — count, keep driving
+                    failed += 1
+            done += wave
+        total = time.perf_counter() - t0
+    return {
+        "requests": n,
+        "ok": ok,
+        "failed": failed,
+        "success_rate": round(ok / float(n), 4),
+        "qps": round(n / total, 2),
+        "p50_ms": round(pct(lats, 50), 3),
+        "p95_ms": round(pct(lats, 95), 3),
+        "p99_ms": round(pct(lats, 99), 3),
+        "retry": policy.stats() if policy else None,
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--requests", type=int, default=512)
+    ap.add_argument("--concurrency", type=int, default=8)
+    ap.add_argument("--fault-rate", type=float, default=0.05)
+    ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--out", default=os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "RESILIENCE.json"))
+    args = ap.parse_args()
+    n = 96 if args.quick else args.requests
+
+    import jax
+    platform = jax.devices()[0].platform
+
+    eng = InferenceEngine(_model(), buckets=BUCKETS, retry_policy=False)
+    eng.warmup(np.zeros((1, D_IN), "float32"))
+
+    chaos.clear()
+    base_policy = RetryPolicy(max_attempts=4, base_delay_ms=1.0,
+                              max_delay_ms=50.0, name="bench.baseline",
+                              register=False)
+    baseline = drive(eng, n, args.concurrency, base_policy)
+    print("baseline  ok %5d/%d  qps %8.1f  p50 %6.2fms  p99 %6.2fms"
+          % (baseline["ok"], n, baseline["qps"], baseline["p50_ms"],
+             baseline["p99_ms"]))
+
+    chaos.arm("serving.execute", "transient", p=args.fault_rate, seed=0)
+    fault_policy = RetryPolicy(max_attempts=4, base_delay_ms=1.0,
+                               max_delay_ms=50.0, name="bench.faulted",
+                               register=False)
+    faulted = drive(eng, n, args.concurrency, fault_policy)
+    chaos.clear()
+    print("faulted   ok %5d/%d  qps %8.1f  p50 %6.2fms  p99 %6.2fms  "
+          "retries %d"
+          % (faulted["ok"], n, faulted["qps"], faulted["p50_ms"],
+             faulted["p99_ms"], faulted["retry"]["retries"]))
+
+    artifact = {
+        "platform": platform,
+        "model": "dense %dx%dx%d relu" % (D_IN, D_HID, D_OUT),
+        "buckets": list(BUCKETS),
+        "concurrency": args.concurrency,
+        "injected_fault_rate": args.fault_rate,
+        "injection_point": "serving.execute",
+        "retry_policy": {"max_attempts": 4, "base_delay_ms": 1.0,
+                         "max_delay_ms": 50.0},
+        "baseline": baseline,
+        "faulted": faulted,
+        "p99_penalty_ms": round(faulted["p99_ms"] - baseline["p99_ms"], 3),
+    }
+    with open(args.out, "w") as f:
+        json.dump(artifact, f, indent=2)
+        f.write("\n")
+    print("wrote %s (platform=%s, fault_rate=%.0f%%, success %.1f%% -> "
+          "%.1f%%)" % (args.out, platform, args.fault_rate * 100,
+                       baseline["success_rate"] * 100,
+                       faulted["success_rate"] * 100))
+
+
+if __name__ == "__main__":
+    main()

@@ -69,6 +69,7 @@ _LAZY = {
     "th": ".torch_bridge",
     "torch_bridge": ".torch_bridge",
     "serving": ".serving",
+    "resilience": ".resilience",
 }
 
 

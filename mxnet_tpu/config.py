@@ -67,6 +67,26 @@ KNOBS = dict([
     _k("MXNET_SAFE_ACCUMULATION", 0, int, "wired",
        "bf16 matmuls already accumulate in fp32 on the MXU; reductions "
        "here run in fp32 — flag accepted for script parity"),
+    _k("MXNET_CHAOS_SPEC", "", str, "wired",
+       "fault-injection spec armed at import (resilience/chaos.py): "
+       "'point:kind[:trigger];...' e.g. serving.execute:transient:first=2"),
+    _k("MXNET_RETRY_MAX_ATTEMPTS", 3, int, "wired",
+       "default RetryPolicy total attempts (resilience/retry.py)"),
+    _k("MXNET_RETRY_BASE_DELAY_MS", 10.0, float, "wired",
+       "default RetryPolicy first backoff delay"),
+    _k("MXNET_RETRY_MAX_DELAY_MS", 1000.0, float, "wired",
+       "default RetryPolicy backoff cap"),
+    _k("MXNET_RETRY_DEADLINE_MS", 0.0, float, "wired",
+       "default RetryPolicy wall-clock budget across attempts (0 = none)"),
+    _k("MXNET_BREAKER_FAILURE_THRESHOLD", 5, int, "wired",
+       "serving circuit breaker: consecutive failures before opening "
+       "(resilience/breaker.py; <=0 disables the ModelServer breaker)"),
+    _k("MXNET_BREAKER_RECOVERY_MS", 1000.0, float, "wired",
+       "serving circuit breaker: open-state hold before half-open probes"),
+    _k("MXNET_BREAKER_HALF_OPEN_PROBES", 1, int, "wired",
+       "serving circuit breaker: successful probes required to close"),
+    _k("MXNET_RESUME_EVERY", 10, int, "wired",
+       "resumable_fit checkpoint cadence in steps (resilience/resume.py)"),
     # ---- subsumed by XLA/PJRT --------------------------------------------
     _k("MXNET_EXEC_BULK_EXEC_INFERENCE", 1, int, "subsumed",
        "XLA compiles whole programs; bulking is implicit"),

@@ -27,6 +27,8 @@ import jax.numpy as jnp
 from .base import MXNetError
 from .ndarray import ndarray as _nd
 from .ndarray.ndarray import NDArray
+from .resilience import chaos as _chaos
+from .resilience import retry as _retry
 
 __all__ = ["KVStore", "create"]
 
@@ -38,7 +40,7 @@ def _key_str(key):
 class KVStore:
     """Single-interface store over local devices / TPU mesh."""
 
-    def __init__(self, kind="local"):
+    def __init__(self, kind="local", retry_policy=None):
         self._kind = kind
         self._store = {}
         self._updater = None
@@ -46,6 +48,13 @@ class KVStore:
         self._compression_params = None
         self._residuals = {}
         self._is_dist = kind.startswith("dist") or kind == "nccl"
+        # transient faults on push/pull (a flaky collective, an injected
+        # chaos fault) are absorbed by the env-configured "retry.kvstore"
+        # policy (own name: uncontended counters, attributable /metrics
+        # rows); pass retry_policy=False to disable
+        if retry_policy is None:
+            retry_policy = _retry.named_policy("retry.kvstore")
+        self._retry = retry_policy or None
 
     # ---- identity ---------------------------------------------------------
     @property
@@ -93,6 +102,15 @@ class KVStore:
         return out
 
     def push(self, key, value, priority=0):
+        if self._retry is not None:
+            return self._retry.call(self._push_once, key, value, priority)
+        return self._push_once(key, value, priority)
+
+    def _push_once(self, key, value, priority=0):
+        # chaos point at entry, BEFORE compression/update mutate anything:
+        # a retried injected fault can never double-consume error-feedback
+        # residuals or double-apply the updater
+        _chaos.point("kvstore.push")
         keys, values = _key_value(key, value)
         grouped = {}
         for k, v in zip(keys, values):
@@ -117,6 +135,13 @@ class KVStore:
                 self._store[k]._data = jnp.array(reduced, copy=True)
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        if self._retry is not None:
+            return self._retry.call(self._pull_once, key, out, priority,
+                                    ignore_sparse)
+        return self._pull_once(key, out, priority, ignore_sparse)
+
+    def _pull_once(self, key, out=None, priority=0, ignore_sparse=True):
+        _chaos.point("kvstore.pull")
         keys, outs = _key_value(key, out)
         for k, o in zip(keys, outs):
             if k not in self._store:

@@ -13,9 +13,12 @@ reference compatibility; this is the path that scales to pod-sized models.
 from __future__ import annotations
 
 import os
+import shutil
 
 import jax
 import numpy as np
+
+from ..resilience import chaos as _chaos
 
 __all__ = ["save_checkpoint", "restore_checkpoint"]
 
@@ -36,12 +39,37 @@ def _tree(trainer):
 def save_checkpoint(trainer, path, force=True):
     """Write the trainer's sharded params + optimizer state + step counter
     to ``path`` (a directory). Safe to call mid-training; blocks until the
-    write completes."""
+    write completes.
+
+    Atomic publish: the tree is staged into ``path + ".tmp"`` and only
+    renamed onto ``path`` once fully written — a crash mid-save (exercised
+    by the ``checkpoint.save`` chaos point, which fires between staging
+    and publish) leaves the previous good checkpoint at ``path`` intact,
+    never a partial write that :func:`restore_checkpoint` would load."""
     import orbax.checkpoint as ocp
 
     path = os.path.abspath(path)
+    tmp = path + ".tmp"
+    old = path + ".old"
+    if not os.path.exists(path) and os.path.exists(old):
+        # crash landed between the two publish renames below: `old` IS the
+        # last good checkpoint — promote it back, never treat it as stale
+        os.rename(old, path)
+    for stale in (tmp, old):  # leftovers from an earlier crashed save
+        if os.path.exists(stale):
+            shutil.rmtree(stale)
+    if os.path.exists(path) and not force:
+        # refused up front: nothing has been staged yet
+        raise FileExistsError("checkpoint %s exists (force=False)" % path)
     ckptr = ocp.PyTreeCheckpointer()
-    ckptr.save(path, _tree(trainer), force=force)
+    ckptr.save(tmp, _tree(trainer), force=force)
+    # a "crash" here (fault injected mid-save) must leave `path` untouched
+    _chaos.point("checkpoint.save")
+    if os.path.exists(path):  # force=False already rejected before the write
+        os.rename(path, old)
+    os.rename(tmp, path)
+    if os.path.exists(old):
+        shutil.rmtree(old)
     return path
 
 
@@ -52,6 +80,10 @@ def restore_checkpoint(trainer, path):
     import orbax.checkpoint as ocp
 
     path = os.path.abspath(path)
+    if not os.path.exists(path) and os.path.exists(path + ".old"):
+        # crash landed between save_checkpoint's two renames: the previous
+        # good checkpoint was already moved aside — promote it back
+        os.rename(path + ".old", path)
     tpl = _tree(trainer)
     restore_args = jax.tree_util.tree_map(
         lambda v: ocp.ArrayRestoreArgs(sharding=v.sharding)

@@ -18,6 +18,7 @@ from jax.sharding import NamedSharding, PartitionSpec
 
 from .. import random as _random
 from ..ndarray.ndarray import NDArray
+from ..resilience import chaos as _chaos
 from .functional import functionalize, functional_optimizer, shard_params
 from .mesh import make_mesh, batch_sharding, replicated
 
@@ -165,6 +166,10 @@ class ShardedTrainer:
         a tuple means multi-input; lists are rejected as ambiguous. Each
         input is batch-sharded over the dp axes. Returns the (replicated)
         scalar loss as a host float-convertible array."""
+        # injection point BEFORE any state mutates: a fault leaves the
+        # trainer consistent, so restore-and-replay (resilience.resume)
+        # resumes from exactly the pre-step state
+        _chaos.point("trainer.step")
         if self._step_fn is None:
             self._build_step()
         if isinstance(data, list):
@@ -200,6 +205,7 @@ class ShardedTrainer:
         multi-input models (lists are rejected as ambiguous); label:
         (n_steps, batch, ...).
         """
+        _chaos.point("trainer.step")  # same pre-mutation contract as step()
         if self._step_many_fn is None:
             self._build_step_many()
         if isinstance(data, list):

@@ -1,0 +1,43 @@
+"""mxnet_tpu.resilience — fault injection, retry, circuit breaking, resume.
+
+The production-hardening layer over the serving and training paths
+(reference counterpart: the exception-propagation machinery threaded
+through `src/engine/threaded_engine.cc` on_complete — here grown into a
+subsystem):
+
+- :mod:`~mxnet_tpu.resilience.chaos` — named injection points
+  (``chaos.point("serving.execute")``) armed deterministically from tests
+  or ``MXNET_CHAOS_SPEC``, raising :class:`TransientFault` /
+  :class:`FatalFault` or injecting latency;
+- :mod:`~mxnet_tpu.resilience.retry` — :class:`RetryPolicy` (bounded
+  attempts, exponential backoff + seeded jitter, deadline), applied to the
+  batcher, engine, and kvstore;
+- :mod:`~mxnet_tpu.resilience.breaker` — :class:`CircuitBreaker`
+  (closed/open/half-open) behind ``ModelServer`` for 503 + Retry-After
+  fast-fail and ``/healthz`` degradation;
+- :mod:`~mxnet_tpu.resilience.resume` — :func:`resumable_fit`: periodic
+  sharded checkpoints with restore-and-replay on faults, bitwise-equal to
+  an uninterrupted run.
+
+All event counters flow into ``profiler.get_aggregate_stats()`` via the
+stats-provider hook, and into the serving ``/metrics`` endpoint.
+"""
+# import order matters: chaos has no intra-package deps; retry imports
+# chaos; breaker is standalone; resume imports chaos (parallel.checkpoint
+# lazily, inside the function, to keep this package import light).
+from .chaos import (Fault, TransientFault, FatalFault, SlowFault)
+from . import chaos
+from .retry import (RetryPolicy, RetryExhausted, retryable, named_policy,
+                    default_policy)
+from . import retry
+from .breaker import CircuitBreaker, CircuitOpen
+from . import breaker
+from .resume import resumable_fit, ResumeGaveUp, resume_stats
+from . import resume
+
+__all__ = ["chaos", "retry", "breaker", "resume",
+           "Fault", "TransientFault", "FatalFault", "SlowFault",
+           "RetryPolicy", "RetryExhausted", "retryable", "named_policy",
+           "default_policy",
+           "CircuitBreaker", "CircuitOpen",
+           "resumable_fit", "ResumeGaveUp", "resume_stats"]

@@ -1,0 +1,34 @@
+"""Shared registry + profiler hookup for the resilience modules.
+
+Each module registers named instances (policies, breakers) and exports
+their counters as aggregate-table rows through one provider registration;
+changes to either pattern (import guards, unregistration, weakrefs)
+happen here, not in per-module copies.
+"""
+import threading
+
+
+class Registry:
+    """Named-instance registry; latest instance wins per name, which keeps
+    the exported stats table bounded under test churn."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = {}
+
+    def add(self, obj):
+        with self._lock:
+            self._items[obj.name] = obj
+
+    def map(self, fn):
+        """``{name: fn(instance)}`` over a consistent snapshot."""
+        with self._lock:
+            items = dict(self._items)
+        return {name: fn(obj) for name, obj in items.items()}
+
+
+def export_rows(rows_fn):
+    """Register ``rows_fn() -> {row_name: (count, seconds)}`` with the
+    profiler's aggregate-stats provider hook."""
+    from .. import profiler
+    profiler.register_stats_provider(rows_fn)

@@ -1,0 +1,270 @@
+"""Fault injection: named chaos points armed from tests or the environment.
+
+Role parity: the reference's exception-propagation tests drive failures
+through the async engine by hand (`tests/python/unittest/test_exc_handling.py`
+raising inside custom ops so `threaded_engine.cc` on_complete error paths
+fire). Here the injection surface is first-class: production code declares
+*named points* (``chaos.point("serving.execute")``) that are free when
+disarmed, and tests/ops arm them with deterministic triggers — so every
+retry/breaker/resume behaviour is exercisable without real hardware faults.
+
+Injection points wired in this codebase:
+
+========================  ==================================================
+``serving.execute``       DynamicBatcher model execution (per attempt)
+``trainer.step``          ShardedTrainer.step / step_many entry
+``kvstore.push``          KVStore.push entry (per attempt)
+``kvstore.pull``          KVStore.pull entry (per attempt)
+``checkpoint.save``       between staging-dir write and atomic publish
+========================  ==================================================
+
+Arming — programmatic::
+
+    chaos.arm("serving.execute", "transient", first=2)   # first 2 calls
+    chaos.arm("trainer.step", "fatal", at=5)             # exactly call #5
+    chaos.arm("kvstore.push", "transient", every=3)      # calls 3, 6, 9...
+    chaos.arm("serving.execute", "transient", p=0.05, seed=0)  # seeded coin
+    chaos.arm("serving.execute", "slow", delay_ms=20, every=2)
+    chaos.clear()
+
+or via the environment (picked up at import and by :func:`arm_from_env`)::
+
+    MXNET_CHAOS_SPEC="serving.execute:transient:first=2;trainer.step:fatal:at=5"
+
+Grammar: ``point:kind[:trigger]`` rules joined by ``;``. ``kind`` is
+``transient`` | ``fatal`` | ``slow(<delay_ms>)``. ``trigger`` is one of
+``first=K`` (default ``first=1``), ``every=N``, ``at=K``, or ``p=R,seed=S``
+(deterministic seeded Bernoulli). ``transient``/``fatal`` raise
+:class:`TransientFault`/:class:`FatalFault`; ``slow`` injects latency
+(sleeps, then returns normally).
+
+Fire/call counters per point are exported to the profiler aggregate table
+(rows ``chaos.<point>.calls`` / ``chaos.<point>.fires``).
+"""
+from __future__ import annotations
+
+import random as _random
+import re
+import threading
+import time
+
+__all__ = ["Fault", "TransientFault", "FatalFault", "SlowFault",
+           "point", "arm", "arm_from_env", "clear", "stats", "active"]
+
+
+class Fault(Exception):
+    """Base class for injected faults."""
+
+
+class TransientFault(Fault):
+    """Injected failure that a retry is expected to absorb."""
+
+
+class FatalFault(Fault):
+    """Injected failure that models a crash: not retryable; recovery is
+    restore-and-replay (``resilience.resume``) or breaker fast-fail."""
+
+
+class SlowFault(Fault):
+    """Injected latency. Carried in specs/arm() as the ``slow`` kind; the
+    chaos point *sleeps* ``delay_ms`` instead of raising."""
+
+    def __init__(self, delay_ms=10.0):
+        super().__init__("injected slowness: %.1f ms" % delay_ms)
+        self.delay_ms = float(delay_ms)
+
+
+_KINDS = ("transient", "fatal", "slow")
+
+
+class _Rule:
+    """One armed injection rule: a fault kind plus a deterministic trigger
+    over this rule's own call counter."""
+
+    __slots__ = ("point", "kind", "delay_ms", "first", "every", "at",
+                 "p", "seed", "_rng", "calls", "fires", "message")
+
+    def __init__(self, point, kind, delay_ms=10.0, first=None, every=None,
+                 at=None, p=None, seed=0, message=None):
+        if kind not in _KINDS:
+            raise ValueError("unknown fault kind %r (want one of %s)"
+                             % (kind, "/".join(_KINDS)))
+        n_triggers = sum(x is not None for x in (first, every, at, p))
+        if n_triggers > 1:
+            raise ValueError("pick ONE trigger: first=/every=/at=/p=")
+        if n_triggers == 0:
+            first = 1
+        # reject triggers that silently never fire: an armed rule that
+        # injects nothing is the false confidence this framework exists
+        # to prevent
+        for label, v in (("first", first), ("every", every), ("at", at)):
+            if v is not None and int(v) < 1:
+                raise ValueError("%s=%s never fires (want >= 1)"
+                                 % (label, v))
+        if p is not None and not 0.0 < float(p) <= 1.0:
+            raise ValueError("p=%s never fires (want 0 < p <= 1)" % (p,))
+        self.point = point
+        self.kind = kind
+        self.delay_ms = float(delay_ms)
+        self.first = int(first) if first is not None else None
+        self.every = int(every) if every is not None else None
+        self.at = int(at) if at is not None else None
+        self.p = float(p) if p is not None else None
+        self.seed = int(seed)
+        self._rng = _random.Random(self.seed) if self.p is not None else None
+        self.calls = 0
+        self.fires = 0
+        self.message = message
+
+    def should_fire(self):
+        """Advance this rule's call counter and decide. Deterministic:
+        counters are per-rule and the Bernoulli stream is seeded."""
+        self.calls += 1
+        if self.first is not None:
+            return self.calls <= self.first
+        if self.every is not None:
+            return self.every > 0 and self.calls % self.every == 0
+        if self.at is not None:
+            return self.calls == self.at
+        return self._rng.random() < self.p
+
+    def fire(self):
+        # self.fires was already counted under the module lock in point()
+        msg = self.message or ("chaos[%s] injected %s (call #%d)"
+                               % (self.point, self.kind, self.calls))
+        if self.kind == "transient":
+            raise TransientFault(msg)
+        if self.kind == "fatal":
+            raise FatalFault(msg)
+        time.sleep(self.delay_ms / 1e3)  # slow: latency, not an error
+
+
+_lock = threading.Lock()
+_rules = {}          # point name -> list[_Rule]
+_armed = False       # fast-path flag: point() is a dict-miss when False
+_totals = {}         # point name -> [calls, fires], survives clear()
+
+
+def point(name):
+    """Declare an injection point. No-op (one attribute read) unless a rule
+    is armed for ``name``; otherwise may raise a :class:`Fault` or sleep."""
+    if not _armed:
+        return
+    with _lock:
+        rules = _rules.get(name)
+        if not rules:
+            return
+        to_fire = [r for r in rules if r.should_fire()]
+        for r in to_fire:
+            r.fires += 1  # counted here, under the lock
+        tot = _totals.setdefault(name, [0, 0])
+        tot[0] += 1
+        tot[1] += len(to_fire)
+    for r in to_fire:
+        r.fire()
+
+
+def arm(name, kind="transient", **kwargs):
+    """Arm one rule at injection point ``name``. Trigger kwargs: exactly one
+    of ``first=K`` / ``every=N`` / ``at=K`` / ``p=R[, seed=S]`` (default
+    ``first=1``); ``slow`` takes ``delay_ms``. Returns the rule (its
+    ``calls``/``fires`` counters are live)."""
+    global _armed
+    rule = _Rule(name, kind, **kwargs)
+    with _lock:
+        _rules.setdefault(name, []).append(rule)
+        _armed = True
+    return rule
+
+
+_SPEC_RE = re.compile(
+    r"^(?P<point>[\w.\-]+):(?P<kind>transient|fatal|slow(\((?P<delay>"
+    r"[0-9.]+)\))?)(:(?P<trig>[\w=.,\-]+))?$")
+
+
+def arm_from_env(spec=None):
+    """Parse ``MXNET_CHAOS_SPEC`` (or an explicit ``spec`` string) and arm
+    every rule in it. Returns the list of armed rules."""
+    if spec is None:
+        from .. import config as _config
+        spec = _config.get("MXNET_CHAOS_SPEC") or ""
+    rules = []
+    for part in str(spec).split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        m = _SPEC_RE.match(part)
+        if m is None:
+            raise ValueError(
+                "bad MXNET_CHAOS_SPEC rule %r: want "
+                "'point:kind[:trigger]' with kind transient|fatal|"
+                "slow(<delay_ms>) and trigger first=K|every=N|at=K|"
+                "p=R,seed=S" % part)
+        kind = m.group("kind")
+        kwargs = {}
+        if kind.startswith("slow"):
+            if m.group("delay") is not None:
+                kwargs["delay_ms"] = float(m.group("delay"))
+            kind = "slow"
+        trig = m.group("trig")
+        if trig:
+            for kv in trig.split(","):
+                k, _, v = kv.partition("=")
+                k = k.strip()
+                if k not in ("first", "every", "at", "p", "seed"):
+                    raise ValueError(
+                        "bad MXNET_CHAOS_SPEC trigger %r in rule %r"
+                        % (kv, part))
+                kwargs[k] = float(v) if k == "p" else int(v)
+        rules.append(arm(m.group("point"), kind, **kwargs))
+    return rules
+
+
+def clear():
+    """Disarm everything (lifetime fire totals are kept for the profiler)."""
+    global _armed
+    with _lock:
+        _rules.clear()
+        _armed = False
+
+
+def active():
+    """Currently armed rules as ``{point: [rule, ...]}`` (live objects)."""
+    with _lock:
+        return {k: list(v) for k, v in _rules.items()}
+
+
+def stats(lifetime=False):
+    """Per-point counters. Armed rules by default; ``lifetime=True`` returns
+    the totals that survive :func:`clear` (what the profiler exports).
+    With several rules armed on one point, armed-mode ``calls`` is the
+    point's invocation count since the OLDEST rule armed (every invocation
+    advances every rule, so that is ``max`` over rules — summing would
+    multiply-count one invocation); ``fires`` sums, each rule fires
+    separately."""
+    with _lock:
+        if lifetime:
+            return {k: {"calls": v[0], "fires": v[1]}
+                    for k, v in _totals.items()}
+        out = {}
+        for name, rules in _rules.items():
+            out[name] = {"calls": max(r.calls for r in rules),
+                         "fires": sum(r.fires for r in rules)}
+        return out
+
+
+def _profiler_rows():
+    rows = {}
+    for name, c in stats(lifetime=True).items():
+        rows["chaos.%s.calls" % name] = (c["calls"], 0.0)
+        rows["chaos.%s.fires" % name] = (c["fires"], 0.0)
+    return rows
+
+
+from ._stats import export_rows as _export_rows  # noqa: E402
+
+_export_rows(_profiler_rows)
+# spawned workers inherit MXNET_CHAOS_SPEC: arm at import so chaos reaches
+# code paths that never call arm() explicitly (a malformed spec raises —
+# that is a user error, not something to swallow)
+arm_from_env()

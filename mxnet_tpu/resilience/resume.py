@@ -1,0 +1,162 @@
+"""Training auto-resume: checkpoint every K steps, restore + replay on fault.
+
+Role parity: the reference pattern was `Module.fit` + per-epoch
+`do_checkpoint` callbacks, with resume a *manual* `--load-epoch` restart
+that lost everything since the last epoch boundary. Here resume is a loop
+property: :func:`resumable_fit` wraps ``ShardedTrainer.step`` with periodic
+sharded checkpoints (``parallel/checkpoint.py``) and, when a fault escapes
+a step (or a save), restores the last good checkpoint and replays the
+batches from the checkpointed step — the equivalence contract is that an
+interrupted-and-resumed run ends with **bitwise-identical** parameters to
+an uninterrupted run of the same seed and step count.
+
+Determinism notes:
+
+- ``save_checkpoint`` round-trips exact array bytes, and XLA re-executes
+  the same program on the same inputs, so replayed steps reproduce the
+  original trajectory exactly.
+- models that draw randomness inside the step (dropout) consume the global
+  RNG key stream; pass ``seed=`` and the loop re-seeds per step from
+  ``seed + absolute_step`` so a replayed step sees the key the original
+  attempt saw.
+
+Resume events are exported to the profiler aggregate table as
+``resilience.resume.{checkpoints,restores,replayed_steps}``.
+"""
+from __future__ import annotations
+
+import os
+import threading
+
+from .chaos import Fault
+
+__all__ = ["resumable_fit", "ResumeGaveUp", "resume_stats"]
+
+
+class ResumeGaveUp(RuntimeError):
+    """``max_restores`` consecutive restore-and-replay cycles failed to make
+    progress; ``__cause__`` is the last fault."""
+
+
+_lock = threading.Lock()
+_counters = {"checkpoints": 0, "restores": 0, "replayed_steps": 0,
+             "completed_runs": 0}
+
+
+def _count(key, n=1):
+    with _lock:
+        _counters[key] += n
+
+
+def resume_stats():
+    with _lock:
+        return dict(_counters)
+
+
+def resumable_fit(trainer, batches, ckpt_dir, ckpt_every=None,
+                  max_restores=8, seed=None, catch=(Fault,),
+                  on_restore=None):
+    """Run ``trainer.step`` over ``batches`` with checkpoint/restore/replay.
+
+    Parameters
+    ----------
+    trainer : ShardedTrainer
+        Stepped in place; its ``_t`` counter is the resume cursor.
+    batches : sequence of (data, label)
+        The full epoch, indexable — replay re-reads slices of it. (A
+        re-iterable dataset works via ``list(...)`` at the call site.)
+    ckpt_dir : str
+        Directory for the rolling checkpoint (one slot, atomically
+        replaced by ``save_checkpoint``).
+    ckpt_every : int, optional
+        Checkpoint cadence in steps (default: ``MXNET_RESUME_EVERY`` env
+        knob). The loop always checkpoints once *before* the first step so
+        a fault in step 1 has a restore target.
+    max_restores : int
+        Bound on restore cycles; exceeded → :class:`ResumeGaveUp`.
+    seed : int, optional
+        Re-seed the global RNG per step from ``seed + absolute_step`` so
+        in-step randomness (dropout) replays identically.
+    catch : tuple of exception types
+        What triggers restore-and-replay (default: injected
+        :class:`~mxnet_tpu.resilience.chaos.Fault` of either kind — a real
+        deployment would list device/runtime errors here too).
+    on_restore : callable, optional
+        ``on_restore(step, exc)`` hook after each successful restore.
+
+    Returns
+    -------
+    list of float
+        Per-batch losses, as finally computed (replayed steps overwrite
+        their earlier, lost values).
+    """
+    from ..parallel.checkpoint import save_checkpoint, restore_checkpoint
+    from .. import random as _rnd
+
+    if ckpt_every is None:
+        from .. import config as _config
+        ckpt_every = _config.get("MXNET_RESUME_EVERY")
+    if ckpt_every < 1:
+        raise ValueError("ckpt_every must be >= 1")
+    os.makedirs(ckpt_dir, exist_ok=True)
+    ckpt = os.path.join(os.path.abspath(ckpt_dir), "resume_ckpt")
+
+    t0 = trainer._t
+    total = len(batches)
+    losses = [None] * total
+    # restore target for a first-step fault; the save itself honors the
+    # fault contract — nothing has mutated yet, so recovery is re-attempt
+    for attempt in range(max_restores + 1):
+        try:
+            save_checkpoint(trainer, ckpt)
+            break
+        except catch as exc:
+            if attempt >= max_restores:
+                raise ResumeGaveUp(
+                    "initial checkpoint failed %d time(s)" % (attempt + 1)
+                ) from exc
+    _count("checkpoints")
+    restores = 0
+    replaying_until = 0  # batch indices below this were stepped before
+
+    while trainer._t - t0 < total:
+        i = trainer._t - t0
+        try:
+            if seed is not None:
+                # key for the step ABOUT to run (absolute step index
+                # trainer._t + 1): replay regenerates the same stream
+                _rnd.seed(int(seed) + trainer._t + 1)
+            x, y = batches[i]
+            loss = trainer.step(x, y)
+            losses[i] = float(loss.asnumpy()) if hasattr(loss, "asnumpy") \
+                else float(loss)
+            if i < replaying_until:
+                _count("replayed_steps")
+            done = trainer._t - t0
+            if done % ckpt_every == 0 or done == total:
+                save_checkpoint(trainer, ckpt)
+                _count("checkpoints")
+                restores = 0  # progress was durably made; reset the budget
+        except catch as exc:
+            restores += 1
+            if restores > max_restores:
+                raise ResumeGaveUp(
+                    "no progress after %d restore(s) at step %d"
+                    % (restores - 1, trainer._t)) from exc
+            restore_checkpoint(trainer, ckpt)
+            _count("restores")
+            replaying_until = max(replaying_until, i + 1)
+            if on_restore is not None:
+                on_restore(trainer._t, exc)
+    _count("completed_runs")
+    return losses
+
+
+def _profiler_rows():
+    st = resume_stats()
+    return {("resilience.resume.%s" % k): (v, 0.0) for k, v in st.items()}
+
+
+from ._stats import export_rows as _export_rows  # noqa: E402
+
+_export_rows(_profiler_rows)

@@ -18,6 +18,15 @@ Robustness contract (the part load balancers care about):
 - **Drain on shutdown**: ``close()`` stops intake, lets the worker finish
   everything already queued, then joins — in-flight requests complete;
   ``close(drain=False)`` fails queued requests with :class:`ServerClosed`.
+- **Retry under faults**: transient model failures (injected via the
+  ``serving.execute`` chaos point, or real ones listed in the policy's
+  ``retryable``) re-run the whole coalesced batch under a
+  :class:`~mxnet_tpu.resilience.retry.RetryPolicy` before waiters see an
+  error.
+- **The worker never dies silently**: an unexpected exception anywhere in
+  the worker loop fails the in-flight batch's waiters, drains the queue
+  with :class:`ServerClosed`, and marks the batcher closed — blocked
+  ``submit()`` callers are never stranded on a dead thread.
 
 Requests carry ONE sample each (no batch axis); results come back as the
 matching row of the model output, as numpy (host) arrays — the batcher is
@@ -31,6 +40,9 @@ from collections import deque
 from concurrent.futures import Future
 
 import numpy as _np
+
+from ..resilience import chaos as _chaos
+from ..resilience import retry as _retry
 
 __all__ = ["DynamicBatcher", "ServingError", "ServerBusy",
            "DeadlineExceeded", "ServerClosed"]
@@ -88,22 +100,31 @@ class DynamicBatcher:
     metrics : ServingMetrics, optional
         Records request latency, batch occupancy, rejections, expiries,
         and exposes live queue depth.
+    retry_policy : RetryPolicy, optional
+        Applied around each batch execution. ``None`` (default) builds one
+        from the ``MXNET_RETRY_*`` env knobs retrying
+        :class:`~mxnet_tpu.resilience.chaos.TransientFault`; pass ``False``
+        to disable retries entirely.
     """
 
     def __init__(self, fn, max_batch_size=32, max_latency_ms=5.0,
                  max_queue_size=128, default_timeout_ms=None, metrics=None,
-                 name="dynamic_batcher"):
+                 retry_policy=None, name="dynamic_batcher"):
         if max_batch_size < 1:
             raise ValueError("max_batch_size must be >= 1")
         if max_queue_size < 1:
             raise ValueError("max_queue_size must be >= 1")
         self._fn = fn
+        if retry_policy is None:
+            retry_policy = _retry.named_policy("retry.batcher")
+        self._retry = retry_policy or None
         self._max_batch = int(max_batch_size)
         self._max_latency_s = max_latency_ms / 1e3
         self._max_queue = int(max_queue_size)
         self._default_timeout_ms = default_timeout_ms
         self._metrics = metrics
         self._queue = deque()
+        self._inflight = ()  # batch the worker is executing right now
         self._lock = threading.Lock()
         self._not_empty = threading.Condition(self._lock)
         self._closing = False
@@ -148,17 +169,33 @@ class DynamicBatcher:
     def close(self, drain=True, timeout=None):
         """Stop intake; with ``drain`` the worker finishes the backlog
         before exiting, otherwise queued requests fail with
-        :class:`ServerClosed`. Idempotent."""
+        :class:`ServerClosed`. ``timeout`` bounds the drain: when it
+        expires with work still queued, the stragglers are failed with
+        :class:`ServerClosed` rather than left blocked forever. Returns
+        True when the worker exited cleanly. Idempotent."""
         with self._lock:
             self._closing = True
             self._drain = drain
             if not drain:
                 while self._queue:
                     req = self._queue.popleft()
-                    req.future.set_exception(
-                        ServerClosed("batcher shut down before execution"))
+                    self._resolve(req.future, exc=ServerClosed(
+                        "batcher shut down before execution"))
             self._not_empty.notify_all()
         self._worker.join(timeout)
+        if self._worker.is_alive():
+            # bounded drain expired: never strand waiters — fail what is
+            # still queued AND the batch wedged inside the model call (the
+            # worker's own resolve later is a tolerated no-op)
+            with self._lock:
+                stranded = list(self._queue) + list(self._inflight)
+                self._queue.clear()
+            for req in stranded:
+                self._resolve(req.future, exc=ServerClosed(
+                    "drain timed out after %.1fs with request unfinished"
+                    % (timeout,)))
+            return False
+        return True
 
     def __enter__(self):
         return self
@@ -209,6 +246,10 @@ class DynamicBatcher:
             leftover.extend(self._queue)
             self._queue.clear()
             self._queue.extend(leftover)
+            # recorded under the SAME lock that popped the batch: close()
+            # must always see these requests in _queue or _inflight, never
+            # in neither (the never-strand-waiters contract)
+            self._inflight = tuple(batch)
             return batch, expired
 
     def _drop_expired_locked(self, expired):
@@ -222,30 +263,92 @@ class DynamicBatcher:
                 kept.append(req)
         self._queue.extend(kept)
 
+    @staticmethod
+    def _resolve(future, result=None, exc=None):
+        """Set a future's outcome, tolerating callers that already
+        cancelled it — a cancelled waiter must never kill the worker."""
+        try:
+            if exc is not None:
+                future.set_exception(exc)
+            else:
+                future.set_result(result)
+        except Exception:  # InvalidStateError: waiter cancelled — fine
+            pass
+
     def _run(self):
-        while True:
-            batch, expired = self._take_batch()
-            for req in expired:
-                if self._metrics is not None:
-                    self._metrics.record_expired()
-                req.future.set_exception(DeadlineExceeded(
-                    "request expired after queueing %.1f ms"
-                    % ((time.monotonic() - req.enqueue_t) * 1e3)))
-            if batch is None:
-                return  # closed and (if draining) queue empty
-            if not batch:
-                continue
-            self._execute(batch)
+        # Robustness contract: this thread is the only executor for every
+        # blocked submit() caller, so NO exception may terminate it without
+        # first resolving all reachable futures and closing intake.
+        try:
+            while True:
+                batch, expired = self._take_batch()
+                for req in expired:
+                    if self._metrics is not None:
+                        try:
+                            self._metrics.record_expired()
+                        except Exception:
+                            pass
+                    self._resolve(req.future, exc=DeadlineExceeded(
+                        "request expired after queueing %.1f ms"
+                        % ((time.monotonic() - req.enqueue_t) * 1e3)))
+                if batch is None:
+                    return  # closed and (if draining) queue empty
+                if not batch:
+                    continue
+                try:
+                    self._execute(batch)
+                except BaseException as exc:  # _execute's guards failed too
+                    for req in batch:
+                        self._resolve(req.future, exc=exc)
+                    raise
+                finally:
+                    with self._lock:
+                        self._inflight = ()
+        except BaseException as exc:  # worker would die: close, don't strand
+            self._abort(exc)
+
+    def _abort(self, exc):
+        """Unexpected worker failure: transition to closed so future
+        submitters fail fast, and fail everything still queued — no
+        submit() caller is ever left blocked on a dead worker."""
+        with self._lock:
+            self._closing = True
+            stranded = list(self._queue) + list(self._inflight)
+            self._queue.clear()
+            self._inflight = ()
+        if self._metrics is not None:
+            try:
+                self._metrics.record_worker_error()
+            except Exception:
+                pass
+        err = ServerClosed("batcher worker died: %s: %s"
+                           % (type(exc).__name__, exc))
+        err.__cause__ = exc
+        for req in stranded:
+            self._resolve(req.future, exc=err)
 
     def _execute(self, batch):
         try:
             n_inputs = len(batch[0].inputs)
             stacked = [_np.stack([r.inputs[i] for r in batch], axis=0)
                        for i in range(n_inputs)]
-            out = self._fn(*stacked)
-            multi = isinstance(out, (list, tuple))
-            outs = [_np.asarray(o.asnumpy() if hasattr(o, "asnumpy") else o)
-                    for o in (out if multi else [out])]
+
+            def run_model():
+                # chaos point INSIDE the retried callable: each retry
+                # attempt re-rolls the injection (first-K/every-Nth count
+                # attempts), so armed transient faults are absorbed here
+                _chaos.point("serving.execute")
+                out = self._fn(*stacked)
+                multi = isinstance(out, (list, tuple))
+                outs = [_np.asarray(o.asnumpy()
+                                    if hasattr(o, "asnumpy") else o)
+                        for o in (out if multi else [out])]
+                return outs, multi
+
+            if self._retry is not None:
+                outs, multi = self._retry.call(run_model)
+            else:
+                outs, multi = run_model()
             for o in outs:
                 if o.shape[0] != len(batch):
                     raise ValueError(
@@ -255,15 +358,26 @@ class DynamicBatcher:
         except Exception as exc:  # noqa: BLE001 — fail the whole batch
             for req in batch:
                 if self._metrics is not None:
-                    self._metrics.record_request(
-                        time.monotonic() - req.enqueue_t, ok=False)
-                req.future.set_exception(exc)
+                    try:
+                        self._metrics.record_request(
+                            time.monotonic() - req.enqueue_t, ok=False)
+                    except Exception:
+                        pass
+                self._resolve(req.future, exc=exc)
             return
-        if self._metrics is not None:
-            self._metrics.record_batch(len(batch), self._max_batch)
-        done_t = time.monotonic()
-        for i, req in enumerate(batch):
-            row = tuple(o[i] for o in outs) if multi else outs[0][i]
+        # past this point waiters MUST be resolved: a metrics failure may
+        # not strand them (satellite: worker-thread death fix)
+        try:
             if self._metrics is not None:
-                self._metrics.record_request(done_t - req.enqueue_t, ok=True)
-            req.future.set_result(row)
+                self._metrics.record_batch(len(batch), self._max_batch)
+            done_t = time.monotonic()
+            for i, req in enumerate(batch):
+                row = tuple(o[i] for o in outs) if multi else outs[0][i]
+                if self._metrics is not None:
+                    self._metrics.record_request(done_t - req.enqueue_t,
+                                                 ok=True)
+                self._resolve(req.future, result=row)
+        except Exception as exc:
+            for req in batch:
+                if not req.future.done():
+                    self._resolve(req.future, exc=exc)

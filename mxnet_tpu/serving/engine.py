@@ -29,6 +29,7 @@ import numpy as _np
 
 from ..cached_op import CachedOp
 from ..ndarray import ndarray as _nd
+from ..resilience import retry as _retry
 
 __all__ = ["InferenceEngine", "DEFAULT_BUCKETS"]
 
@@ -63,10 +64,17 @@ class InferenceEngine:
         already internally hybridized.
     metrics : ServingMetrics, optional
         If given, its executor-cache gauge is wired to :meth:`stats`.
+    retry_policy : RetryPolicy, optional
+        Wrapped around every bucketed execution in :meth:`predict` so
+        transient model faults are absorbed per chunk. ``None`` (default)
+        uses the env-configured ``retry.engine`` policy; ``False`` disables.
     """
 
     def __init__(self, model, buckets=DEFAULT_BUCKETS, jit=True,
-                 metrics=None, name="inference_engine"):
+                 metrics=None, retry_policy=None, name="inference_engine"):
+        if retry_policy is None:
+            retry_policy = _retry.named_policy("retry.engine")
+        self._retry = retry_policy or None
         if not buckets:
             raise ValueError("need at least one bucket size")
         self._buckets = sorted(set(int(b) for b in buckets))
@@ -154,15 +162,17 @@ class InferenceEngine:
         n = arrays[0].shape[0]
         if n == 0:
             raise ValueError("empty batch")
+        run = (self._run_bucketed if self._retry is None
+               else lambda a: self._retry.call(self._run_bucketed, a))
         cap = self._buckets[-1]
         if n <= cap:
-            outs, multi = self._run_bucketed(arrays)
+            outs, multi = run(arrays)
             return (outs if multi else outs[0])
         chunks = []
         multi = False
         for start in range(0, n, cap):
             part = [a[start:min(start + cap, n)] for a in arrays]
-            outs, multi = self._run_bucketed(part)
+            outs, multi = run(part)
             chunks.append(outs)
         merged = [_nd.concat(*[c[i] for c in chunks], dim=0)
                   for i in range(len(chunks[0]))]

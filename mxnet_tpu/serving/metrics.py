@@ -37,12 +37,14 @@ class ServingMetrics:
         self._lock = threading.Lock()
         self._window = deque(maxlen=window)  # (done_t, latency_s)
         self._c = {"requests": 0, "ok": 0, "errors": 0, "rejected": 0,
-                   "expired": 0, "batches": 0, "batched_rows": 0}
+                   "expired": 0, "batches": 0, "batched_rows": 0,
+                   "worker_errors": 0}
         self._latency_total = 0.0
         self._occupancy_total = 0.0  # sum over batches of rows/capacity
         self._t0 = time.time()
         self._queue_depth_fn = None
         self._cache_stats_fn = None
+        self._gauge_fns = {}
         self._bound_provider = None
 
     # ---- recording (hot path) ---------------------------------------------
@@ -63,6 +65,12 @@ class ServingMetrics:
         with self._lock:
             self._c["expired"] += 1
 
+    def record_worker_error(self):
+        """Batcher worker hit an unexpected exception and closed (the
+        robustness contract converted it into ServerClosed for waiters)."""
+        with self._lock:
+            self._c["worker_errors"] += 1
+
     def record_batch(self, rows, capacity):
         """One coalesced execution of ``rows`` requests (capacity =
         max_batch_size); occupancy = rows/capacity."""
@@ -78,6 +86,13 @@ class ServingMetrics:
     def set_cache_stats_fn(self, fn):
         """``fn()`` -> executor-cache dict (``InferenceEngine.stats``)."""
         self._cache_stats_fn = fn
+
+    def set_gauge_fn(self, name, fn):
+        """Attach a named gauge callback (``fn()`` -> JSON-able value),
+        pulled at snapshot time — how breaker state and retry counters
+        reach the ``/metrics`` endpoint without this module holding
+        references into other subsystems' locks."""
+        self._gauge_fns[name] = fn
 
     # ---- reading ----------------------------------------------------------
     def percentiles(self, qs=(50, 95, 99)):
@@ -133,6 +148,11 @@ class ServingMetrics:
                 out["executor_cache"] = self._cache_stats_fn()
             except Exception:
                 out["executor_cache"] = None
+        for gname, fn in self._gauge_fns.items():
+            try:
+                out[gname] = fn()
+            except Exception:
+                out[gname] = None
         return out
 
     # ---- profiler integration ---------------------------------------------
@@ -148,6 +168,7 @@ class ServingMetrics:
             prefix + ".batches": (c["batches"], 0.0),
             prefix + ".rejected": (c["rejected"], 0.0),
             prefix + ".expired": (c["expired"], 0.0),
+            prefix + ".worker_errors": (c["worker_errors"], 0.0),
         }
         if self._cache_stats_fn is not None:
             try:

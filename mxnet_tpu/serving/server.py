@@ -15,9 +15,18 @@ Endpoints (JSON):
   ``{"output": [...]}`` (or ``{"outputs": [...]}``). Typed failures map
   to load-balancer-friendly codes: ServerBusy→503, DeadlineExceeded→504,
   malformed input→400.
-- ``GET /healthz`` — liveness.
+- ``GET /healthz`` — liveness + degradation: ``{"status": "ok"}`` in
+  normal service, ``"degraded"`` (with breaker state) while the circuit
+  breaker is open/half-open, ``"draining"`` during shutdown — load
+  balancers key off the status field to drain the instance.
 - ``GET /metrics`` — ``ServingMetrics.snapshot()`` (QPS, latency
-  percentiles, occupancy, queue depth, executor-cache counters).
+  percentiles, occupancy, queue depth, executor-cache counters, retry
+  counters, breaker state).
+
+Resilience: model failures feed a
+:class:`~mxnet_tpu.resilience.breaker.CircuitBreaker`; while it is open,
+``/predict`` fast-fails with 503 + ``Retry-After`` instead of queueing
+doomed work, then half-open probes let real traffic close it again.
 """
 from __future__ import annotations
 
@@ -27,6 +36,8 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as _np
 
+from ..resilience import retry as _retry
+from ..resilience.breaker import CircuitBreaker
 from .batcher import (DeadlineExceeded, DynamicBatcher, ServerBusy,
                       ServerClosed)
 from .engine import InferenceEngine
@@ -42,18 +53,20 @@ class _Handler(BaseHTTPRequestHandler):
     def log_message(self, fmt, *args):  # quiet: metrics replace access logs
         pass
 
-    def _reply(self, code, payload):
+    def _reply(self, code, payload, headers=None):
         body = json.dumps(payload).encode("utf-8")
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
         self.end_headers()
         self.wfile.write(body)
 
     def do_GET(self):  # noqa: N802 (http.server API)
         srv = self.server.model_server
         if self.path == "/healthz":
-            self._reply(200, {"status": "ok"})
+            self._reply(200, srv.health())
         elif self.path == "/metrics":
             self._reply(200, srv.metrics.snapshot())
         else:
@@ -61,12 +74,31 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_POST(self):  # noqa: N802
         srv = self.server.model_server
+        # consume the body FIRST: an early reply with the body still unread
+        # desyncs HTTP/1.1 keep-alive (the next request on the connection
+        # would be parsed starting at the leftover body bytes)
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            if length < 0:  # read(-1) would block until client EOF
+                raise ValueError("negative Content-Length")
+            body = self.rfile.read(length)
+        except (ValueError, TypeError):
+            self.close_connection = True  # unknown length: can't resync
+            self._reply(400, {"error": "bad Content-Length"})
+            return
         if self.path != "/predict":
             self._reply(404, {"error": "unknown path %s" % self.path})
             return
+        if srv.draining:
+            # shutdown in progress: shed new work BEFORE the socket goes
+            # away so clients get a clean 503, not a connection reset
+            self._reply(503, {"error": "server draining"},
+                        headers={"Retry-After": "1"})
+            return
+        # parse BEFORE breaker admission: a malformed body (400) must
+        # never hold a half-open probe slot
         try:
-            length = int(self.headers.get("Content-Length", 0))
-            payload = json.loads(self.rfile.read(length) or b"{}")
+            payload = json.loads(body or b"{}")
             if "inputs" in payload:
                 raw = payload["inputs"]
             elif "data" in payload:
@@ -79,20 +111,42 @@ class _Handler(BaseHTTPRequestHandler):
         except (ValueError, TypeError, KeyError, json.JSONDecodeError) as e:
             self._reply(400, {"error": str(e)})
             return
+        breaker = srv.breaker
+        admission = breaker.allow() if breaker is not None else True
+        if not admission:
+            retry_after = max(1, int(round(breaker.retry_after_s())))
+            snap = breaker.snapshot()
+            self._reply(503, {"error": "circuit open: %s" % snap["state"],
+                              "breaker": snap},
+                        headers={"Retry-After": str(retry_after)})
+            return
         try:
             row = srv.batcher.predict(*inputs, timeout_ms=timeout_ms)
         except ServerBusy as e:
-            self._reply(503, {"error": str(e)})
+            # backpressure, not a model fault: the breaker must not trip
+            if breaker is not None:
+                breaker.release(admission)
+            self._reply(503, {"error": str(e)},
+                        headers={"Retry-After": "1"})
             return
         except DeadlineExceeded as e:
+            if breaker is not None:
+                breaker.release(admission)
             self._reply(504, {"error": str(e)})
             return
         except ServerClosed as e:
-            self._reply(503, {"error": str(e)})
+            if breaker is not None:
+                breaker.release(admission)
+            self._reply(503, {"error": str(e)},
+                        headers={"Retry-After": "1"})
             return
         except Exception as e:  # noqa: BLE001 — model failure
+            if breaker is not None:
+                breaker.record_failure(admission)
             self._reply(500, {"error": "%s: %s" % (type(e).__name__, e)})
             return
+        if breaker is not None:
+            breaker.record_success(admission)
         if isinstance(row, tuple):
             self._reply(200, {"outputs": [_np.asarray(r).tolist()
                                           for r in row]})
@@ -101,17 +155,25 @@ class _Handler(BaseHTTPRequestHandler):
 
 
 class ModelServer:
-    """Wire engine + batcher + metrics behind one HTTP listener.
+    """Wire engine + batcher + metrics + breaker behind one HTTP listener.
 
     ``model`` may be an :class:`InferenceEngine` (pre-configured buckets /
     warmup) or any batched callable, in which case an engine is built with
     ``buckets``. ``port=0`` picks an ephemeral port (tests).
+
+    ``breaker=None`` (default) builds a :class:`CircuitBreaker` from the
+    ``MXNET_BREAKER_*`` env knobs (set ``MXNET_BREAKER_FAILURE_THRESHOLD``
+    <= 0 to disable); pass a configured breaker, or ``False`` to disable
+    explicitly. ``retry_policy`` is forwarded to the batcher — the single
+    retry layer in this stack; an engine built here gets
+    ``retry_policy=False`` (pass a pre-built engine to layer differently).
     """
 
     def __init__(self, model, host="127.0.0.1", port=8080,
                  buckets=None, jit=True, max_batch_size=32,
                  max_latency_ms=5.0, max_queue_size=128,
                  default_timeout_ms=None, metrics=None,
+                 breaker=None, retry_policy=None,
                  bind_profiler=True):
         self.metrics = metrics or ServingMetrics()
         if isinstance(model, InferenceEngine):
@@ -119,19 +181,52 @@ class ModelServer:
             self.metrics.set_cache_stats_fn(self.engine.stats)
         else:
             from .engine import DEFAULT_BUCKETS
+            # retry lives at the batcher layer here (it re-runs the whole
+            # coalesced batch); a second engine-level policy underneath
+            # would only multiply attempts and split the counters
             self.engine = InferenceEngine(
                 model, buckets=buckets or DEFAULT_BUCKETS, jit=jit,
-                metrics=self.metrics)
+                metrics=self.metrics, retry_policy=False)
+        if breaker is None:
+            from .. import config as _config
+            threshold = _config.get("MXNET_BREAKER_FAILURE_THRESHOLD")
+            breaker = CircuitBreaker(
+                failure_threshold=threshold,
+                recovery_ms=_config.get("MXNET_BREAKER_RECOVERY_MS"),
+                half_open_probes=_config.get(
+                    "MXNET_BREAKER_HALF_OPEN_PROBES"),
+                name="serving") if threshold > 0 else False
+        self.breaker = breaker or None
+        if self.breaker is not None:
+            self.metrics.set_gauge_fn("breaker", self.breaker.snapshot)
+        self.metrics.set_gauge_fn("retry", _retry.all_stats)
         if bind_profiler:
             self.metrics.bind_profiler()
+        self._draining = False
         self.batcher = DynamicBatcher(
             self.engine, max_batch_size=max_batch_size,
             max_latency_ms=max_latency_ms, max_queue_size=max_queue_size,
-            default_timeout_ms=default_timeout_ms, metrics=self.metrics)
+            default_timeout_ms=default_timeout_ms, metrics=self.metrics,
+            retry_policy=retry_policy)
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
         self._httpd.daemon_threads = True
         self._httpd.model_server = self
         self._thread = None
+
+    @property
+    def draining(self):
+        return self._draining
+
+    def health(self):
+        """The ``/healthz`` payload: ``ok`` | ``degraded`` | ``draining``
+        (+ breaker state when degraded) — the drain signal for LBs."""
+        if self._draining:
+            return {"status": "draining"}
+        if self.breaker is not None:
+            snap = self.breaker.snapshot()
+            if snap["state"] != "closed":
+                return {"status": "degraded", "breaker": snap}
+        return {"status": "ok"}
 
     @property
     def address(self):
@@ -161,15 +256,22 @@ class ModelServer:
         finally:
             self.stop()
 
-    def stop(self, drain=True):
-        """Stop the listener, then shut the batcher down (draining
-        in-flight work by default)."""
+    def stop(self, drain=True, timeout=10.0):
+        """Graceful shutdown, bounded by ``timeout`` seconds.
+
+        Order matters: first flip :attr:`draining` so new POSTs are shed
+        with 503 (instead of racing the socket close), then drain the
+        batcher — in-flight requests complete and their HTTP responses go
+        out over the still-open listener — and only then stop the
+        listener. ``drain=False`` fails queued work immediately with
+        ``ServerClosed``."""
+        self._draining = True
+        self.batcher.close(drain=drain, timeout=timeout)
         self._httpd.shutdown()
         self._httpd.server_close()
         if self._thread is not None:
             self._thread.join(5.0)
             self._thread = None
-        self.batcher.close(drain=drain)
         self.metrics.unbind_profiler()
 
     def __enter__(self):

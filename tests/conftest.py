@@ -80,6 +80,11 @@ def pytest_configure(config):
         "markers",
         "slow: long end-to-end tests excluded from the tier-1 sweep "
         "(run explicitly with -m slow)")
+    config.addinivalue_line(
+        "markers",
+        "chaos: tests that arm fault-injection points "
+        "(mxnet_tpu.resilience.chaos) — deselect with -m 'not chaos' when "
+        "debugging unrelated failures")
 
 
 @pytest.fixture(autouse=True)

@@ -1,0 +1,870 @@
+"""mxnet_tpu.resilience tests — chaos determinism, retry backoff schedule
+(fake clock, no real sleeps), circuit-breaker state machine incl. the
+half-open probe, serving end-to-end under injected transient faults, and
+resume-equivalence (interrupted-and-resumed training == uninterrupted).
+
+Covers the ISSUE-2 acceptance criteria on the CPU oracle:
+(a) with transient faults injected into ``serving.execute`` every client
+    request still succeeds (retry) or fast-fails 503 while the breaker is
+    open — zero hung submit() callers, zero dead worker threads;
+(b) a run killed by an injected fault and resumed via ``resumable_fit``
+    ends with parameters identical to an uninterrupted run;
+(c) retry/breaker/resume counters visible in
+    ``profiler.get_aggregate_stats()`` and the serving ``/metrics``.
+"""
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, nd, parallel
+from mxnet_tpu.resilience import (CircuitBreaker, FatalFault, ResumeGaveUp,
+                                  RetryExhausted, RetryPolicy, SlowFault,
+                                  TransientFault, chaos, resumable_fit,
+                                  retryable)
+from mxnet_tpu.resilience import breaker as breaker_mod
+from mxnet_tpu.resilience import resume as resume_mod
+from mxnet_tpu.resilience import retry as retry_mod
+from mxnet_tpu.serving import (DynamicBatcher, InferenceEngine, ModelServer,
+                               ServerClosed, ServingMetrics)
+
+pytestmark = []
+
+
+@pytest.fixture(autouse=True)
+def _disarm_chaos():
+    """Chaos state is process-global: every test starts and ends clean."""
+    chaos.clear()
+    yield
+    chaos.clear()
+
+
+def _fast_policy(**kw):
+    kw.setdefault("max_attempts", 4)
+    kw.setdefault("base_delay_ms", 0.5)
+    kw.setdefault("name", "test")
+    kw.setdefault("register", False)
+    return RetryPolicy(**kw)
+
+
+# ---------------------------------------------------------------------------
+# chaos: deterministic triggers, spec grammar, counters
+# ---------------------------------------------------------------------------
+
+def _fire_count(point, n):
+    fired = 0
+    for _ in range(n):
+        try:
+            chaos.point(point)
+        except (TransientFault, FatalFault):
+            fired += 1
+    return fired
+
+
+def test_chaos_disarmed_is_noop():
+    for _ in range(3):
+        chaos.point("never.armed")  # must not raise
+    assert "never.armed" not in chaos.stats()
+
+
+def test_chaos_first_k():
+    chaos.arm("p.first", "transient", first=2)
+    hits = [isinstance(_try_point("p.first"), TransientFault)
+            for _ in range(5)]
+    assert hits == [True, True, False, False, False]
+    st = chaos.stats()["p.first"]
+    assert st["calls"] == 5 and st["fires"] == 2
+
+
+def _try_point(name):
+    try:
+        chaos.point(name)
+    except Exception as e:  # noqa: BLE001
+        return e
+    return None
+
+
+def test_chaos_every_nth():
+    chaos.arm("p.every", "transient", every=3)
+    hits = [isinstance(_try_point("p.every"), TransientFault)
+            for _ in range(9)]
+    assert hits == [False, False, True, False, False, True,
+                    False, False, True]
+
+
+def test_chaos_at_exact_call():
+    chaos.arm("p.at", "fatal", at=3)
+    hits = [isinstance(_try_point("p.at"), FatalFault) for _ in range(6)]
+    assert hits == [False, False, True, False, False, False]
+
+
+def test_chaos_seeded_probability_is_deterministic():
+    chaos.arm("p.probA", "transient", p=0.5, seed=7)
+    seq_a = [isinstance(_try_point("p.probA"), TransientFault)
+             for _ in range(32)]
+    chaos.clear()
+    chaos.arm("p.probA", "transient", p=0.5, seed=7)
+    seq_b = [isinstance(_try_point("p.probA"), TransientFault)
+             for _ in range(32)]
+    assert seq_a == seq_b
+    assert 0 < sum(seq_a) < 32  # actually stochastic, not all/none
+
+
+def test_chaos_slow_injects_latency_not_error():
+    chaos.arm("p.slow", "slow", delay_ms=30, first=1)
+    t0 = time.monotonic()
+    chaos.point("p.slow")  # sleeps, does not raise
+    assert time.monotonic() - t0 >= 0.025
+    t0 = time.monotonic()
+    chaos.point("p.slow")  # rule exhausted (first=1): immediate
+    assert time.monotonic() - t0 < 0.02
+
+
+def test_chaos_env_spec_grammar(monkeypatch):
+    rules = chaos.arm_from_env(
+        "serving.execute:transient:first=2;"
+        "trainer.step:fatal:at=5;"
+        "kvstore.push:slow(15):every=4;"
+        "checkpoint.save:transient:p=0.25,seed=3")
+    assert len(rules) == 4
+    kinds = {r.point: r.kind for r in rules}
+    assert kinds == {"serving.execute": "transient", "trainer.step": "fatal",
+                     "kvstore.push": "slow", "checkpoint.save": "transient"}
+    assert rules[2].delay_ms == 15.0
+    assert rules[3].p == 0.25 and rules[3].seed == 3
+    # the armed rule actually fires
+    assert isinstance(_try_point("serving.execute"), TransientFault)
+
+
+def test_chaos_rejects_never_firing_triggers():
+    """Regression: first=0/every=0/at=0/p=0 arm a rule that injects
+    nothing — reject them instead of faking fault coverage."""
+    for kwargs in ({"first": 0}, {"every": 0}, {"at": 0},
+                   {"p": 0.0}, {"p": 1.5}):
+        with pytest.raises(ValueError, match="never fires"):
+            chaos.arm("p.dead", "transient", **kwargs)
+    with pytest.raises(ValueError, match="never fires"):
+        chaos.arm_from_env("p.dead:transient:first=0")
+
+
+def test_chaos_env_spec_rejects_garbage():
+    with pytest.raises(ValueError, match="MXNET_CHAOS_SPEC"):
+        chaos.arm_from_env("serving.execute:explode")
+    with pytest.raises(ValueError, match="trigger"):
+        chaos.arm_from_env("serving.execute:transient:whenever=1")
+    with pytest.raises(ValueError):
+        chaos.arm("x", "transient", first=1, every=2)  # two triggers
+
+
+def test_chaos_spec_via_config_env(monkeypatch):
+    monkeypatch.setenv("MXNET_CHAOS_SPEC", "env.point:transient:first=1")
+    rules = chaos.arm_from_env()
+    assert len(rules) == 1 and rules[0].point == "env.point"
+    assert isinstance(_try_point("env.point"), TransientFault)
+
+
+# ---------------------------------------------------------------------------
+# retry: schedule (fake clock — zero real sleeping), semantics, stats
+# ---------------------------------------------------------------------------
+
+def test_retry_succeeds_after_transients_and_matches_schedule():
+    sleeps = []
+    pol = _fast_policy(max_attempts=5, base_delay_ms=10, multiplier=2,
+                       jitter=0.25, seed=11, sleep=sleeps.append)
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] <= 3:
+            raise TransientFault("boom %d" % calls["n"])
+        return "ok"
+
+    assert pol.call(flaky) == "ok"
+    assert calls["n"] == 4
+    # the recorded sleeps are exactly the policy's published schedule
+    expected_ms = RetryPolicy(max_attempts=5, base_delay_ms=10,
+                              multiplier=2, jitter=0.25, seed=11,
+                              register=False).schedule()[:3]
+    np.testing.assert_allclose([s * 1e3 for s in sleeps], expected_ms)
+    # exponential shape survives jitter in [1-j, 1]: delay k in
+    # [base*2^k*(1-j), base*2^k]
+    for k, ms in enumerate(expected_ms):
+        assert 10 * 2 ** k * 0.75 <= ms <= 10 * 2 ** k
+    st = pol.stats()
+    assert st["attempts"] == 4 and st["retries"] == 3
+    assert st["successes"] == 1 and st["giveups"] == 0
+
+
+def test_retry_non_retryable_raises_immediately():
+    sleeps = []
+    pol = _fast_policy(sleep=sleeps.append)
+
+    def bad():
+        raise ValueError("not transient")
+
+    with pytest.raises(ValueError, match="not transient"):
+        pol.call(bad)
+    assert sleeps == []
+    assert pol.stats()["attempts"] == 1
+
+
+def test_retry_exhausted_chains_last_fault():
+    pol = _fast_policy(max_attempts=3, sleep=lambda s: None)
+
+    def always():
+        raise TransientFault("persistent")
+
+    with pytest.raises(RetryExhausted) as ei:
+        pol.call(always)
+    assert ei.value.attempts == 3
+    assert isinstance(ei.value.__cause__, TransientFault)
+    assert pol.stats()["giveups"] == 1
+
+
+def test_retry_deadline_stops_early_fake_clock():
+    clk = {"t": 0.0}
+
+    def clock():
+        return clk["t"]
+
+    def sleep(s):
+        clk["t"] += s
+
+    # attempts would sleep 100ms each; deadline 150ms admits only 1 retry
+    pol = _fast_policy(max_attempts=10, base_delay_ms=100, multiplier=1,
+                       jitter=0, deadline_ms=150, sleep=sleep, clock=clock)
+    calls = {"n": 0}
+
+    def always():
+        calls["n"] += 1
+        raise TransientFault("x")
+
+    with pytest.raises(RetryExhausted):
+        pol.call(always)
+    assert calls["n"] == 2  # initial + the one retry the deadline allowed
+
+
+def test_retryable_decorator():
+    calls = {"n": 0}
+
+    @retryable(_fast_policy(sleep=lambda s: None))
+    def flaky(v):
+        calls["n"] += 1
+        if calls["n"] < 2:
+            raise TransientFault("once")
+        return v * 2
+
+    assert flaky(21) == 42
+    assert calls["n"] == 2
+
+
+def test_default_policy_reads_env_knobs(monkeypatch):
+    monkeypatch.setenv("MXNET_RETRY_MAX_ATTEMPTS", "7")
+    monkeypatch.setenv("MXNET_RETRY_BASE_DELAY_MS", "2.5")
+    retry_mod._reset_default_policy()
+    try:
+        pol = retry_mod.default_policy()
+        assert pol.max_attempts == 7
+        assert pol.base_delay_ms == 2.5
+        assert retry_mod.default_policy() is pol  # cached
+    finally:
+        retry_mod._reset_default_policy()
+
+
+# ---------------------------------------------------------------------------
+# breaker: state machine with a fake clock
+# ---------------------------------------------------------------------------
+
+def _clocked_breaker(**kw):
+    clk = {"t": 0.0}
+    kw.setdefault("failure_threshold", 3)
+    kw.setdefault("recovery_ms", 1000)
+    kw.setdefault("register", False)
+    b = CircuitBreaker(clock=lambda: clk["t"], **kw)
+    return b, clk
+
+
+def test_breaker_opens_on_consecutive_failures():
+    b, clk = _clocked_breaker()
+    for _ in range(2):
+        b.record_failure()
+    assert b.state == "closed" and b.allow()
+    b.record_success()  # success resets the consecutive counter
+    for _ in range(2):
+        b.record_failure()
+    assert b.state == "closed"
+    b.record_failure()
+    assert b.state == "open"
+    assert not b.allow()
+    assert b.snapshot()["fast_fails"] == 1
+    assert 0.0 < b.retry_after_s() <= 1.0
+
+
+def test_breaker_half_open_probe_success_closes():
+    b, clk = _clocked_breaker(failure_threshold=1, half_open_probes=1)
+    b.record_failure()
+    assert b.state == "open"
+    clk["t"] = 1.2  # past recovery window
+    assert b.state == "half_open"
+    assert b.allow()          # the single probe slot
+    assert not b.allow()      # concurrent second caller is shed
+    b.record_success()
+    assert b.state == "closed"
+    snap = b.snapshot()
+    assert snap["opened"] == 1 and snap["half_open"] == 1 \
+        and snap["closed"] == 1
+
+
+def test_breaker_half_open_probe_failure_reopens():
+    b, clk = _clocked_breaker(failure_threshold=1)
+    b.record_failure()
+    clk["t"] = 1.2
+    assert b.allow()
+    b.record_failure()        # probe failed
+    assert b.state == "open"
+    assert not b.allow()      # fresh recovery timer from t=1.2
+    clk["t"] = 1.9
+    assert b.state == "open"
+    clk["t"] = 2.3
+    assert b.state == "half_open"
+
+
+def test_breaker_release_frees_probe_slot():
+    b, clk = _clocked_breaker(failure_threshold=1)
+    b.record_failure()
+    clk["t"] = 1.2
+    assert b.allow()
+    b.release()               # probe shed before reaching the model
+    assert b.allow()          # slot is reusable, breaker not wedged
+    b.record_success()
+    assert b.state == "closed"
+
+
+def test_breaker_stale_admission_cannot_decide_half_open():
+    """Regression: a slow call admitted while CLOSED must not be counted
+    as the half-open probe's outcome (nor free the probe's slot) when it
+    completes after the breaker has transitioned."""
+    b, clk = _clocked_breaker(failure_threshold=1, half_open_probes=1)
+    stale = b.allow()            # admitted in CLOSED; completes late
+    assert stale and not stale.probe
+    b.record_failure()           # meanwhile: opens
+    clk["t"] = 1.2
+    probe = b.allow()            # the real half-open probe
+    assert probe and probe.probe
+    b.record_success(stale)      # stale success: must NOT close
+    assert b.state == "half_open"
+    b.release(stale)             # stale release: must NOT free the slot
+    assert not b.allow()         # still exactly one probe in flight
+    b.record_failure(stale)      # stale failure: must NOT re-open
+    assert b.state == "half_open"
+    b.record_success(probe)      # the live probe decides
+    assert b.state == "closed"
+
+
+def test_breaker_error_rate_trip():
+    b, clk = _clocked_breaker(failure_threshold=100,
+                              error_rate_threshold=0.5, window=8)
+    for i in range(8):  # alternate: 50% error rate over the full window
+        (b.record_failure if i % 2 else b.record_success)()
+    assert b.state == "open"
+
+
+def test_breaker_call_wrapper():
+    b, clk = _clocked_breaker(failure_threshold=1)
+    with pytest.raises(RuntimeError, match="boom"):
+        b.call(lambda: (_ for _ in ()).throw(RuntimeError("boom")))
+    assert b.state == "open"
+    with pytest.raises(breaker_mod.CircuitOpen) as ei:
+        b.call(lambda: 1)
+    assert ei.value.retry_after_s > 0
+
+
+# ---------------------------------------------------------------------------
+# batcher robustness: worker survives / closes cleanly, never strands
+# ---------------------------------------------------------------------------
+
+class _BrokenMetrics(ServingMetrics):
+    """Metrics object whose success path explodes — models any unexpected
+    non-ServingError failure inside the worker loop."""
+
+    def record_batch(self, rows, capacity):
+        raise RuntimeError("metrics backend down")
+
+
+def test_batcher_unexpected_worker_error_never_strands_waiters():
+    m = _BrokenMetrics()
+    b = DynamicBatcher(lambda x: x * 2.0, max_batch_size=4,
+                       max_latency_ms=1, metrics=m, retry_policy=False)
+    try:
+        f = b.submit(np.ones((2,), "float32"))
+        # the waiter MUST resolve (result or error) — never hang
+        with pytest.raises(RuntimeError, match="metrics backend down"):
+            f.result(timeout=5)
+        # worker stayed alive: next request is served or cleanly refused
+        try:
+            f2 = b.submit(np.ones((2,), "float32"))
+            with pytest.raises(RuntimeError):
+                f2.result(timeout=5)
+        except ServerClosed:
+            pass  # transition-to-closed is the other allowed contract
+    finally:
+        b.close(timeout=5)
+    assert not b._worker.is_alive()
+
+
+def test_batcher_fatal_fault_fails_batch_keeps_worker():
+    chaos.arm("serving.execute", "fatal", first=1)
+    pol = _fast_policy(sleep=lambda s: None)
+    with DynamicBatcher(lambda x: x + 1.0, max_batch_size=2,
+                        max_latency_ms=1, retry_policy=pol) as b:
+        f = b.submit(np.zeros((1,), "float32"))
+        with pytest.raises(FatalFault):  # not retryable -> surfaces
+            f.result(timeout=5)
+        # worker alive, later requests fine
+        np.testing.assert_allclose(
+            b.predict(np.zeros((1,), "float32")), [1.0])
+    assert pol.stats()["retries"] == 0
+
+
+@pytest.mark.chaos
+def test_batcher_retries_absorb_injected_transients():
+    """Acceptance (a), batcher level: every=2 faults, all requests OK."""
+    chaos.arm("serving.execute", "transient", every=2)
+    pol = _fast_policy(max_attempts=3, base_delay_ms=0.5)
+    with DynamicBatcher(lambda x: x * 3.0, max_batch_size=4,
+                        max_latency_ms=2, retry_policy=pol) as b:
+        futs = [b.submit(np.full((2,), i, "float32")) for i in range(16)]
+        for i, f in enumerate(futs):
+            np.testing.assert_allclose(f.result(timeout=15),
+                                       np.full((2,), 3.0 * i))
+    assert pol.stats()["retries"] >= 1
+    assert chaos.stats()["serving.execute"]["fires"] >= 1
+
+
+def test_engine_retry_absorbs_transient_model_fault():
+    state = {"n": 0}
+
+    def flaky_model(x):
+        state["n"] += 1
+        if state["n"] == 1:
+            raise TransientFault("cold start")
+        return nd.array(np.asarray(x)) * 2.0
+
+    eng = InferenceEngine(flaky_model, buckets=(2, 4), jit=False,
+                          retry_policy=_fast_policy())
+    out = eng.predict(np.ones((2, 3), "float32"))
+    np.testing.assert_allclose(out.asnumpy(), np.full((2, 3), 2.0))
+    assert state["n"] == 2
+
+
+def test_kvstore_push_pull_retry_under_chaos():
+    chaos.arm("kvstore.push", "transient", first=1)
+    chaos.arm("kvstore.pull", "transient", first=1)
+    kv = mx.kv.create("local")
+    kv._retry = _fast_policy()
+    kv.init("w", nd.array(np.arange(4, dtype="float32")))
+    kv.push("w", nd.array(np.ones(4, "float32")))  # retried past the fault
+    out = nd.zeros((4,))
+    kv.pull("w", out=out)                          # retried past the fault
+    np.testing.assert_allclose(out.asnumpy(), np.ones(4))
+    assert kv._retry.stats()["retries"] == 2
+
+
+# ---------------------------------------------------------------------------
+# HTTP e2e: faults absorbed, breaker degradation, drain semantics
+# ---------------------------------------------------------------------------
+
+def _post_json(url, payload, timeout=30):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(), method="POST",
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def _get_json(url, timeout=10):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+D_IN, D_OUT = 6, 2
+_W = np.linspace(-1, 1, D_IN * D_OUT).reshape(D_IN, D_OUT).astype("float32")
+
+
+def _linear(x):
+    return nd.dot(nd.array(np.asarray(x)), nd.array(_W))
+
+
+@pytest.mark.chaos
+def test_e2e_serving_chaos_all_requests_succeed_no_leaks():
+    """Acceptance (a): transient faults on serving.execute; every HTTP
+    request succeeds via retry; no dead worker, no thread leak."""
+    chaos.arm("serving.execute", "transient", every=3)
+    pol = _fast_policy(max_attempts=4, base_delay_ms=0.5,
+                       name="serving.e2e", register=True)
+    threads_before = threading.active_count()
+    with ModelServer(_linear, port=0, jit=False, max_batch_size=4,
+                     max_latency_ms=2, retry_policy=pol) as srv:
+        def client(i):
+            x = np.full((D_IN,), float(i), "float32")
+            code, body = _post_json(srv.url + "/predict",
+                                    {"data": x.tolist()})
+            assert code == 200
+            np.testing.assert_allclose(
+                body["output"], (x[None] @ _W)[0], rtol=1e-4, atol=1e-5)
+            return code
+
+        with ThreadPoolExecutor(max_workers=6) as pool:
+            codes = list(pool.map(client, range(24)))
+        assert codes == [200] * 24
+        assert srv.batcher._worker.is_alive()  # zero dead workers
+        code, m = _get_json(srv.url + "/metrics")
+        assert m["ok"] == 24 and m["worker_errors"] == 0
+        assert m["retry"]["serving.e2e"]["retries"] >= 1  # visible in /metrics
+    deadline = time.monotonic() + 5
+    while threading.active_count() > threads_before and \
+            time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert threading.active_count() <= threads_before  # zero leaked threads
+
+
+def test_e2e_breaker_opens_and_health_degrades():
+    def doomed(x):
+        raise RuntimeError("model melted")
+
+    brk = CircuitBreaker(failure_threshold=3, recovery_ms=60000,
+                         name="serving.test", register=False)
+    with ModelServer(doomed, port=0, jit=False, max_latency_ms=1,
+                     breaker=brk, retry_policy=False) as srv:
+        # first `threshold` requests reach the model -> 500
+        for _ in range(3):
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _post_json(srv.url + "/predict", {"data": [1.0] * D_IN})
+            assert ei.value.code == 500
+        # breaker now open: fast-fail 503 + Retry-After, model not touched
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post_json(srv.url + "/predict", {"data": [1.0] * D_IN})
+        assert ei.value.code == 503
+        assert int(ei.value.headers["Retry-After"]) >= 1
+        assert json.loads(ei.value.read())["breaker"]["state"] == "open"
+        # healthz reports degraded with breaker state for LB drain
+        code, h = _get_json(srv.url + "/healthz")
+        assert code == 200 and h["status"] == "degraded"
+        assert h["breaker"]["state"] == "open"
+        # /metrics carries the breaker snapshot too
+        code, m = _get_json(srv.url + "/metrics")
+        assert m["breaker"]["opened"] == 1 and m["breaker"]["fast_fails"] >= 1
+
+
+def test_e2e_breaker_half_open_probe_recovers():
+    state = {"broken": True}
+
+    def flappy(x):
+        if state["broken"]:
+            raise RuntimeError("down")
+        return _linear(x)
+
+    brk = CircuitBreaker(failure_threshold=2, recovery_ms=80,
+                         name="serving.test", register=False)
+    with ModelServer(flappy, port=0, jit=False, max_latency_ms=1,
+                     breaker=brk, retry_policy=False) as srv:
+        for _ in range(2):
+            with pytest.raises(urllib.error.HTTPError):
+                _post_json(srv.url + "/predict", {"data": [0.0] * D_IN})
+        assert brk.state == "open"
+        state["broken"] = False
+        time.sleep(0.12)  # recovery window elapses -> half-open probe
+        code, body = _post_json(srv.url + "/predict",
+                                {"data": [0.0] * D_IN})
+        assert code == 200          # probe succeeded
+        assert brk.state == "closed"
+        code, h = _get_json(srv.url + "/healthz")
+        assert h["status"] == "ok"
+
+
+def test_e2e_malformed_body_does_not_leak_half_open_probe():
+    """Regression: a 400 (or a socket error mid-read) while the breaker is
+    half-open must not consume the probe slot forever."""
+    state = {"broken": True}
+
+    def flappy(x):
+        if state["broken"]:
+            raise RuntimeError("down")
+        return _linear(x)
+
+    brk = CircuitBreaker(failure_threshold=1, recovery_ms=60,
+                         name="serving.test", register=False)
+    with ModelServer(flappy, port=0, jit=False, max_latency_ms=1,
+                     breaker=brk, retry_policy=False) as srv:
+        with pytest.raises(urllib.error.HTTPError):
+            _post_json(srv.url + "/predict", {"data": [0.0] * D_IN})
+        assert brk.state == "open"
+        state["broken"] = False
+        time.sleep(0.1)  # -> half-open
+        # malformed body: 400, must not occupy the single probe slot
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post_json(srv.url + "/predict", {"nope": 1})
+        assert ei.value.code == 400
+        # the probe slot is still free: a real request closes the circuit
+        code, _ = _post_json(srv.url + "/predict", {"data": [0.0] * D_IN})
+        assert code == 200
+        assert brk.state == "closed"
+
+
+def test_server_drain_rejects_new_posts_with_503():
+    with ModelServer(_linear, port=0, jit=False, max_latency_ms=1) as srv:
+        code, _ = _post_json(srv.url + "/predict", {"data": [0.0] * D_IN})
+        assert code == 200
+        srv._draining = True  # what stop() flips first
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post_json(srv.url + "/predict", {"data": [0.0] * D_IN})
+        assert ei.value.code == 503
+        assert ei.value.headers["Retry-After"] is not None
+        code, h = _get_json(srv.url + "/healthz")
+        assert h["status"] == "draining"
+        srv._draining = False  # let the context-manager stop() drain clean
+
+
+def test_drain_503_keeps_keepalive_connection_in_sync():
+    """Regression: an early 503 (draining) must consume the POST body, or
+    the next request on a reused HTTP/1.1 connection is parsed starting at
+    the leftover body bytes."""
+    import http.client
+
+    with ModelServer(_linear, port=0, jit=False, max_latency_ms=1) as srv:
+        host, port = srv.address
+        conn = http.client.HTTPConnection(host, port, timeout=10)
+        try:
+            body = json.dumps({"data": [0.0] * D_IN})
+            srv._draining = True
+            conn.request("POST", "/predict", body=body,
+                         headers={"Content-Type": "application/json"})
+            assert conn.getresponse().read() and True  # drain the 503
+            srv._draining = False
+            # the SAME connection must still speak clean HTTP
+            conn.request("POST", "/predict", body=body,
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            out = json.loads(resp.read())
+            assert resp.status == 200 and "output" in out
+        finally:
+            conn.close()
+
+
+def test_server_rejects_negative_content_length():
+    """Regression: Content-Length: -1 must get a 400, not an rfile.read(-1)
+    that blocks the handler thread until the client hangs up."""
+    import http.client
+
+    with ModelServer(_linear, port=0, jit=False, max_latency_ms=1) as srv:
+        host, port = srv.address
+        conn = http.client.HTTPConnection(host, port, timeout=10)
+        try:
+            conn.putrequest("POST", "/predict")
+            conn.putheader("Content-Length", "-1")
+            conn.endheaders()
+            resp = conn.getresponse()
+            assert resp.status == 400
+        finally:
+            conn.close()
+
+
+def test_batcher_bounded_drain_timeout_fails_stragglers():
+    gate = threading.Event()
+    entered = threading.Event()
+
+    def slow(x):
+        entered.set()
+        assert gate.wait(10)
+        return x
+
+    b = DynamicBatcher(slow, max_batch_size=1, max_latency_ms=0,
+                       retry_policy=False)
+    try:
+        wedged = b.submit(np.zeros(1, "float32"))
+        assert entered.wait(5)
+        straggler = b.submit(np.zeros(1, "float32"))
+        clean = b.close(drain=True, timeout=0.2)  # worker stuck in model
+        assert clean is False
+        with pytest.raises(ServerClosed, match="drain timed out"):
+            straggler.result(timeout=5)  # bounded: failed, not stranded
+        with pytest.raises(ServerClosed, match="drain timed out"):
+            wedged.result(timeout=5)  # the IN-FLIGHT batch fails too
+    finally:
+        gate.set()
+        b.close(timeout=5)
+
+
+# ---------------------------------------------------------------------------
+# checkpoint atomicity + resume equivalence
+# ---------------------------------------------------------------------------
+
+def _make_trainer(seed=0):
+    mx.random.seed(seed)
+    np.random.seed(seed)
+    net = gluon.nn.Sequential()
+    net.add(gluon.nn.Dense(16, activation="relu"), gluon.nn.Dense(4))
+    net.initialize(mx.init.Xavier())
+    net(mx.nd.zeros((2, 8)))
+    mesh = parallel.make_mesh()
+    return parallel.ShardedTrainer(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(), "adam",
+        {"learning_rate": 1e-2}, mesh=mesh)
+
+
+def _batches(n, seed):
+    rng = np.random.RandomState(seed)
+    return [(mx.nd.array(rng.rand(8, 8).astype("float32")),
+             mx.nd.array(rng.randint(0, 4, (8,)).astype("float32")))
+            for _ in range(n)]
+
+
+@pytest.mark.chaos
+def test_checkpoint_save_atomic_under_mid_save_crash(tmp_path):
+    t = _make_trainer()
+    for x, y in _batches(2, seed=1):
+        t.step(x, y)
+    ckpt = str(tmp_path / "ckpt")
+    parallel.save_checkpoint(t, ckpt)
+    good_vals = [np.asarray(v).copy() for v in t._values]
+    good_step = t._t
+
+    t.step(*_batches(1, seed=2)[0])  # move past the saved state
+    chaos.arm("checkpoint.save", "fatal", first=1)
+    with pytest.raises(FatalFault):  # crash mid-save
+        parallel.save_checkpoint(t, ckpt)
+
+    # the previous good checkpoint is intact and loadable
+    t2 = _make_trainer(seed=9)
+    parallel.restore_checkpoint(t2, ckpt)
+    assert t2._t == good_step
+    for a, b in zip(good_vals, t2._values):
+        np.testing.assert_array_equal(a, np.asarray(b))
+    # and a post-crash save cleans up its staging dir and succeeds
+    parallel.save_checkpoint(t, ckpt)
+    t3 = _make_trainer(seed=10)
+    parallel.restore_checkpoint(t3, ckpt)
+    assert t3._t == t._t
+
+
+def test_checkpoint_save_promotes_old_and_honors_force(tmp_path):
+    """Regression: a crash between save's two publish renames leaves only
+    ``.old`` — the next save must promote it, not delete it; and
+    ``force=False`` must refuse BEFORE staging the expensive write."""
+    import os
+    import shutil
+
+    t = _make_trainer()
+    t.step(*_batches(1, seed=6)[0])
+    ckpt = str(tmp_path / "ckpt")
+    parallel.save_checkpoint(t, ckpt)
+    step_saved = t._t
+
+    # simulate the crash window: path was renamed aside, publish never ran
+    os.rename(ckpt, ckpt + ".old")
+    t.step(*_batches(1, seed=7)[0])
+    chaos.arm("checkpoint.save", "fatal", first=1)
+    with pytest.raises(FatalFault):  # this save crashes mid-publish...
+        parallel.save_checkpoint(t, ckpt)
+    t2 = _make_trainer(seed=11)
+    parallel.restore_checkpoint(t2, ckpt)  # ...yet the old ckpt survived
+    assert t2._t == step_saved
+
+    # force=False refuses up front, leaving no staged .tmp behind
+    with pytest.raises(FileExistsError):
+        parallel.save_checkpoint(t, ckpt, force=False)
+    assert not os.path.exists(ckpt + ".tmp")
+    shutil.rmtree(ckpt)
+
+
+@pytest.mark.chaos
+def test_resume_survives_fault_on_initial_checkpoint(tmp_path):
+    """Regression: a transient fault on the pre-loop restore-target save
+    is re-attempted, not propagated out of resumable_fit."""
+    chaos.arm("checkpoint.save", "transient", first=1)
+    t = _make_trainer(seed=3)
+    losses = resumable_fit(t, _batches(3, seed=8), str(tmp_path / "e"),
+                           ckpt_every=2)
+    assert t._t == 3 and all(l is not None for l in losses)
+
+
+@pytest.mark.chaos
+def test_resume_equivalence_bitwise(tmp_path):
+    """Acceptance (b): fault at step 5 of 8, resumed via resumable_fit ->
+    final params bitwise-identical to the uninterrupted run."""
+    batches = _batches(8, seed=3)
+
+    ta = _make_trainer(seed=0)
+    clean = resumable_fit(ta, batches, str(tmp_path / "a"),
+                          ckpt_every=2, seed=123)
+
+    before = resume_mod.resume_stats()
+    chaos.arm("trainer.step", "fatal", at=5)
+    tb = _make_trainer(seed=0)
+    resumed = resumable_fit(tb, batches, str(tmp_path / "b"),
+                            ckpt_every=2, seed=123)
+    after = resume_mod.resume_stats()
+
+    assert after["restores"] == before["restores"] + 1
+    assert tb._t == ta._t == 8
+    for va, vb in zip(ta._values, tb._values):
+        np.testing.assert_array_equal(np.asarray(va), np.asarray(vb))
+    np.testing.assert_allclose(clean, resumed, rtol=0, atol=0)
+
+
+@pytest.mark.chaos
+def test_resume_survives_transient_every_n(tmp_path):
+    chaos.arm("trainer.step", "transient", every=4)
+    t = _make_trainer(seed=1)
+    losses = resumable_fit(t, _batches(6, seed=4), str(tmp_path / "c"),
+                           ckpt_every=2)
+    assert t._t == 6
+    assert all(l is not None and np.isfinite(l) for l in losses)
+
+
+@pytest.mark.chaos
+def test_resume_gives_up_after_max_restores(tmp_path):
+    chaos.arm("trainer.step", "fatal", every=1)  # every step dies
+    t = _make_trainer(seed=2)
+    with pytest.raises(ResumeGaveUp):
+        resumable_fit(t, _batches(3, seed=5), str(tmp_path / "d"),
+                      ckpt_every=1, max_restores=2)
+
+
+# ---------------------------------------------------------------------------
+# observability: everything lands in the profiler aggregate table
+# ---------------------------------------------------------------------------
+
+def test_counters_reach_profiler_aggregate(tmp_path):
+    from mxnet_tpu import profiler
+
+    # retry activity (registered policy)
+    pol = RetryPolicy(max_attempts=2, base_delay_ms=0.1,
+                      name="agg_probe_retry", sleep=lambda s: None)
+    with pytest.raises(RetryExhausted):
+        pol.call(lambda: (_ for _ in ()).throw(TransientFault("x")))
+    # breaker activity (registered breaker)
+    brk = CircuitBreaker(failure_threshold=1, name="agg_probe_breaker")
+    brk.record_failure()
+    brk.allow()
+    # chaos activity
+    chaos.arm("agg.probe", "transient", first=1)
+    _try_point("agg.probe")
+
+    stats = profiler.get_aggregate_stats()
+    assert stats["retry.agg_probe_retry.retries"]["calls"] == 1
+    assert stats["retry.agg_probe_retry.giveups"]["calls"] == 1
+    assert stats["breaker.agg_probe_breaker.opened"]["calls"] == 1
+    assert stats["breaker.agg_probe_breaker.fast_fails"]["calls"] == 1
+    assert stats["chaos.agg.probe.fires"]["calls"] == 1
+    assert "resilience.resume.restores" in stats
+    # and the rendered table carries the same rows
+    table = profiler.dumps()
+    assert "retry.agg_probe_retry.retries" in table
+    assert "breaker.agg_probe_breaker.opened" in table
