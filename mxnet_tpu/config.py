@@ -87,6 +87,30 @@ KNOBS = dict([
        "serving circuit breaker: successful probes required to close"),
     _k("MXNET_RESUME_EVERY", 10, int, "wired",
        "resumable_fit checkpoint cadence in steps (resilience/resume.py)"),
+    _k("MXNET_GUARDRAILS_CLIP_NORM", 0.0, float, "wired",
+       "GuardedStep global-norm gradient clip fused into the step "
+       "(resilience/guardrails.py; 0 = off)"),
+    _k("MXNET_GUARDRAILS_DYNAMIC_SCALE", 0, int, "wired",
+       "GuardedStep dynamic loss scaling as traced state (grow/halve; "
+       "needed for true fp16, off for bf16/f32)"),
+    _k("MXNET_GUARDRAILS_INIT_SCALE", 2.0 ** 16, float, "wired",
+       "initial loss scale when dynamic scaling is on (reference AMP "
+       "LossScaler default)"),
+    _k("MXNET_GUARDRAILS_SCALE_FACTOR", 2.0, float, "wired",
+       "loss-scale grow/halve factor (power of 2 keeps fp32 exact)"),
+    _k("MXNET_GUARDRAILS_SCALE_WINDOW", 2000, int, "wired",
+       "consecutive clean steps before the loss scale grows"),
+    _k("MXNET_GUARDRAILS_DEADLINE_MS", 0.0, float, "wired",
+       "GuardedStep watchdog: flag steps whose results are not ready "
+       "within this many ms (0 = no watchdog)"),
+    _k("MXNET_GUARDRAILS_STORM_WINDOW", 20, int, "wired",
+       "AnomalyDetector NaN-storm window (recent steps considered)"),
+    _k("MXNET_GUARDRAILS_STORM_SKIPS", 5, int, "wired",
+       "skipped steps within the storm window that declare a NaN storm "
+       "(raises AnomalyFault -> resumable_fit restore-and-replay)"),
+    _k("MXNET_DATALOADER_MAX_SKIPS", 100, int, "wired",
+       "DataLoader error_policy='skip': bad samples tolerated per "
+       "iteration before failing loudly (<0 = unbounded)"),
     # ---- subsumed by XLA/PJRT --------------------------------------------
     _k("MXNET_EXEC_BULK_EXEC_INFERENCE", 1, int, "subsumed",
        "XLA compiles whole programs; bulking is implicit"),

@@ -1,9 +1,14 @@
 """Dynamic loss scaler (reference ``contrib/amp/loss_scaler.py``): grow the
 scale every `scale_window` clean steps, halve it on overflow. Needed only
-for true fp16; bf16 on TPU keeps scale at 1."""
-from __future__ import annotations
+for true fp16; bf16 on TPU keeps scale at 1.
 
-import numpy as _np
+The sharded-trainer path fuses this whole state machine into the compiled
+step (``resilience/guardrails.py`` ``GuardedStep``); this host-side class
+remains for the eager/Module path — with :meth:`has_overflow` now doing
+ONE fused device-side all-finite reduction and a single scalar readback
+instead of the reference's blocking ``asnumpy()`` per gradient per step.
+"""
+from __future__ import annotations
 
 __all__ = ["LossScaler"]
 
@@ -17,14 +22,21 @@ class LossScaler:
         self._unskipped = 0
 
     def has_overflow(self, params):
-        """True if any gradient is non-finite (reference loss_scaler.py)."""
+        """True if any gradient is non-finite (reference loss_scaler.py).
+
+        The reduction over every gradient runs on device (one fused
+        ``isfinite``/``all`` chain, see ``guardrails.all_finite``); the
+        only device→host traffic is the final scalar bool — per STEP, not
+        per gradient."""
+        grads = []
         for param in params:
             if param.grad_req != "null":
                 for grad in param.list_grad():
-                    g = grad.asnumpy()
-                    if not _np.isfinite(g).all():
-                        return True
-        return False
+                    grads.append(grad._data)
+        if not grads:
+            return False
+        from ...resilience.guardrails import all_finite
+        return not bool(all_finite(grads))
 
     def update_scale(self, overflow):
         if overflow:

@@ -23,7 +23,37 @@ from ...ndarray import ndarray as _nd
 from ...ndarray.ndarray import NDArray
 from . import sampler as _sampler
 
-__all__ = ["DataLoader", "default_batchify_fn"]
+__all__ = ["DataLoader", "DataLoaderSkipLimit", "default_batchify_fn"]
+
+
+class DataLoaderSkipLimit(RuntimeError):
+    """``error_policy="skip"`` hit its bad-sample cap
+    (``MXNET_DATALOADER_MAX_SKIPS``): this is data-wide corruption, not a
+    few bad records — failing loudly beats silently training on a
+    shrinking dataset. ``__cause__`` is the last sample error."""
+
+
+# process-wide skipped-sample counter, exported to the profiler aggregate
+# table (row ``guardrails.dataloader.skipped``) so silent data loss is
+# never actually silent
+_skip_lock = threading.Lock()
+_skipped_total = 0
+
+
+def _count_skip(n=1):
+    global _skipped_total
+    with _skip_lock:
+        _skipped_total += n
+
+
+def _profiler_rows():
+    with _skip_lock:
+        return {"guardrails.dataloader.skipped": (_skipped_total, 0.0)}
+
+
+from ...resilience._stats import export_rows as _export_rows  # noqa: E402
+
+_export_rows(_profiler_rows)
 
 
 def default_batchify_fn(data):
@@ -44,10 +74,28 @@ class DataLoader:
     def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
                  last_batch=None, batch_sampler=None, batchify_fn=None,
                  num_workers=0, pin_memory=False, pin_device_id=0,
-                 prefetch=None, thread_pool=False, timeout=120):
+                 prefetch=None, thread_pool=False, timeout=120,
+                 error_policy="raise", max_skips=None):
+        """``error_policy``: what to do when a sample's ``__getitem__`` or
+        its batchify raises — ``"raise"`` (reference behavior: the error
+        propagates to the consumer) or ``"skip"`` (drop the bad sample,
+        count it in the ``guardrails.dataloader.skipped`` profiler row,
+        serve the rest of the batch). ``max_skips`` caps skipped samples
+        per iteration (default ``MXNET_DATALOADER_MAX_SKIPS``; negative =
+        unbounded); past the cap a :class:`DataLoaderSkipLimit` is raised
+        — a few corrupt records are survivable, a corrupt dataset is not.
+        """
         self._dataset = dataset
         self._pin_memory = pin_memory
         self._timeout = timeout
+        if error_policy not in ("raise", "skip"):
+            raise ValueError("error_policy must be 'raise' or 'skip', got "
+                             "%r" % (error_policy,))
+        self._error_policy = error_policy
+        if max_skips is None:
+            from ... import config as _config
+            max_skips = _config.get("MXNET_DATALOADER_MAX_SKIPS")
+        self._max_skips = int(max_skips)
         if batch_sampler is None:
             if batch_size is None:
                 raise ValueError("batch_size must be specified unless "
@@ -76,17 +124,69 @@ class DataLoader:
         else:
             self._batchify_fn = batchify_fn
 
+    def _load_batch(self, idxs, budget):
+        """Fetch + batchify one batch honoring ``error_policy``. Returns
+        the batch, or None when every sample in it was skipped."""
+        if self._error_policy == "raise":
+            return self._batchify_fn([self._dataset[idx] for idx in idxs])
+        samples = []
+        for idx in idxs:
+            try:
+                samples.append(self._dataset[idx])
+            except Exception as e:  # noqa: BLE001 — the policy's whole point
+                budget.spend(1, e)
+        if not samples:
+            return None
+        try:
+            return self._batchify_fn(samples)
+        except Exception:  # noqa: BLE001 — attribute the failure per sample
+            good = []
+            for s in samples:
+                try:
+                    self._batchify_fn([s])
+                    good.append(s)
+                except Exception as e:  # noqa: BLE001
+                    budget.spend(1, e)
+            if not good:
+                return None
+            # a mix that STILL fails jointly (shape-incompatible but each
+            # fine alone) is a batchify bug, not a bad sample: propagate
+            return self._batchify_fn(good)
+
     def __iter__(self):
         if self._num_workers == 0:
             def same_process_iter():
+                budget = _SkipBudget(self._max_skips)
                 for batch in self._batch_sampler:
-                    yield self._batchify_fn(
-                        [self._dataset[idx] for idx in batch])
+                    out = self._load_batch(batch, budget)
+                    if out is not None:
+                        yield out
             return same_process_iter()
         return _MultiWorkerIter(self)
 
     def __len__(self):
         return len(self._batch_sampler)
+
+
+class _SkipBudget:
+    """Per-iteration skip accounting shared across worker threads: counts
+    into the process-wide profiler row and enforces the loud-failure cap."""
+
+    def __init__(self, cap):
+        self._lock = threading.Lock()
+        self._cap = cap
+        self.count = 0
+
+    def spend(self, n, err):
+        with self._lock:
+            self.count += n
+            count = self.count
+        _count_skip(n)
+        if self._cap >= 0 and count > self._cap:
+            raise DataLoaderSkipLimit(
+                "DataLoader skipped %d samples (cap %d, "
+                "MXNET_DATALOADER_MAX_SKIPS) — data-wide corruption?"
+                % (count, self._cap)) from err
 
 
 class _MultiWorkerIter:
@@ -102,6 +202,7 @@ class _MultiWorkerIter:
         self._results = {}
         self._out_q = queue.Queue()
         self._task_q = queue.Queue()
+        self._budget = _SkipBudget(loader._max_skips)
         depth = max(1, loader._prefetch)
         for _ in range(loader._num_workers):
             t = threading.Thread(target=self._worker, daemon=True)
@@ -116,8 +217,7 @@ class _MultiWorkerIter:
                 return
             i, idxs = item
             try:
-                batch = self._loader._batchify_fn(
-                    [self._loader._dataset[idx] for idx in idxs])
+                batch = self._loader._load_batch(idxs, self._budget)
                 self._out_q.put((i, batch, None))
             except Exception as e:  # propagate to consumer
                 self._out_q.put((i, None, e))
@@ -131,18 +231,22 @@ class _MultiWorkerIter:
         return self
 
     def __next__(self):
-        if self._got >= self._n:
-            for _ in range(self._loader._num_workers):
-                self._task_q.put(None)
-            raise StopIteration
-        while self._got not in self._results:
-            i, batch, err = self._out_q.get(timeout=self._loader._timeout)
-            self._results[i] = (batch, err)
-        batch, err = self._results.pop(self._got)
-        self._got += 1
-        self._dispatch()
-        if err is not None:
-            raise err
-        return batch
+        while True:
+            if self._got >= self._n:
+                for _ in range(self._loader._num_workers):
+                    self._task_q.put(None)
+                raise StopIteration
+            while self._got not in self._results:
+                i, batch, err = self._out_q.get(
+                    timeout=self._loader._timeout)
+                self._results[i] = (batch, err)
+            batch, err = self._results.pop(self._got)
+            self._got += 1
+            self._dispatch()
+            if err is not None:
+                raise err
+            if batch is None:  # every sample skipped: move to the next one
+                continue
+            return batch
 
     next = __next__

@@ -28,12 +28,20 @@ def _tree(trainer):
     # parameter names differ between otherwise-identical trainers, and the
     # restore target must match the saved structure exactly
     keys = ["p%04d" % i for i in range(len(trainer._params))]
-    return {
+    tree = {
         "step": np.int64(trainer._t),
         "names": [p.name for p in trainer._params],
         "values": dict(zip(keys, trainer._values)),
         "states": {k: list(s) for k, s in zip(keys, trainer._states)},
     }
+    # wrappers with their own carried state (resilience.guardrails
+    # GuardedStep: loss scale, clean-step counter, skip counter) ride in
+    # the same atomic checkpoint, so restore-and-replay reproduces their
+    # trajectory bitwise, not just the parameters'
+    extra_fn = getattr(trainer, "_checkpoint_extra", None)
+    if extra_fn is not None:
+        tree["extra"] = extra_fn()
+    return tree
 
 
 def save_checkpoint(trainer, path, force=True):
@@ -85,10 +93,28 @@ def restore_checkpoint(trainer, path):
         # good checkpoint was already moved aside — promote it back
         os.rename(path + ".old", path)
     tpl = _tree(trainer)
+    ckptr = ocp.PyTreeCheckpointer()
+    # the wrapper population may have changed between save and restore
+    # (e.g. the trainer was wrapped in a GuardedStep AFTER the incident
+    # the checkpoint predates): adapt the template to the saved tree
+    # instead of failing on a top-level key mismatch
+    try:
+        saved = ckptr.metadata(path)
+        saved_keys = set(saved.keys())
+    except Exception:  # noqa: BLE001 — older layouts: keep strict template
+        saved, saved_keys = None, set(tpl.keys())
+    if "extra" in tpl and "extra" not in saved_keys:
+        # pre-wrapper checkpoint: restore the trainer state; the wrapper
+        # keeps its current (fresh) guard state
+        tpl.pop("extra")
+    elif saved is not None and "extra" in saved_keys and "extra" not in tpl:
+        # wrapper checkpoint restored into a bare trainer: materialize the
+        # extra subtree from metadata so orbax accepts it, then discard
+        tpl["extra"] = jax.tree_util.tree_map(
+            lambda m: np.zeros(m.shape, m.dtype), saved["extra"])
     restore_args = jax.tree_util.tree_map(
         lambda v: ocp.ArrayRestoreArgs(sharding=v.sharding)
         if isinstance(v, jax.Array) else ocp.RestoreArgs(), tpl)
-    ckptr = ocp.PyTreeCheckpointer()
     restored = ckptr.restore(
         path, args=ocp.args.PyTreeRestore(item=tpl,
                                           restore_args=restore_args))
@@ -96,4 +122,6 @@ def restore_checkpoint(trainer, path):
     trainer._t = int(restored["step"])
     trainer._values = [restored["values"][k] for k in keys]
     trainer._states = [tuple(restored["states"][k]) for k in keys]
+    if "extra" in restored and hasattr(trainer, "_restore_extra"):
+        trainer._restore_extra(restored["extra"])
     return trainer

@@ -176,7 +176,6 @@ class ShardedTrainer:
             raise TypeError(
                 "ShardedTrainer.step: pass a TUPLE for multi-input models "
                 "or a single stacked array — a list is ambiguous")
-        self._t += 1
         xs = data if isinstance(data, tuple) else (data,)
         bs = batch_sharding(self._mesh, self._batch_axes)
         xs = tuple(jax.device_put(
@@ -184,6 +183,16 @@ class ShardedTrainer:
             for x in xs)
         y = label._data if isinstance(label, NDArray) else jnp.asarray(label)
         y = jax.device_put(y, bs)
+        # numerical-fault injection on the step INPUT path (chaos kind
+        # "nan"): models a corrupt batch reaching the compiled step. The
+        # unguarded trainer will absorb the poison into its parameters —
+        # wrap with resilience.guardrails.GuardedStep to skip it instead.
+        # Fired BEFORE _t advances: a raising kind armed here must honor
+        # the same pre-mutation contract as trainer.step above.
+        if _chaos.poisoned("trainer.grads"):
+            from ..resilience.guardrails import poison_nonfinite
+            xs, y = poison_nonfinite(xs, y)
+        self._t += 1
         key = _random.next_key()
         loss_val, self._values, self._states, aux = self._step_fn(
             key, self._values, self._states, self._t,
@@ -226,6 +235,11 @@ class ShardedTrainer:
             self._mesh,
             PartitionSpec(None, self._batch_axes) if ys.ndim >= 2
             else PartitionSpec(None)))
+        # same input-path injection as step(): one fire poisons the whole
+        # staged span (this call IS one input staging)
+        if _chaos.poisoned("trainer.grads"):
+            from ..resilience.guardrails import poison_nonfinite
+            xs, ys = poison_nonfinite(xs, ys)
         key = _random.next_key()
         # t is 1-based inside updates (matches step(): first call sees t=1)
         losses, self._values, self._states = self._step_many_fn(

@@ -17,14 +17,22 @@ subsystem):
   fast-fail and ``/healthz`` degradation;
 - :mod:`~mxnet_tpu.resilience.resume` — :func:`resumable_fit`: periodic
   sharded checkpoints with restore-and-replay on faults, bitwise-equal to
-  an uninterrupted run.
+  an uninterrupted run;
+- :mod:`~mxnet_tpu.resilience.guardrails` — :class:`GuardedStep`:
+  numerical-fault tolerance fused INTO the compiled training step
+  (branchless NaN/overflow skip, dynamic loss scaling, global-norm
+  clipping) plus host-side :class:`AnomalyDetector` and
+  :class:`StepWatchdog` monitors.
 
 All event counters flow into ``profiler.get_aggregate_stats()`` via the
 stats-provider hook, and into the serving ``/metrics`` endpoint.
 """
 # import order matters: chaos has no intra-package deps; retry imports
 # chaos; breaker is standalone; resume imports chaos (parallel.checkpoint
-# lazily, inside the function, to keep this package import light).
+# lazily, inside the function, to keep this package import light);
+# guardrails imports chaos and MUST come after it (it is itself imported
+# from parallel/trainer.py mid-initialization of this package, so its own
+# heavy deps — parallel.mesh, ndarray — stay lazy inside methods).
 from .chaos import (Fault, TransientFault, FatalFault, SlowFault)
 from . import chaos
 from .retry import (RetryPolicy, RetryExhausted, retryable, named_policy,
@@ -34,10 +42,14 @@ from .breaker import CircuitBreaker, CircuitOpen
 from . import breaker
 from .resume import resumable_fit, ResumeGaveUp, resume_stats
 from . import resume
+from .guardrails import (GuardedStep, AnomalyDetector, StepWatchdog,
+                         AnomalyFault)
+from . import guardrails
 
-__all__ = ["chaos", "retry", "breaker", "resume",
+__all__ = ["chaos", "retry", "breaker", "resume", "guardrails",
            "Fault", "TransientFault", "FatalFault", "SlowFault",
            "RetryPolicy", "RetryExhausted", "retryable", "named_policy",
            "default_policy",
            "CircuitBreaker", "CircuitOpen",
-           "resumable_fit", "ResumeGaveUp", "resume_stats"]
+           "resumable_fit", "ResumeGaveUp", "resume_stats",
+           "GuardedStep", "AnomalyDetector", "StepWatchdog", "AnomalyFault"]

@@ -20,6 +20,13 @@ class Registry:
         with self._lock:
             self._items[obj.name] = obj
 
+    def discard(self, obj):
+        """Remove ``obj`` if it is still the registered instance for its
+        name (a newer same-name instance is left alone)."""
+        with self._lock:
+            if self._items.get(obj.name) is obj:
+                del self._items[obj.name]
+
     def map(self, fn):
         """``{name: fn(instance)}`` over a consistent snapshot."""
         with self._lock:

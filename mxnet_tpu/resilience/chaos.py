@@ -13,6 +13,8 @@ Injection points wired in this codebase:
 ========================  ==================================================
 ``serving.execute``       DynamicBatcher model execution (per attempt)
 ``trainer.step``          ShardedTrainer.step / step_many entry
+``trainer.grads``         training-step input staging (``nan`` kind poisons
+                          the batch so loss/grads go non-finite)
 ``kvstore.push``          KVStore.push entry (per attempt)
 ``kvstore.pull``          KVStore.pull entry (per attempt)
 ``checkpoint.save``       between staging-dir write and atomic publish
@@ -25,6 +27,7 @@ Arming — programmatic::
     chaos.arm("kvstore.push", "transient", every=3)      # calls 3, 6, 9...
     chaos.arm("serving.execute", "transient", p=0.05, seed=0)  # seeded coin
     chaos.arm("serving.execute", "slow", delay_ms=20, every=2)
+    chaos.arm("trainer.grads", "nan", every=3)           # poison the batch
     chaos.clear()
 
 or via the environment (picked up at import and by :func:`arm_from_env`)::
@@ -32,11 +35,15 @@ or via the environment (picked up at import and by :func:`arm_from_env`)::
     MXNET_CHAOS_SPEC="serving.execute:transient:first=2;trainer.step:fatal:at=5"
 
 Grammar: ``point:kind[:trigger]`` rules joined by ``;``. ``kind`` is
-``transient`` | ``fatal`` | ``slow(<delay_ms>)``. ``trigger`` is one of
-``first=K`` (default ``first=1``), ``every=N``, ``at=K``, or ``p=R,seed=S``
-(deterministic seeded Bernoulli). ``transient``/``fatal`` raise
-:class:`TransientFault`/:class:`FatalFault`; ``slow`` injects latency
-(sleeps, then returns normally).
+``transient`` | ``fatal`` | ``slow(<delay_ms>)`` | ``nan``. ``trigger`` is
+one of ``first=K`` (default ``first=1``), ``every=N``, ``at=K``, or
+``p=R,seed=S`` (deterministic seeded Bernoulli). ``transient``/``fatal``
+raise :class:`TransientFault`/:class:`FatalFault`; ``slow`` injects latency
+(sleeps, then returns normally); ``nan`` raises nothing — the point
+*returns* ``"nan"`` (see :func:`poisoned`) and data-path callers corrupt
+their in-flight values with non-finite numbers, which is how numerical
+faults reach the compiled training step (a raise could never model a bad
+batch that the hardware happily computes on).
 
 Fire/call counters per point are exported to the profiler aggregate table
 (rows ``chaos.<point>.calls`` / ``chaos.<point>.fires``).
@@ -49,7 +56,8 @@ import threading
 import time
 
 __all__ = ["Fault", "TransientFault", "FatalFault", "SlowFault",
-           "point", "arm", "arm_from_env", "clear", "stats", "active"]
+           "point", "poisoned", "arm", "arm_from_env", "clear", "stats",
+           "active"]
 
 
 class Fault(Exception):
@@ -74,7 +82,7 @@ class SlowFault(Fault):
         self.delay_ms = float(delay_ms)
 
 
-_KINDS = ("transient", "fatal", "slow")
+_KINDS = ("transient", "fatal", "slow", "nan")
 
 
 class _Rule:
@@ -136,7 +144,10 @@ class _Rule:
             raise TransientFault(msg)
         if self.kind == "fatal":
             raise FatalFault(msg)
-        time.sleep(self.delay_ms / 1e3)  # slow: latency, not an error
+        if self.kind == "slow":
+            time.sleep(self.delay_ms / 1e3)  # slow: latency, not an error
+        # "nan" raises nothing: point() reports it via its return value and
+        # the caller poisons its own in-flight data
 
 
 _lock = threading.Lock()
@@ -147,21 +158,35 @@ _totals = {}         # point name -> [calls, fires], survives clear()
 
 def point(name):
     """Declare an injection point. No-op (one attribute read) unless a rule
-    is armed for ``name``; otherwise may raise a :class:`Fault` or sleep."""
+    is armed for ``name``; otherwise may raise a :class:`Fault`, sleep, or
+    return ``"nan"`` when a ``nan``-kind rule fired (data-path callers
+    poison their in-flight values — see :func:`poisoned`)."""
     if not _armed:
-        return
+        return None
     with _lock:
         rules = _rules.get(name)
         if not rules:
-            return
+            return None
         to_fire = [r for r in rules if r.should_fire()]
         for r in to_fire:
             r.fires += 1  # counted here, under the lock
         tot = _totals.setdefault(name, [0, 0])
         tot[0] += 1
         tot[1] += len(to_fire)
+    out = None
     for r in to_fire:
-        r.fire()
+        if r.kind == "nan":
+            out = "nan"
+        else:
+            r.fire()
+    return out
+
+
+def poisoned(name):
+    """True when an armed ``nan`` rule fires at ``name`` this call. Raising
+    kinds armed on the same point still raise (a transient beats a poison:
+    the step never runs at all)."""
+    return point(name) == "nan"
 
 
 def arm(name, kind="transient", **kwargs):
@@ -178,7 +203,7 @@ def arm(name, kind="transient", **kwargs):
 
 
 _SPEC_RE = re.compile(
-    r"^(?P<point>[\w.\-]+):(?P<kind>transient|fatal|slow(\((?P<delay>"
+    r"^(?P<point>[\w.\-]+):(?P<kind>transient|fatal|nan|slow(\((?P<delay>"
     r"[0-9.]+)\))?)(:(?P<trig>[\w=.,\-]+))?$")
 
 
@@ -197,7 +222,7 @@ def arm_from_env(spec=None):
         if m is None:
             raise ValueError(
                 "bad MXNET_CHAOS_SPEC rule %r: want "
-                "'point:kind[:trigger]' with kind transient|fatal|"
+                "'point:kind[:trigger]' with kind transient|fatal|nan|"
                 "slow(<delay_ms>) and trigger first=K|every=N|at=K|"
                 "p=R,seed=S" % part)
         kind = m.group("kind")

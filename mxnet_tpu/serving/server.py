@@ -36,6 +36,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as _np
 
+from ..resilience import guardrails as _guardrails
 from ..resilience import retry as _retry
 from ..resilience.breaker import CircuitBreaker
 from .batcher import (DeadlineExceeded, DynamicBatcher, ServerBusy,
@@ -200,6 +201,7 @@ class ModelServer:
         if self.breaker is not None:
             self.metrics.set_gauge_fn("breaker", self.breaker.snapshot)
         self.metrics.set_gauge_fn("retry", _retry.all_stats)
+        self.metrics.set_gauge_fn("guardrails", _guardrails.all_stats)
         if bind_profiler:
             self.metrics.bind_profiler()
         self._draining = False
@@ -219,13 +221,19 @@ class ModelServer:
 
     def health(self):
         """The ``/healthz`` payload: ``ok`` | ``degraded`` | ``draining``
-        (+ breaker state when degraded) — the drain signal for LBs."""
+        (+ breaker state when degraded) — the drain signal for LBs. A
+        co-resident training job's guardrails (watchdog stall, NaN storm)
+        degrade this process too: a host whose device is wedged or whose
+        numerics are melting should not take serving traffic either."""
         if self._draining:
             return {"status": "draining"}
         if self.breaker is not None:
             snap = self.breaker.snapshot()
             if snap["state"] != "closed":
                 return {"status": "degraded", "breaker": snap}
+        g = _guardrails.health()
+        if g["status"] != "ok":
+            return {"status": "degraded", "guardrails": g}
         return {"status": "ok"}
 
     @property
