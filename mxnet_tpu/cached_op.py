@@ -88,12 +88,20 @@ class CachedOp:
         self._capacity = int(capacity)
         self._cache = OrderedDict()
         self._stats = {"hits": 0, "misses": 0, "evictions": 0}
+        # the serving engine dispatches one CachedOp from many HTTP threads:
+        # every _cache/_stats mutation happens under this lock. Compiles run
+        # OUTSIDE it (an XLA compile can take seconds; serializing compiles
+        # of different signatures would stall every other thread) — two
+        # threads racing the same cold signature may both compile, and the
+        # loser's executable is simply dropped on insert.
+        self._dispatch_lock = threading.Lock()
 
     def cache_stats(self):
         """This instance's executor-cache counters plus occupancy:
         ``{"size", "capacity", "hits", "misses", "evictions"}``."""
-        out = dict(self._stats)
-        out["size"] = len(self._cache)
+        with self._dispatch_lock:
+            out = dict(self._stats)
+            out["size"] = len(self._cache)
         out["capacity"] = self._capacity
         return out
 
@@ -150,23 +158,33 @@ class CachedOp:
         if any(isinstance(a._data, _jax.core.Tracer) for a in args):
             return self._fn(*args)
         sig = self._signature(args)
-        entry = self._cache.get(sig)
+        with self._dispatch_lock:
+            entry = self._cache.get(sig)
+            if entry is not None:
+                self._cache.move_to_end(sig)
+                self._stats["hits"] += 1
         if entry is None:
-            entry = self._compile(args)
-            self._cache[sig] = entry
-            self._stats["misses"] += 1
+            compiled = self._compile(args)  # outside the lock (see __init__)
             evicted = 0
-            if self._capacity > 0:
-                while len(self._cache) > self._capacity:
-                    self._cache.popitem(last=False)
-                    evicted += 1
-            self._stats["evictions"] += evicted
+            with self._dispatch_lock:
+                entry = self._cache.get(sig)
+                if entry is None:
+                    # we won (or were alone): publish our executable
+                    self._cache[sig] = entry = compiled
+                else:
+                    # a racing thread published first — use theirs, drop
+                    # ours; still a miss (an XLA compile really happened)
+                    self._cache.move_to_end(sig)
+                self._stats["misses"] += 1
+                if self._capacity > 0:
+                    while len(self._cache) > self._capacity:
+                        self._cache.popitem(last=False)
+                        evicted += 1
+                self._stats["evictions"] += evicted
             with _STATS_LOCK:
                 _GLOBAL_STATS["misses"] += 1
                 _GLOBAL_STATS["evictions"] += evicted
         else:
-            self._cache.move_to_end(sig)
-            self._stats["hits"] += 1
             with _STATS_LOCK:
                 _GLOBAL_STATS["hits"] += 1
         jitted, n_out, multi, aux_handles = entry

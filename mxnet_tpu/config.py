@@ -111,6 +111,12 @@ KNOBS = dict([
     _k("MXNET_DATALOADER_MAX_SKIPS", 100, int, "wired",
        "DataLoader error_policy='skip': bad samples tolerated per "
        "iteration before failing loudly (<0 = unbounded)"),
+    _k("MXNET_DATAFEED_DEPTH", 4, int, "wired",
+       "DeviceFeed staging ring depth: batches dispatched to sharded "
+       "device buffers ahead of consumption (parallel/datafeed.py)"),
+    _k("MXNET_DATAFEED_CHUNK", 8, int, "wired",
+       "ShardedTrainer.step_stream steps per compiled lax.scan span — "
+       "chunk N+1 stages while chunk N computes"),
     # ---- subsumed by XLA/PJRT --------------------------------------------
     _k("MXNET_EXEC_BULK_EXEC_INFERENCE", 1, int, "subsumed",
        "XLA compiles whole programs; bulking is implicit"),

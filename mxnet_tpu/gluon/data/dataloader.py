@@ -14,8 +14,10 @@ threads; the prefetch queue double-buffers ahead of the device.
 """
 from __future__ import annotations
 
+import itertools
 import queue
 import threading
+import weakref
 
 import numpy as np
 
@@ -24,6 +26,10 @@ from ...ndarray.ndarray import NDArray
 from . import sampler as _sampler
 
 __all__ = ["DataLoader", "DataLoaderSkipLimit", "default_batchify_fn"]
+
+# distinct pin_memory stats name per loader (datafeed registry is
+# latest-wins per name; train + val loaders must both stay visible)
+_pin_seq = itertools.count()
 
 
 class DataLoaderSkipLimit(RuntimeError):
@@ -76,7 +82,19 @@ class DataLoader:
                  num_workers=0, pin_memory=False, pin_device_id=0,
                  prefetch=None, thread_pool=False, timeout=120,
                  error_policy="raise", max_skips=None):
-        """``error_policy``: what to do when a sample's ``__getitem__`` or
+        """``pin_memory``: the reference staged batches into page-locked
+        host memory so the device copy could run async with compute; here
+        the same promise — "the transfer is already underway when the
+        consumer asks" — is kept by pre-staging batches through a
+        :class:`~mxnet_tpu.parallel.datafeed.DeviceFeed` ring (depth =
+        ``prefetch`` if set, else ``MXNET_DATAFEED_DEPTH``), yielding
+        device-backed NDArrays. ``pin_device_id`` is accepted for API
+        parity (single default device per process here). One staging ring
+        is live per loader: starting a new epoch retires the previous
+        ring (so a mid-epoch ``break`` can't strand staged buffers) —
+        iterate a pinned loader from one place at a time.
+
+        ``error_policy``: what to do when a sample's ``__getitem__`` or
         its batchify raises — ``"raise"`` (reference behavior: the error
         propagates to the consumer) or ``"skip"`` (drop the bad sample,
         count it in the ``guardrails.dataloader.skipped`` profiler row,
@@ -161,8 +179,41 @@ class DataLoader:
                     out = self._load_batch(batch, budget)
                     if out is not None:
                         yield out
-            return same_process_iter()
-        return _MultiWorkerIter(self)
+            base = same_process_iter()
+        else:
+            base = _MultiWorkerIter(self)
+        if not self._pin_memory:
+            return base
+        # pin_memory: the reference copied batches into page-locked host
+        # buffers so the engine's async cudaMemcpy could overlap compute
+        # (reference dataloader.py:431 _as_in_context pinned path). The
+        # TPU-native equivalent of "transfer already underway when the
+        # consumer asks" is a DeviceFeed ring: batches are dispatched to
+        # device buffers ahead of consumption and come back as
+        # device-backed NDArrays in the loader's own batch structure.
+        from ...parallel.datafeed import DeviceFeed
+        depth = self._prefetch if self._prefetch > 0 else None
+        # retire the previous epoch's feed (if any): an abandoned mid-epoch
+        # ring must not keep its stager thread parked on a full queue
+        last_ref = getattr(self, "_pin_feed", None)
+        last = last_ref() if last_ref is not None else None
+        if last is not None:
+            last.close()
+        # per-loader stats name: concurrent pinned loaders (train + val)
+        # must not evict each other's rows from the latest-wins registry
+        name = getattr(self, "_pin_name", None)
+        if name is None:
+            name = self._pin_name = "dataloader.%d" % next(_pin_seq)
+        feed = DeviceFeed(base, mesh=None, output="batch", depth=depth,
+                          timeout=self._timeout, name=name)
+        # WEAK ref: the feed's lifetime belongs to the epoch's consumer,
+        # not to this loader — a strong ref here would make the stager
+        # (whose source closure reaches the loader) keep an abandoned
+        # feed alive, and closing it from a __del__ that can fire on the
+        # stager's own thread deadlocked the anonymous-loader idiom
+        # `for batch in DataLoader(..., pin_memory=True)`
+        self._pin_feed = weakref.ref(feed)
+        return iter(feed)
 
     def __len__(self):
         return len(self._batch_sampler)

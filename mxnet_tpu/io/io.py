@@ -335,6 +335,7 @@ class PrefetchingIter(DataIter):
         self.started = True
         self.current_batch = [None for _ in range(self.n_iter)]
         self.next_batch = [None for _ in range(self.n_iter)]
+        self.next_error = [None for _ in range(self.n_iter)]
 
         def prefetch_func(self, i):
             while True:
@@ -343,8 +344,17 @@ class PrefetchingIter(DataIter):
                     break
                 try:
                     self.next_batch[i] = self.iters[i].next()
+                    self.next_error[i] = None
                 except StopIteration:
                     self.next_batch[i] = None
+                    self.next_error[i] = None
+                except Exception as e:  # noqa: BLE001 — relay, never wedge
+                    # the handshake MUST complete even on a source fault:
+                    # a dead prefetch thread would leave data_ready forever
+                    # unset and hang the consumer (and reset()) instead of
+                    # surfacing the error
+                    self.next_batch[i] = None
+                    self.next_error[i] = e
                 self.data_taken[i].clear()
                 self.data_ready[i].set()
 
@@ -354,10 +364,23 @@ class PrefetchingIter(DataIter):
         for thread in self.prefetch_threads:
             thread.start()
 
-    def __del__(self):
+    def close(self):
+        """Stop AND join the prefetch threads (idempotent). ``__del__``
+        only signals them without joining — call ``close()`` when the
+        underlying iterators are about to be reused elsewhere."""
         self.started = False
         for e in self.data_taken:
             e.set()
+        for t in getattr(self, "prefetch_threads", []):
+            t.join(timeout=2.0)
+
+    def __del__(self):
+        try:
+            self.started = False
+            for e in self.data_taken:
+                e.set()
+        except Exception:
+            pass
 
     @property
     def provide_data(self):
@@ -380,10 +403,14 @@ class PrefetchingIter(DataIter):
         ] for r, i in zip(self.rename_label, self.iters)], [])
 
     def reset(self):
+        # wait for any in-flight fetch to land (the handshake guarantees
+        # data_ready is eventually set even when the source raised — see
+        # prefetch_func), discard it, and restart the underlying iters
         for e in self.data_ready:
             e.wait()
         for i in self.iters:
             i.reset()
+        self.next_error = [None for _ in range(self.n_iter)]
         for e in self.data_ready:
             e.clear()
         for e in self.data_taken:
@@ -392,9 +419,22 @@ class PrefetchingIter(DataIter):
     def iter_next(self):
         for e in self.data_ready:
             e.wait()
+        errs = [e for e in self.next_error if e is not None]
+        if errs:
+            # propagate the source fault to the consumer, re-arming ONLY
+            # the errored slots so the handshake (and reset()) stays live
+            # — a non-failing iterator's already-fetched batch must not be
+            # clobbered by an early refetch
+            for i, err in enumerate(self.next_error):
+                if err is not None:
+                    self.data_ready[i].clear()
+                    self.data_taken[i].set()
+            raise errs[0]
         if self.next_batch[0] is None:
             for i in self.next_batch:
                 assert i is None, "Number of entry mismatches between iterators"
+            # exhausted, but re-armable: reset() restarts the underlying
+            # iters and the handshake below resumes fetching
             return False
         for batch in self.next_batch:
             assert batch.pad == self.next_batch[0].pad, \

@@ -12,6 +12,7 @@ from .mesh import (MeshConfig, make_mesh, current_mesh, set_mesh,
                    replicated, batch_sharding)
 from .functional import functionalize, functional_optimizer, shard_params
 from .trainer import ShardedTrainer
+from .datafeed import DeviceFeed, feed_stats
 from .checkpoint import save_checkpoint, restore_checkpoint
 from .ring_attention import ring_attention, ring_attention_sharded
 from .pipeline import pipeline_apply, pipeline_spmd

@@ -11,6 +11,8 @@ optimizer update, all fused, with parameter buffers donated in place.
 """
 from __future__ import annotations
 
+import itertools
+
 import numpy as np
 import jax
 import jax.numpy as jnp
@@ -23,6 +25,11 @@ from .functional import functionalize, functional_optimizer, shard_params
 from .mesh import make_mesh, batch_sharding, replicated
 
 __all__ = ["ShardedTrainer"]
+
+# distinct stats name per auto-wrapped step_stream feed (the datafeed
+# registry is latest-wins per name; concurrent trainers must not evict
+# each other's telemetry)
+_stream_seq = itertools.count()
 
 
 def _owned_on(v, device):
@@ -223,18 +230,11 @@ class ShardedTrainer:
                 "models or a single (n_steps, batch, ...) array — a list "
                 "is ambiguous")
         data_list = data if isinstance(data, tuple) else (data,)
-        # dim 0 = steps (unsharded), dim 1 = batch sharded over ALL batch
-        # axes jointly (matches batch_sharding used by step())
-        spec = PartitionSpec(None, self._batch_axes)
-        xs = tuple(jax.device_put(
-            x._data if isinstance(x, NDArray) else jnp.asarray(x),
-            NamedSharding(self._mesh, spec)) for x in data_list)
-        ys = label._data if isinstance(label, NDArray) else jnp.asarray(label)
+        xs, ys = self._place_span(
+            tuple(x._data if isinstance(x, NDArray) else jnp.asarray(x)
+                  for x in data_list),
+            label._data if isinstance(label, NDArray) else jnp.asarray(label))
         n_steps = xs[0].shape[0]
-        ys = jax.device_put(ys, NamedSharding(
-            self._mesh,
-            PartitionSpec(None, self._batch_axes) if ys.ndim >= 2
-            else PartitionSpec(None)))
         # same input-path injection as step(): one fire poisons the whole
         # staged span (this call IS one input staging)
         if _chaos.poisoned("trainer.grads"):
@@ -251,6 +251,132 @@ class ShardedTrainer:
         # call would add ~2 host roundtrips per BN layer per span — ~5s on
         # a ResNet-50 over the tunneled chip (measured, bench_datafed).
         return NDArray(losses)
+
+    def _place_span(self, xs, ys):
+        """Place already-stacked ``(n_steps, batch, ...)`` inputs/labels on
+        the mesh in the span layout ``_step_many_fn`` consumes: dim 0 =
+        steps (unsharded), dim 1 = batch sharded over ALL batch axes
+        jointly (matches ``batch_sharding`` used by step()). The single
+        definition of the span sharding convention — step_many and
+        step_stream both route through it."""
+        spec = PartitionSpec(None, self._batch_axes)
+        xs = tuple(jax.device_put(x, NamedSharding(self._mesh, spec))
+                   for x in xs)
+        ys = jax.device_put(ys, NamedSharding(
+            self._mesh,
+            PartitionSpec(None, self._batch_axes) if ys.ndim >= 2
+            else PartitionSpec(None)))
+        return xs, ys
+
+    def _stack_span(self, xs_list, ys_list):
+        """Stack per-step staged device batches into the span layout.
+        Device-side only: the inputs are already resident (DeviceFeed
+        staged them), so this is a concat + reshard in HBM, never an H2D
+        transfer."""
+        n_inputs = len(xs_list[0])
+        return self._place_span(
+            tuple(jnp.stack([row[i] for row in xs_list])
+                  for i in range(n_inputs)),
+            jnp.stack(ys_list))
+
+    def step_stream(self, feed, steps=None, chunk=None, lr=None):
+        """Run training steps off a :class:`~.datafeed.DeviceFeed` (or any
+        batch source, auto-wrapped) in chunked fused spans: chunk N runs as
+        ONE compiled ``lax.scan`` program (the :meth:`step_many` function,
+        params/opt-state donated across chunks) while the feed's stager
+        thread keeps chunk N+1's batches flowing onto the device — the H2D
+        staging that :meth:`step` pays serially and :meth:`step_many` pays
+        up front for the whole span overlaps with compute instead.
+
+        Parameters
+        ----------
+        feed : DeviceFeed or iterable
+            Source of ``(data, label)`` batches. A non-DeviceFeed source is
+            wrapped in one on this trainer's mesh/batch axes (and closed on
+            return); pass an explicit ``DeviceFeed`` to control depth or to
+            keep the feed alive across calls (restore-and-replay resumes
+            consuming where the fault stopped it).
+        steps : int, optional
+            Max steps to run (default: until the feed is exhausted).
+        chunk : int, optional
+            Steps per compiled span (default ``MXNET_DATAFEED_CHUNK``). A
+            short tail compiles one extra span program for its length.
+        lr : float, optional
+            Learning-rate override, as in :meth:`step`.
+
+        Returns the per-step losses as an NDArray of shape ``(n_run,)``.
+        Fires the same pre-mutation ``trainer.step`` chaos point as
+        :meth:`step`/:meth:`step_many` once per chunk BEFORE consuming from
+        the feed, so a fault leaves both the trainer and the feed
+        consistent for restore-and-replay; the ``trainer.grads`` poison
+        point fires per staged span. BatchNorm aux stats land in the Block
+        on :meth:`sync_back`, as with :meth:`step_many`.
+        """
+        from .datafeed import DeviceFeed
+        if chunk is None:
+            from .. import config as _config
+            chunk = _config.get("MXNET_DATAFEED_CHUNK")
+        chunk = int(chunk)
+        if chunk < 1:
+            raise ValueError("chunk must be >= 1, got %r" % (chunk,))
+        if steps is not None and steps < 0:
+            raise ValueError("steps must be >= 0, got %r" % (steps,))
+        if self._step_many_fn is None:
+            self._build_step_many()
+        owned = not isinstance(feed, DeviceFeed)
+        if owned:
+            feed = DeviceFeed(feed, mesh=self._mesh,
+                              batch_axes=self._batch_axes,
+                              name="step_stream.%d" % next(_stream_seq))
+        try:
+            it = iter(feed)
+            losses_out = []
+            remaining = None if steps is None else int(steps)
+            while remaining is None or remaining > 0:
+                # peek ONE batch first so a dry feed never fires the chaos
+                # point (exactly one fire per chunk of real work, matching
+                # step()/step_many() parity), then fire BEFORE any state
+                # mutates — and hand the peeked batch back on a fault so
+                # the replay loses nothing
+                try:
+                    first = next(it)
+                except StopIteration:
+                    break
+                try:
+                    _chaos.point("trainer.step")
+                except BaseException:
+                    feed._unget(first)
+                    raise
+                take = chunk if remaining is None else min(chunk, remaining)
+                xs_list, ys_list = [first[0]], [first[1]]
+                while len(xs_list) < take:
+                    try:
+                        xs, y = next(it)
+                    except StopIteration:
+                        break
+                    xs_list.append(xs)
+                    ys_list.append(y)
+                n = len(xs_list)
+                xs, ys = self._stack_span(xs_list, ys_list)
+                if _chaos.poisoned("trainer.grads"):
+                    from ..resilience.guardrails import poison_nonfinite
+                    xs, ys = poison_nonfinite(xs, ys)
+                key = _random.next_key()
+                losses, self._values, self._states = self._step_many_fn(
+                    key, self._values, self._states, self._t + 1,
+                    lr if lr is not None else self._lr, *xs, ys)
+                self._t += n
+                losses_out.append(losses)
+                if remaining is not None:
+                    remaining -= n
+        finally:
+            if owned:
+                feed.close()
+        if not losses_out:
+            return NDArray(jnp.zeros((0,), jnp.float32))
+        if len(losses_out) == 1:
+            return NDArray(losses_out[0])
+        return NDArray(jnp.concatenate(losses_out))
 
     def forward(self, data):
         """Sharded inference forward (no grad, no update)."""

@@ -202,6 +202,8 @@ class ModelServer:
             self.metrics.set_gauge_fn("breaker", self.breaker.snapshot)
         self.metrics.set_gauge_fn("retry", _retry.all_stats)
         self.metrics.set_gauge_fn("guardrails", _guardrails.all_stats)
+        from ..parallel import datafeed as _datafeed
+        self.metrics.set_gauge_fn("datafeed", _datafeed.feed_stats)
         if bind_profiler:
             self.metrics.bind_profiler()
         self._draining = False
