@@ -70,6 +70,7 @@ _LAZY = {
     "torch_bridge": ".torch_bridge",
     "serving": ".serving",
     "resilience": ".resilience",
+    "observability": ".observability",
 }
 
 

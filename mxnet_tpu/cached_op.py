@@ -32,6 +32,7 @@ import jax
 from . import _tape
 from . import config as _config
 from . import random as _random
+from .observability import tracer as _trace
 
 __all__ = ["CachedOp", "cache_stats", "reset_cache_stats"]
 
@@ -164,7 +165,15 @@ class CachedOp:
                 self._cache.move_to_end(sig)
                 self._stats["hits"] += 1
         if entry is None:
-            compiled = self._compile(args)  # outside the lock (see __init__)
+            # compile outside the lock (see __init__); the span makes XLA
+            # compiles first-class timeline citizens, labeled with the
+            # shape bucket (leading dim of the first input) that triggered
+            # them — the classic "why was THIS request 2s?" answer
+            with _trace.span("cachedop.compile", op=self._name,
+                             bucket=(args[0].shape[0]
+                                     if args and args[0].shape else None),
+                             signature=str(sig[0])):
+                compiled = self._compile(args)
             evicted = 0
             with self._dispatch_lock:
                 entry = self._cache.get(sig)

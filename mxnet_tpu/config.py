@@ -117,6 +117,13 @@ KNOBS = dict([
     _k("MXNET_DATAFEED_CHUNK", 8, int, "wired",
        "ShardedTrainer.step_stream steps per compiled lax.scan span — "
        "chunk N+1 stages while chunk N computes"),
+    _k("MXNET_TRACE_ENABLE", 0, int, "wired",
+       "record host-side spans from import (observability/tracer.py); "
+       "profiler.set_state('run') enables tracing for its session "
+       "regardless of this knob"),
+    _k("MXNET_TRACE_BUFFER", 65536, int, "wired",
+       "span ring-buffer capacity in events — full buffer drops the "
+       "OLDEST record, so long runs trace at bounded memory"),
     # ---- subsumed by XLA/PJRT --------------------------------------------
     _k("MXNET_EXEC_BULK_EXEC_INFERENCE", 1, int, "subsumed",
        "XLA compiles whole programs; bulking is implicit"),

@@ -1,0 +1,49 @@
+"""End-to-end tracing for the serving + training stack.
+
+Role parity: the reference's ``src/profiler/`` recorded nested host/device
+events per thread and ``MXDumpProfile`` emitted chrome://tracing JSON — the
+timeline MXNet users actually open to diagnose queue stalls and overlap
+failures. This package is that layer for the TPU stack, host side:
+
+- :mod:`.tracer` — a thread-aware span recorder with a bounded,
+  drop-oldest ring buffer, trace/span IDs with parent linkage (the
+  Dapper-style propagation model), instant events, counter samples, and a
+  near-zero-cost disabled path. Knobs: ``MXNET_TRACE_ENABLE``,
+  ``MXNET_TRACE_BUFFER``.
+- :mod:`.export` — Chrome Trace Event Format JSON, loadable in Perfetto
+  (https://ui.perfetto.dev) or chrome://tracing; ``profiler.dump()`` routes
+  through it, restoring reference ``MXDumpProfile`` parity on CPU-only
+  runs (the optional jax.profiler XPlane trace rides alongside).
+
+Instrumented call chains (see ``docs/observability.md``):
+
+- serving: ``serving.http`` (``X-Request-Id``) → ``serving.queue_wait`` →
+  ``serving.batch_assemble``/``serving.batch_execute`` →
+  ``serving.engine.execute``, linked by trace id across the HTTP handler
+  and batcher worker threads.
+- training: ``trainer.step`` / ``trainer.step_many`` / per-chunk
+  ``trainer.chunk`` spans, ``datafeed.stage`` on the stager thread vs.
+  ``datafeed.consumer_wait`` on the consumer (the overlap proof),
+  ``cachedop.compile``, ``checkpoint.save``/``restore``, and instant
+  events for guardrail skips/anomalies, retry attempts, and breaker state
+  transitions.
+
+``tools/trace_summary.py`` reads a dumped trace and prints the critical
+path (compute vs. stage-wait vs. queue-wait, overlap efficiency, top-N
+slowest spans).
+"""
+from .tracer import (SpanContext, Tracer, attach, clear, complete, counter,
+                     current, disable, enable, enabled, event_count, events,
+                     instant, now, phase_stats, reset_phase_stats, span,
+                     summary_gauge)
+from .export import chrome_trace_events, dump_chrome_trace, to_chrome_trace
+
+# NOTE: the process-wide Tracer instance lives at ``tracer.tracer`` (the
+# submodule keeps the name; re-exporting it here would shadow the
+# ``observability.tracer`` module itself).
+
+__all__ = ["Tracer", "SpanContext", "span", "instant", "counter",
+           "complete", "attach", "current", "enable", "disable", "enabled",
+           "clear", "events", "event_count", "now", "phase_stats",
+           "reset_phase_stats", "summary_gauge", "chrome_trace_events",
+           "to_chrome_trace", "dump_chrome_trace"]

@@ -1,0 +1,100 @@
+"""Chrome Trace Event Format export (reference ``MXDumpProfile`` parity).
+
+Converts the tracer's event tuples into the JSON object format described
+in the Trace Event Format spec (the chrome://tracing / Perfetto interchange
+format): ``"X"`` complete events with ``ts``/``dur`` in microseconds,
+``"i"`` instants, ``"C"`` counters, plus ``"M"`` metadata records naming
+the process and every thread that recorded an event. Span/parent/trace ids
+ride in ``args`` so tools (``tools/trace_summary.py``, Perfetto SQL) can
+rebuild the causal chains the Dapper-style propagation established.
+"""
+from __future__ import annotations
+
+import json
+import math
+
+__all__ = ["chrome_trace_events", "to_chrome_trace", "dump_chrome_trace"]
+
+PROCESS_NAME = "mxnet_tpu"
+
+
+def _category(name):
+    return name.split(".", 1)[0]
+
+
+def _json_safe(value):
+    """Args must serialize to SPEC-VALID JSON: leave natives alone,
+    stringify the rest (shapes, dtypes, exception reprs). Non-finite
+    floats become strings — ``json.dump`` would otherwise emit bare
+    ``NaN``/``Infinity`` tokens no spec-compliant parser accepts, and the
+    trace most likely to carry a NaN attribute (a guardrails.skip on a
+    non-finite loss) is exactly the one the user needs to open."""
+    if isinstance(value, float) and not math.isfinite(value):
+        return str(value)
+    if isinstance(value, (str, int, float, bool)) or value is None:
+        return value
+    if isinstance(value, (list, tuple)):
+        return [_json_safe(v) for v in value]
+    if isinstance(value, dict):
+        return {str(k): _json_safe(v) for k, v in value.items()}
+    return str(value)
+
+
+def chrome_trace_events(events, pid=None):
+    """Map tracer event tuples to Chrome Trace Event dicts (metadata
+    records first, then the events oldest-first)."""
+    if pid is None:
+        import os
+        pid = os.getpid()
+    threads = {}
+    out = []
+    for ph, name, ts, dur, tid, tname, span_id, parent_id, trace_id, args \
+            in events:
+        if tname and threads.get(tid) is None:
+            threads[tid] = tname
+        record = {
+            "ph": ph,
+            "name": name,
+            "cat": _category(name),
+            "pid": pid,
+            "tid": tid,
+            "ts": round(ts * 1e6, 3),
+        }
+        if ph == "X":
+            record["dur"] = round(dur * 1e6, 3)
+        elif ph == "i":
+            record["s"] = "t"  # thread-scoped instant
+        merged = dict(args) if args else {}
+        if ph != "C":
+            merged["span_id"] = span_id
+            merged["parent_id"] = parent_id
+            merged["trace_id"] = trace_id
+        record["args"] = _json_safe(merged)
+        out.append(record)
+    meta = [{"ph": "M", "name": "process_name", "pid": pid, "tid": 0,
+             "args": {"name": PROCESS_NAME}}]
+    for tid, tname in sorted(threads.items()):
+        meta.append({"ph": "M", "name": "thread_name", "pid": pid,
+                     "tid": tid, "args": {"name": tname}})
+    return meta + out
+
+
+def to_chrome_trace(events, pid=None):
+    """The full JSON-object-format document Perfetto/chrome://tracing
+    loads directly."""
+    return {"traceEvents": chrome_trace_events(events, pid=pid),
+            "displayTimeUnit": "ms"}
+
+
+def dump_chrome_trace(path, events=None, pid=None):
+    """Write the trace document for ``events`` (default: the module
+    tracer's buffer) to ``path``; returns ``path``."""
+    if events is None:
+        from .tracer import tracer
+        events = tracer.events()
+    doc = to_chrome_trace(events, pid=pid)
+    with open(path, "w") as f:
+        # allow_nan=False: fail loudly if a non-finite ever slips past
+        # _json_safe rather than write a file browsers can't parse
+        json.dump(doc, f, allow_nan=False)
+    return path

@@ -18,6 +18,7 @@ import shutil
 import jax
 import numpy as np
 
+from ..observability import tracer as _trace
 from ..resilience import chaos as _chaos
 
 __all__ = ["save_checkpoint", "restore_checkpoint"]
@@ -54,6 +55,11 @@ def save_checkpoint(trainer, path, force=True):
     by the ``checkpoint.save`` chaos point, which fires between staging
     and publish) leaves the previous good checkpoint at ``path`` intact,
     never a partial write that :func:`restore_checkpoint` would load."""
+    with _trace.span("checkpoint.save", path=path, step=trainer._t):
+        return _save_checkpoint(trainer, path, force)
+
+
+def _save_checkpoint(trainer, path, force):
     import orbax.checkpoint as ocp
 
     path = os.path.abspath(path)
@@ -85,6 +91,11 @@ def restore_checkpoint(trainer, path):
     """Restore a checkpoint written by :func:`save_checkpoint` onto the
     trainer's CURRENT mesh/shardings — the device topology may differ from
     the one that saved (elastic resume), as long as shapes match."""
+    with _trace.span("checkpoint.restore", path=path):
+        return _restore_checkpoint(trainer, path)
+
+
+def _restore_checkpoint(trainer, path):
     import orbax.checkpoint as ocp
 
     path = os.path.abspath(path)

@@ -42,6 +42,7 @@ import numpy as np
 import jax
 
 from ..ndarray.ndarray import NDArray
+from ..observability import tracer as _trace
 from ..resilience._stats import Registry, export_rows
 from .mesh import batch_sharding
 
@@ -245,10 +246,14 @@ class DeviceFeed:
                         "(data, label)" % type(item).__name__)
 
     def _stage_item(self, item):
-        if self._output == "batch":
-            return self._stage_structure(item)
-        xs, y = self._split(item)
-        return (tuple(self._put_one(x) for x in xs), self._put_one(y))
+        # recorded on the stager thread: datafeed.stage spans interleaving
+        # with the consumer's trainer.chunk spans on another lane is the
+        # visual proof that H2D staging overlaps compute
+        with _trace.span("datafeed.stage", feed=self.name):
+            if self._output == "batch":
+                return self._stage_structure(item)
+            xs, y = self._split(item)
+            return (tuple(self._put_one(x) for x in xs), self._put_one(y))
 
     def _stage_structure(self, item):
         """pin_memory mode: same structure out, device-backed NDArray
@@ -304,10 +309,12 @@ class DeviceFeed:
         if self._exhausted:
             raise StopIteration
         waited = None
+        wait_t0 = None
         try:
             item = self._ring.get_nowait()
         except queue.Empty:
             t0 = time.perf_counter()
+            wait_t0 = _trace.now()
             try:
                 item = self._ring.get(timeout=self._timeout)
             except queue.Empty:
@@ -330,6 +337,12 @@ class DeviceFeed:
                 # received the end-of-epoch sentinel is not a stall.)
                 self._stats["stage_waits"] += 1
                 self._stats["stage_wait_s"] += waited
+        if waited is not None and _trace.enabled():
+            # the consumer-side stall the pipeline exists to eliminate;
+            # on the trace it nests inside the consuming trainer.chunk
+            _trace.complete("datafeed.consumer_wait", wait_t0,
+                            wait_t0 + waited, parent=_trace.current(),
+                            feed=self.name)
         return item
 
     next = __next__

@@ -20,6 +20,7 @@ from jax.sharding import NamedSharding, PartitionSpec
 
 from .. import random as _random
 from ..ndarray.ndarray import NDArray
+from ..observability import tracer as _trace
 from ..resilience import chaos as _chaos
 from .functional import functionalize, functional_optimizer, shard_params
 from .mesh import make_mesh, batch_sharding, replicated
@@ -173,6 +174,10 @@ class ShardedTrainer:
         a tuple means multi-input; lists are rejected as ambiguous. Each
         input is batch-sharded over the dp axes. Returns the (replicated)
         scalar loss as a host float-convertible array."""
+        with _trace.span("trainer.step", t=self._t + 1):
+            return self._step_impl(data, label, lr)
+
+    def _step_impl(self, data, label, lr):
         # injection point BEFORE any state mutates: a fault leaves the
         # trainer consistent, so restore-and-replay (resilience.resume)
         # resumes from exactly the pre-step state
@@ -221,6 +226,10 @@ class ShardedTrainer:
         multi-input models (lists are rejected as ambiguous); label:
         (n_steps, batch, ...).
         """
+        with _trace.span("trainer.step_many", t0=self._t + 1):
+            return self._step_many_impl(data, label, lr)
+
+    def _step_many_impl(self, data, label, lr):
         _chaos.point("trainer.step")  # same pre-mutation contract as step()
         if self._step_many_fn is None:
             self._build_step_many()
@@ -332,43 +341,55 @@ class ShardedTrainer:
             it = iter(feed)
             losses_out = []
             remaining = None if steps is None else int(steps)
+            chunk_idx = 0
             while remaining is None or remaining > 0:
-                # peek ONE batch first so a dry feed never fires the chaos
-                # point (exactly one fire per chunk of real work, matching
-                # step()/step_many() parity), then fire BEFORE any state
-                # mutates — and hand the peeked batch back on a fault so
-                # the replay loses nothing
-                try:
-                    first = next(it)
-                except StopIteration:
-                    break
-                try:
-                    _chaos.point("trainer.step")
-                except BaseException:
-                    feed._unget(first)
-                    raise
-                take = chunk if remaining is None else min(chunk, remaining)
-                xs_list, ys_list = [first[0]], [first[1]]
-                while len(xs_list) < take:
+                # the chunk span covers feed consumption (where stage
+                # waits appear as nested datafeed.consumer_wait spans),
+                # span stacking, and the fused dispatch — one timeline box
+                # per compiled lax.scan program. Cancelled (not recorded)
+                # when the feed turns out to be dry.
+                with _trace.span("trainer.chunk", feed=feed.name,
+                                 chunk=chunk_idx, t0=self._t + 1) as sp:
+                    # peek ONE batch first so a dry feed never fires the
+                    # chaos point (exactly one fire per chunk of real
+                    # work, matching step()/step_many() parity), then fire
+                    # BEFORE any state mutates — and hand the peeked batch
+                    # back on a fault so the replay loses nothing
                     try:
-                        xs, y = next(it)
+                        first = next(it)
                     except StopIteration:
+                        sp.cancel()
                         break
-                    xs_list.append(xs)
-                    ys_list.append(y)
-                n = len(xs_list)
-                xs, ys = self._stack_span(xs_list, ys_list)
-                if _chaos.poisoned("trainer.grads"):
-                    from ..resilience.guardrails import poison_nonfinite
-                    xs, ys = poison_nonfinite(xs, ys)
-                key = _random.next_key()
-                losses, self._values, self._states = self._step_many_fn(
-                    key, self._values, self._states, self._t + 1,
-                    lr if lr is not None else self._lr, *xs, ys)
-                self._t += n
-                losses_out.append(losses)
-                if remaining is not None:
-                    remaining -= n
+                    try:
+                        _chaos.point("trainer.step")
+                    except BaseException:
+                        feed._unget(first)
+                        raise
+                    take = (chunk if remaining is None
+                            else min(chunk, remaining))
+                    xs_list, ys_list = [first[0]], [first[1]]
+                    while len(xs_list) < take:
+                        try:
+                            xs, y = next(it)
+                        except StopIteration:
+                            break
+                        xs_list.append(xs)
+                        ys_list.append(y)
+                    n = len(xs_list)
+                    sp.set(steps=n)
+                    xs, ys = self._stack_span(xs_list, ys_list)
+                    if _chaos.poisoned("trainer.grads"):
+                        from ..resilience.guardrails import poison_nonfinite
+                        xs, ys = poison_nonfinite(xs, ys)
+                    key = _random.next_key()
+                    losses, self._values, self._states = self._step_many_fn(
+                        key, self._values, self._states, self._t + 1,
+                        lr if lr is not None else self._lr, *xs, ys)
+                    self._t += n
+                    losses_out.append(losses)
+                    if remaining is not None:
+                        remaining -= n
+                chunk_idx += 1
         finally:
             if owned:
                 feed.close()

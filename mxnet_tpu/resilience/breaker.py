@@ -31,6 +31,8 @@ import threading
 import time
 from collections import deque
 
+from ..observability import tracer as _trace
+
 __all__ = ["CircuitBreaker", "CircuitOpen"]
 
 CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
@@ -95,6 +97,12 @@ class CircuitBreaker:
             self._maybe_half_open_locked()
             return self._state
 
+    def _transition_event(self, state):
+        # timeline instant per state change (tracer append is lock-free,
+        # safe under self._lock) — an open/half-open/closed sequence lines
+        # up against the request spans that drove it
+        _trace.instant("breaker.state", breaker=self.name, state=state)
+
     def _maybe_half_open_locked(self):
         if self._state == OPEN and \
                 self._clock() - self._opened_at >= self.recovery_s:
@@ -103,6 +111,7 @@ class CircuitBreaker:
             self._probes_in_flight = 0
             self._probe_successes = 0
             self._c["half_open"] += 1
+            self._transition_event(HALF_OPEN)
 
     def _open_locked(self):
         self._state = OPEN
@@ -113,6 +122,7 @@ class CircuitBreaker:
         self._probes_in_flight = 0
         self._probe_successes = 0
         self._c["opened"] += 1
+        self._transition_event(OPEN)
 
     def _close_locked(self):
         self._state = CLOSED
@@ -122,6 +132,7 @@ class CircuitBreaker:
         self._probes_in_flight = 0
         self._probe_successes = 0
         self._c["closed"] += 1
+        self._transition_event(CLOSED)
 
     def _is_probe_locked(self, admission):
         """Does ``admission`` denote the probe of the CURRENT half-open

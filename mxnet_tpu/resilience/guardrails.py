@@ -49,6 +49,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ..observability import tracer as _trace
 from . import chaos as _chaos
 from ._stats import Registry, export_rows
 from .chaos import Fault
@@ -609,6 +610,11 @@ class GuardedStep:
             self._last = {"loss": loss, "grad_norm": gnorm,
                           "loss_scale": scale, "skips": int(skips),
                           "ok": ok}
+            if not ok:
+                # skipped step as a timeline instant: a NaN burst shows up
+                # exactly where it happened in the step sequence
+                _trace.instant("guardrails.skip", guarded=self.name,
+                               step=step_no, loss=loss, loss_scale=scale)
             self._skips = int(skips)
             if (ok and self._clip_norm is not None
                     and np.isfinite(gnorm) and gnorm > self._clip_norm):
@@ -620,6 +626,8 @@ class GuardedStep:
                     storm = (step_no, loss)
         if storm is not None and self._raise_on_storm:
             self._detector.reset()
+            _trace.instant("guardrails.anomaly", guarded=self.name,
+                           step=storm[0], loss=storm[1], kind="nan_storm")
             raise AnomalyFault(
                 "NaN storm: >= %d skipped steps in the last %d (at step "
                 "%d) — restore-and-replay" % (self._detector.storm_skips,

@@ -26,6 +26,7 @@ import random as _random
 import threading
 import time
 
+from ..observability import tracer as _trace
 from .chaos import TransientFault
 
 __all__ = ["RetryPolicy", "RetryExhausted", "retryable", "named_policy",
@@ -134,6 +135,13 @@ class RetryPolicy:
                 with self._lock:
                     self._c["retries"] += 1
                     self._backoff_total_s += delay_ms / 1e3
+                # attempts become timeline instants: a retried request's
+                # extra latency is attributable on the trace, not just a
+                # counter bump
+                _trace.instant("retry.attempt", policy=self.name,
+                               attempt=attempt,
+                               delay_ms=round(delay_ms, 3),
+                               error=type(exc).__name__)
                 self._sleep(delay_ms / 1e3)
             else:
                 with self._lock:
@@ -141,6 +149,8 @@ class RetryPolicy:
                 return out
         with self._lock:
             self._c["giveups"] += 1
+        _trace.instant("retry.giveup", policy=self.name, attempts=attempt,
+                       error=type(last).__name__)
         raise RetryExhausted(
             "%s: gave up after %d attempt(s): %s: %s"
             % (self.name, attempt, type(last).__name__, last),

@@ -41,6 +41,7 @@ from concurrent.futures import Future
 
 import numpy as _np
 
+from ..observability import tracer as _trace
 from ..resilience import chaos as _chaos
 from ..resilience import retry as _retry
 
@@ -65,15 +66,21 @@ class ServerClosed(ServingError):
 
 
 class _Request:
-    __slots__ = ("inputs", "future", "enqueue_t", "deadline", "sig")
+    __slots__ = ("inputs", "future", "enqueue_t", "deadline", "sig",
+                 "ctx", "request_id")
 
-    def __init__(self, inputs, timeout_ms):
+    def __init__(self, inputs, timeout_ms, request_id=None):
         self.inputs = inputs
         self.future = Future()
         self.enqueue_t = time.monotonic()
         self.deadline = (self.enqueue_t + timeout_ms / 1e3
                          if timeout_ms else None)
         self.sig = tuple((a.shape, str(a.dtype)) for a in inputs)
+        # trace propagation: capture the submitter's span context (the
+        # serving.http span) so the worker thread can link this request's
+        # queue-wait and execution spans back to it
+        self.request_id = request_id
+        self.ctx = _trace.current()
 
 
 class DynamicBatcher:
@@ -141,15 +148,16 @@ class DynamicBatcher:
         with self._lock:
             return len(self._queue)
 
-    def submit(self, *inputs, timeout_ms=None):
+    def submit(self, *inputs, timeout_ms=None, request_id=None):
         """Enqueue one sample (each input WITHOUT batch axis); returns a
         ``concurrent.futures.Future`` resolving to the sample's output row
         (numpy), or a tuple of rows for multi-output models. Raises
-        :class:`ServerBusy` / :class:`ServerClosed` synchronously."""
+        :class:`ServerBusy` / :class:`ServerClosed` synchronously.
+        ``request_id`` labels the request's spans in the trace."""
         if timeout_ms is None:
             timeout_ms = self._default_timeout_ms
         arrays = tuple(_np.asarray(x) for x in inputs)
-        req = _Request(arrays, timeout_ms)
+        req = _Request(arrays, timeout_ms, request_id=request_id)
         with self._lock:
             if self._closing:
                 raise ServerClosed("batcher is shut down")
@@ -162,9 +170,10 @@ class DynamicBatcher:
             self._not_empty.notify()
         return req.future
 
-    def predict(self, *inputs, timeout_ms=None):
+    def predict(self, *inputs, timeout_ms=None, request_id=None):
         """Blocking single-sample prediction through the shared batch."""
-        return self.submit(*inputs, timeout_ms=timeout_ms).result()
+        return self.submit(*inputs, timeout_ms=timeout_ms,
+                           request_id=request_id).result()
 
     def close(self, drain=True, timeout=None):
         """Stop intake; with ``drain`` the worker finishes the backlog
@@ -295,6 +304,16 @@ class DynamicBatcher:
                     return  # closed and (if draining) queue empty
                 if not batch:
                     continue
+                if _trace.enabled():
+                    # the wait each request just finished, recorded after
+                    # the fact and linked to its serving.http span — the
+                    # "queue" phase of a p99 decomposition
+                    popped_t = time.monotonic()
+                    for req in batch:
+                        _trace.complete("serving.queue_wait",
+                                        req.enqueue_t, popped_t,
+                                        parent=req.ctx,
+                                        request_id=req.request_id)
                 try:
                     self._execute(batch)
                 except BaseException as exc:  # _execute's guards failed too
@@ -328,10 +347,24 @@ class DynamicBatcher:
             self._resolve(req.future, exc=err)
 
     def _execute(self, batch):
+        if not _trace.enabled():
+            return self._execute_inner(batch)
+        # one execution span for the coalesced batch; a span cannot have
+        # many parents, so it adopts the first request's trace and carries
+        # every member's request id as an attribute (the summary tool and
+        # Perfetto queries join on those)
+        with _trace.span("serving.batch_execute", rows=len(batch),
+                         request_ids=[r.request_id for r in batch
+                                      if r.request_id is not None],
+                         parent=batch[0].ctx):
+            return self._execute_inner(batch)
+
+    def _execute_inner(self, batch):
         try:
             n_inputs = len(batch[0].inputs)
-            stacked = [_np.stack([r.inputs[i] for r in batch], axis=0)
-                       for i in range(n_inputs)]
+            with _trace.span("serving.batch_assemble", rows=len(batch)):
+                stacked = [_np.stack([r.inputs[i] for r in batch], axis=0)
+                           for i in range(n_inputs)]
 
             def run_model():
                 # chaos point INSIDE the retried callable: each retry

@@ -29,6 +29,7 @@ import numpy as _np
 
 from ..cached_op import CachedOp
 from ..ndarray import ndarray as _nd
+from ..observability import tracer as _trace
 from ..resilience import retry as _retry
 
 __all__ = ["InferenceEngine", "DEFAULT_BUCKETS"]
@@ -129,26 +130,27 @@ class InferenceEngine:
         bucket = self.bucket_for(n)
         with self._lock:
             self._buckets_seen.add(bucket)
-        padded = []
-        for a in arrays:
-            if a.shape[0] != n:
-                raise ValueError(
-                    "all inputs must share batch size: got %d vs %d"
-                    % (a.shape[0], n))
+        with _trace.span("serving.engine.execute", bucket=bucket, rows=n):
+            padded = []
+            for a in arrays:
+                if a.shape[0] != n:
+                    raise ValueError(
+                        "all inputs must share batch size: got %d vs %d"
+                        % (a.shape[0], n))
+                if n < bucket:
+                    fill = _nd.zeros((bucket - n,) + tuple(a.shape[1:]),
+                                     dtype=a.dtype)
+                    a = _nd.concat(a, fill, dim=0)
+                padded.append(a)
+            if self._op is not None:
+                out = self._op(*padded)
+            else:
+                out = self._model(*padded)
+            multi = isinstance(out, (list, tuple))
+            outs = list(out) if multi else [out]
             if n < bucket:
-                fill = _nd.zeros((bucket - n,) + tuple(a.shape[1:]),
-                                 dtype=a.dtype)
-                a = _nd.concat(a, fill, dim=0)
-            padded.append(a)
-        if self._op is not None:
-            out = self._op(*padded)
-        else:
-            out = self._model(*padded)
-        multi = isinstance(out, (list, tuple))
-        outs = list(out) if multi else [out]
-        if n < bucket:
-            outs = [o[0:n] for o in outs]
-        return outs, multi
+                outs = [o[0:n] for o in outs]
+            return outs, multi
 
     # ---- execution --------------------------------------------------------
     def predict(self, *inputs):

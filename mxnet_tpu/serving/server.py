@@ -27,15 +27,25 @@ Resilience: model failures feed a
 :class:`~mxnet_tpu.resilience.breaker.CircuitBreaker`; while it is open,
 ``/predict`` fast-fails with 503 + ``Retry-After`` instead of queueing
 doomed work, then half-open probes let real traffic close it again.
+
+Tracing: every ``/predict`` gets an ``X-Request-Id`` (honored from the
+incoming header, minted otherwise) echoed on the response, and — while
+``mxnet_tpu.observability`` tracing is on — a ``serving.http`` root span
+carrying it. The request's queue wait, batch assembly, and engine
+execution are recorded as linked spans (same trace id) even though they
+run on the batcher worker thread, so a p99 outlier in ``profiler.dump()``
+decomposes into its phases instead of being one opaque latency number.
 """
 from __future__ import annotations
 
 import json
 import threading
+import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as _np
 
+from ..observability import tracer as _trace
 from ..resilience import guardrails as _guardrails
 from ..resilience import retry as _retry
 from ..resilience.breaker import CircuitBreaker
@@ -59,12 +69,18 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        rid = getattr(self, "_request_id", None)
+        if rid is not None:
+            self.send_header("X-Request-Id", rid)
         for k, v in (headers or {}).items():
             self.send_header(k, v)
         self.end_headers()
         self.wfile.write(body)
 
     def do_GET(self):  # noqa: N802 (http.server API)
+        # a keep-alive connection reuses this handler across requests: a
+        # GET after a POST must not echo the POST's stale request id
+        self._request_id = None
         srv = self.server.model_server
         if self.path == "/healthz":
             self._reply(200, srv.health())
@@ -74,6 +90,15 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply(404, {"error": "unknown path %s" % self.path})
 
     def do_POST(self):  # noqa: N802
+        # the request id propagates: honored from the client's header
+        # (upstream tracing), minted otherwise; echoed on every reply and
+        # attached to the request's whole span chain
+        rid = self.headers.get("X-Request-Id") or uuid.uuid4().hex[:16]
+        self._request_id = rid
+        with _trace.span("serving.http", request_id=rid, path=self.path):
+            self._handle_post(rid)
+
+    def _handle_post(self, rid):
         srv = self.server.model_server
         # consume the body FIRST: an early reply with the body still unread
         # desyncs HTTP/1.1 keep-alive (the next request on the connection
@@ -122,7 +147,8 @@ class _Handler(BaseHTTPRequestHandler):
                         headers={"Retry-After": str(retry_after)})
             return
         try:
-            row = srv.batcher.predict(*inputs, timeout_ms=timeout_ms)
+            row = srv.batcher.predict(*inputs, timeout_ms=timeout_ms,
+                                      request_id=rid)
         except ServerBusy as e:
             # backpressure, not a model fault: the breaker must not trip
             if breaker is not None:
@@ -204,6 +230,9 @@ class ModelServer:
         self.metrics.set_gauge_fn("guardrails", _guardrails.all_stats)
         from ..parallel import datafeed as _datafeed
         self.metrics.set_gauge_fn("datafeed", _datafeed.feed_stats)
+        # trace-derived per-phase latency histograms on /metrics: the
+        # timeline's aggregate view without parsing the dumped JSON
+        self.metrics.set_gauge_fn("trace", _trace.summary_gauge)
         if bind_profiler:
             self.metrics.bind_profiler()
         self._draining = False

@@ -1,0 +1,199 @@
+#!/usr/bin/env python
+"""Summarize a dumped Chrome Trace Event JSON (``profiler.dump()``).
+
+Reads the ``profile.json`` the profiler writes and prints the numbers the
+timeline exists to surface:
+
+- the **critical-path split**: host compute (``trainer.*`` spans) vs.
+  stage wait (``datafeed.consumer_wait``) vs. queue wait
+  (``serving.queue_wait``) vs. XLA compiles (``cachedop.compile``), and
+  the staging **overlap efficiency** — the fraction of training time NOT
+  spent stalled on input staging (1.0 = perfect overlap, the
+  ``step_stream`` design target);
+- a per-span-name aggregate table (count / total / mean / max);
+- the **top-N slowest spans**, each with its request id when it carries
+  one — the p99 outlier, decomposed.
+
+Pure stdlib, no mxnet_tpu import needed: it reads the JSON interchange
+format, so it also works on traces copied off another host.
+
+Usage::
+
+    python tools/trace_summary.py /tmp/mxnet_tpu_profile/profile.json
+    python tools/trace_summary.py profile.json --top 20
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from collections import defaultdict
+
+# span-name prefixes -> critical-path category
+COMPUTE_PREFIXES = ("trainer.",)
+STAGE_WAIT_NAMES = ("datafeed.consumer_wait",)
+QUEUE_WAIT_NAMES = ("serving.queue_wait",)
+COMPILE_NAMES = ("cachedop.compile",)
+SERVING_ROOT = "serving.http"
+
+
+def load_trace(path):
+    """The ``traceEvents`` list from a Chrome Trace JSON file (object
+    format, or a bare event array)."""
+    with open(path) as f:
+        doc = json.load(f)
+    return doc["traceEvents"] if isinstance(doc, dict) else doc
+
+
+def _is_span(ev):
+    return ev.get("ph") == "X" and "dur" in ev
+
+
+def summarize(events, top=10):
+    """Aggregate a trace into one JSON-able summary dict."""
+    spans = [ev for ev in events if _is_span(ev)]
+    instants = [ev for ev in events if ev.get("ph") == "i"]
+    threads = {ev["tid"]: ev["args"].get("name", str(ev["tid"]))
+               for ev in events
+               if ev.get("ph") == "M" and ev.get("name") == "thread_name"}
+
+    by_name = defaultdict(lambda: [0, 0.0, 0.0])  # count, total_us, max_us
+    for ev in spans:
+        ent = by_name[ev["name"]]
+        ent[0] += 1
+        ent[1] += ev["dur"]
+        if ev["dur"] > ent[2]:
+            ent[2] = ev["dur"]
+
+    def total_ms(match):
+        if callable(match):
+            return sum(t for n, (_, t, _m) in by_name.items()
+                       if match(n)) / 1e3
+        return sum(by_name[n][1] for n in match if n in by_name) / 1e3
+
+    compute_ms = total_ms(lambda n: n.startswith(COMPUTE_PREFIXES))
+    # trainer.chunk nests inside nothing, but step/step_many are roots
+    # too: avoid double counting by preferring chunk/step/step_many spans
+    # only (trainer.* has no self-nesting today; keep the simple sum)
+    stage_wait_ms = total_ms(STAGE_WAIT_NAMES)
+    queue_wait_ms = total_ms(QUEUE_WAIT_NAMES)
+    compile_ms = total_ms(COMPILE_NAMES)
+    serving_ms = by_name[SERVING_ROOT][1] / 1e3 \
+        if SERVING_ROOT in by_name else 0.0
+
+    wall_ms = 0.0
+    if spans:
+        t0 = min(ev["ts"] for ev in spans)
+        t1 = max(ev["ts"] + ev["dur"] for ev in spans)
+        wall_ms = (t1 - t0) / 1e3
+
+    overlap_efficiency = None
+    if compute_ms > 0:
+        # stage waits happen INSIDE trainer chunk spans: efficiency is the
+        # fraction of training wall time not stalled on input staging
+        overlap_efficiency = max(0.0, 1.0 - stage_wait_ms / compute_ms)
+
+    slowest = sorted(spans, key=lambda ev: -ev["dur"])[:top]
+    top_spans = [{
+        "name": ev["name"],
+        "dur_ms": ev["dur"] / 1e3,
+        "ts_ms": ev["ts"] / 1e3,
+        "thread": threads.get(ev["tid"], str(ev["tid"])),
+        "request_id": (ev.get("args") or {}).get("request_id"),
+        "trace_id": (ev.get("args") or {}).get("trace_id"),
+    } for ev in slowest]
+
+    names = {name: {"count": c, "total_ms": t / 1e3, "mean_ms": t / c / 1e3,
+                    "max_ms": m / 1e3}
+             for name, (c, t, m) in by_name.items()}
+
+    instant_counts = defaultdict(int)
+    for ev in instants:
+        instant_counts[ev["name"]] += 1
+
+    return {
+        "spans": len(spans),
+        "instants": len(instants),
+        "threads": len(threads),
+        "wall_ms": wall_ms,
+        "critical_path": {
+            "compute_ms": compute_ms,
+            "stage_wait_ms": stage_wait_ms,
+            "queue_wait_ms": queue_wait_ms,
+            "compile_ms": compile_ms,
+            "serving_ms": serving_ms,
+        },
+        "overlap_efficiency": overlap_efficiency,
+        "by_name": names,
+        "instant_counts": dict(instant_counts),
+        "top_spans": top_spans,
+    }
+
+
+def format_summary(summary):
+    """Render :func:`summarize` output as the human-readable report."""
+    lines = []
+    cp = summary["critical_path"]
+    lines.append("Trace summary: %d spans, %d instants, %d threads, "
+                 "wall %.1f ms"
+                 % (summary["spans"], summary["instants"],
+                    summary["threads"], summary["wall_ms"]))
+    lines.append("")
+    lines.append("Critical path split:")
+    lines.append("  %-28s %12.2f ms" % ("train compute (trainer.*)",
+                                        cp["compute_ms"]))
+    lines.append("  %-28s %12.2f ms" % ("stage wait (consumer)",
+                                        cp["stage_wait_ms"]))
+    lines.append("  %-28s %12.2f ms" % ("serving queue wait",
+                                        cp["queue_wait_ms"]))
+    lines.append("  %-28s %12.2f ms" % ("XLA compiles", cp["compile_ms"]))
+    lines.append("  %-28s %12.2f ms" % ("serving requests (http)",
+                                        cp["serving_ms"]))
+    if summary["overlap_efficiency"] is not None:
+        lines.append("  staging overlap efficiency: %.1f%%"
+                     % (summary["overlap_efficiency"] * 100.0))
+    lines.append("")
+    lines.append("Per-span aggregates:")
+    lines.append("  %-32s %8s %12s %10s %10s"
+                 % ("name", "count", "total ms", "mean ms", "max ms"))
+    for name in sorted(summary["by_name"],
+                       key=lambda n: -summary["by_name"][n]["total_ms"]):
+        st = summary["by_name"][name]
+        lines.append("  %-32s %8d %12.2f %10.3f %10.3f"
+                     % (name, st["count"], st["total_ms"], st["mean_ms"],
+                        st["max_ms"]))
+    if summary["instant_counts"]:
+        lines.append("")
+        lines.append("Instant events:")
+        for name in sorted(summary["instant_counts"]):
+            lines.append("  %-32s %8d" % (name,
+                                          summary["instant_counts"][name]))
+    lines.append("")
+    lines.append("Top %d slowest spans:" % len(summary["top_spans"]))
+    for ev in summary["top_spans"]:
+        rid = (" request_id=%s" % ev["request_id"]) if ev["request_id"] \
+            else ""
+        lines.append("  %10.3f ms  %-28s [%s]%s"
+                     % (ev["dur_ms"], ev["name"], ev["thread"], rid))
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="Summarize a profiler.dump() Chrome Trace JSON")
+    ap.add_argument("trace", help="path to profile.json")
+    ap.add_argument("--top", type=int, default=10,
+                    help="slowest spans to list (default 10)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the summary as JSON instead of text")
+    args = ap.parse_args(argv)
+    summary = summarize(load_trace(args.trace), top=args.top)
+    if args.json:
+        print(json.dumps(summary, indent=2))
+    else:
+        print(format_summary(summary))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
