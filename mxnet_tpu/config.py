@@ -117,6 +117,29 @@ KNOBS = dict([
     _k("MXNET_DATAFEED_CHUNK", 8, int, "wired",
        "ShardedTrainer.step_stream steps per compiled lax.scan span — "
        "chunk N+1 stages while chunk N computes"),
+    _k("MXNET_ELASTIC_HEARTBEAT_MS", 1000.0, float, "wired",
+       "ElasticMember background-beater cadence (resilience/elastic.py); "
+       "per-step beats fire regardless"),
+    _k("MXNET_ELASTIC_DEADLINE_MS", 15000.0, float, "wired",
+       "missed-beat deadline after which the coordinator/supervisor "
+       "declares a host dead (covers compile gaps; lower it on fast "
+       "steps for quicker failover)"),
+    _k("MXNET_ELASTIC_GRACE_MS", 10000.0, float, "wired",
+       "SIGTERM->eviction grace window: the emergency checkpoint must "
+       "publish within this budget (PreemptionHandler)"),
+    _k("MXNET_ELASTIC_MAX_RESTARTS", 2, int, "wired",
+       "launch.py --supervise: consecutive crash-restarts per worker "
+       "before it is evicted and the mesh re-forms at world-1"),
+    _k("MXNET_ELASTIC_BACKOFF_MS", 500.0, float, "wired",
+       "launch.py --supervise: first restart backoff (doubles per "
+       "consecutive failure of the same worker)"),
+    _k("MXNET_ELASTIC_MIN_WORLD", 1, int, "wired",
+       "launch.py --supervise: smallest world size worth re-forming to; "
+       "below it the run fails instead of limping"),
+    _k("MXNET_ELASTIC_COLLECTIVE_DEADLINE_MS", 0.0, float, "wired",
+       "collective watchdog: abort a kvstore allreduce/barrier that is "
+       "still blocked after this many ms (hung-peer wedge -> "
+       "CollectiveTimeout; 0 = off)"),
     _k("MXNET_TRACE_ENABLE", 0, int, "wired",
        "record host-side spans from import (observability/tracer.py); "
        "profiler.set_state('run') enables tracing for its session "

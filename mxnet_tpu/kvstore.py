@@ -98,7 +98,13 @@ class KVStore:
                              else vv)
             out = acc
         if self._is_dist and jax.process_count() > 1:
-            out = _cross_process_allreduce(out)
+            # a peer lost mid-allreduce blocks here forever, not loudly:
+            # the elastic collective watchdog turns the wedge into a
+            # CollectiveTimeout abort (off unless
+            # MXNET_ELASTIC_COLLECTIVE_DEADLINE_MS is set)
+            from .resilience.elastic import guard_collective
+            out = guard_collective(_cross_process_allreduce, out,
+                                   op="kvstore.allreduce")
         return out
 
     def push(self, key, value, priority=0):
@@ -286,7 +292,11 @@ class KVStore:
     def barrier(self):
         if self._is_dist and jax.process_count() > 1:
             from jax.experimental import multihost_utils
-            multihost_utils.sync_global_devices("kvstore_barrier")
+            from .resilience.elastic import guard_collective
+            # same watchdog as the allreduce: a barrier whose peer died is
+            # the canonical silent wedge
+            guard_collective(multihost_utils.sync_global_devices,
+                             "kvstore_barrier", op="kvstore.barrier")
 
     def _barrier(self):
         self.barrier()

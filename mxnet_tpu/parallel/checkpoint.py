@@ -31,6 +31,11 @@ def _tree(trainer):
     keys = ["p%04d" % i for i in range(len(trainer._params))]
     tree = {
         "step": np.int64(trainer._t),
+        # saving topology: restore compares it against the CURRENT mesh
+        # and records a reshard when they differ (elastic resume at a
+        # smaller/larger world) — the values themselves are re-placed on
+        # the restoring trainer's shardings either way
+        "world": np.int64(len(trainer._mesh.devices.flat)),
         "names": [p.name for p in trainer._params],
         "values": dict(zip(keys, trainer._values)),
         "states": {k: list(s) for k, s in zip(keys, trainer._states)},
@@ -123,16 +128,43 @@ def _restore_checkpoint(trainer, path):
         # extra subtree from metadata so orbax accepts it, then discard
         tpl["extra"] = jax.tree_util.tree_map(
             lambda m: np.zeros(m.shape, m.dtype), saved["extra"])
-    restore_args = jax.tree_util.tree_map(
-        lambda v: ocp.ArrayRestoreArgs(sharding=v.sharding)
-        if isinstance(v, jax.Array) else ocp.RestoreArgs(), tpl)
-    restored = ckptr.restore(
-        path, args=ocp.args.PyTreeRestore(item=tpl,
-                                          restore_args=restore_args))
+    if "world" in tpl and saved is not None and "world" not in saved_keys:
+        tpl.pop("world")  # checkpoint from before topology was recorded
+
+    def _restore(tpl):
+        restore_args = jax.tree_util.tree_map(
+            lambda v: ocp.ArrayRestoreArgs(sharding=v.sharding)
+            if isinstance(v, jax.Array) else ocp.RestoreArgs(), tpl)
+        return ckptr.restore(
+            path, args=ocp.args.PyTreeRestore(item=tpl,
+                                              restore_args=restore_args))
+
+    try:
+        restored = _restore(tpl)
+    except (ValueError, KeyError):
+        # tree-structure mismatch with metadata() unavailable: the only
+        # template adaptation that couldn't happen up front is the
+        # optional "world" key (pre-topology checkpoint) — retry without
+        # it. Runtime/shape errors are NOT retried: they would only fail
+        # again and mask the primary error.
+        if saved is not None or "world" not in tpl:
+            raise
+        tpl.pop("world")
+        restored = _restore(tpl)
     keys = ["p%04d" % i for i in range(len(trainer._params))]
     trainer._t = int(restored["step"])
     trainer._values = [restored["values"][k] for k in keys]
     trainer._states = [tuple(restored["states"][k]) for k in keys]
     if "extra" in restored and hasattr(trainer, "_restore_extra"):
         trainer._restore_extra(restored["extra"])
+    if "world" in restored:
+        saved_world = int(restored["world"])
+        now_world = len(trainer._mesh.devices.flat)
+        if saved_world != now_world:
+            # the elastic reshard path fired: state written under one
+            # topology landed on another — make the transition visible
+            from ..resilience import elastic as _elastic
+            _elastic._count("resharded_restores")
+            _trace.instant("elastic.reshard", saved_world=saved_world,
+                           world=now_world, step=trainer._t)
     return trainer

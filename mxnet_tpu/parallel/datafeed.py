@@ -203,7 +203,7 @@ class DeviceFeed:
         self._holdover = deque()  # batches returned via _unget
         self._lock = threading.Lock()
         self._stats = {"batches": 0, "bytes_staged": 0, "stage_time_s": 0.0,
-                       "stage_waits": 0, "stage_wait_s": 0.0}
+                       "stage_waits": 0, "stage_wait_s": 0.0, "flushed": 0}
         # the registry must not keep an abandoned feed (and its staged
         # device buffers) alive — register a weakref handle, not the feed
         self._reg_handle = _FeedHandle(self)
@@ -381,6 +381,28 @@ class DeviceFeed:
             time.sleep(0.002)
         return self._ring.qsize()
 
+    def flush(self):
+        """Eviction path: stop the stager and DISCARD every staged-but-
+        unconsumed batch (ring + holdover) so an emergency checkpoint is
+        not held hostage by in-flight staging. Returns the number of
+        batches released (counted into the ``flushed`` stat). Unlike
+        :meth:`close` the feed is not dead — but the next iteration
+        restarts the SOURCE per its own restart contract (a list or
+        re-iterable source starts over from its top), which is right for
+        the intended use (the process exits and the restarted run's
+        replay re-reads from the beginning), NOT for continuing training
+        in the same process mid-epoch — use :meth:`reset` and re-slice
+        the source for an in-process drill."""
+        self._check_open()
+        # the load-bearing stop/join/drain/gen-bump ordering lives ONLY in
+        # _shutdown/_restart — flush just counts what they release
+        n = len(self._holdover)
+        n += self._shutdown()
+        self._restart()
+        with self._lock:
+            self._stats["flushed"] += n
+        return n
+
     def reset(self):
         """``DataIter`` parity: stop staging, reset a resettable source,
         and restart from its top. The one sanctioned way to revive a
@@ -394,11 +416,16 @@ class DeviceFeed:
         self._restart()
 
     def _drain(self):
+        """Empty the ring; returns how many REAL batches (not the
+        end-of-epoch sentinel or a relayed error) were discarded."""
+        n = 0
         while True:
             try:
-                self._ring.get_nowait()
+                item = self._ring.get_nowait()
             except queue.Empty:
-                return
+                return n
+            if item is not _END and not isinstance(item, _StageError):
+                n += 1
 
     def _shutdown(self):
         self._stop.set()
@@ -407,8 +434,9 @@ class DeviceFeed:
             if t is not threading.current_thread():  # no self-join
                 t.join(timeout=5.0)
             self._thread = None
-        self._drain()
+        n = self._drain()
         self._holdover.clear()
+        return n
 
     def close(self):
         """Stop the stager, release staged buffers, and drop the feed from
@@ -435,7 +463,7 @@ class DeviceFeed:
 
     def stats(self):
         """Host-side counters: ``{batches, bytes_staged, stage_time_s,
-        stage_waits, stage_wait_s, depth, depth_occupancy}``."""
+        stage_waits, stage_wait_s, flushed, depth, depth_occupancy}``."""
         with self._lock:
             out = dict(self._stats)
         out["depth"] = self.depth

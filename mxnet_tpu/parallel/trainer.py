@@ -288,7 +288,8 @@ class ShardedTrainer:
                   for i in range(n_inputs)),
             jnp.stack(ys_list))
 
-    def step_stream(self, feed, steps=None, chunk=None, lr=None):
+    def step_stream(self, feed, steps=None, chunk=None, lr=None,
+                    preemption=None):
         """Run training steps off a :class:`~.datafeed.DeviceFeed` (or any
         batch source, auto-wrapped) in chunked fused spans: chunk N runs as
         ONE compiled ``lax.scan`` program (the :meth:`step_many` function,
@@ -312,6 +313,14 @@ class ShardedTrainer:
             short tail compiles one extra span program for its length.
         lr : float, optional
             Learning-rate override, as in :meth:`step`.
+        preemption : PreemptionHandler, optional
+            Polled at every chunk boundary (the step-stream's consistency
+            points). A delivered eviction notice raises
+            :class:`~mxnet_tpu.resilience.elastic.Preempted` BEFORE the
+            next chunk consumes from the feed, with all completed chunks
+            committed to ``_t`` — the caller emergency-checkpoints and
+            ``feed.flush()`` releases the staged-ahead batches (replay
+            re-reads them from the source after restart).
 
         Returns the per-step losses as an NDArray of shape ``(n_run,)``.
         Fires the same pre-mutation ``trainer.step`` chaos point as
@@ -343,6 +352,9 @@ class ShardedTrainer:
             remaining = None if steps is None else int(steps)
             chunk_idx = 0
             while remaining is None or remaining > 0:
+                if preemption is not None and preemption.triggered():
+                    from ..resilience.elastic import Preempted
+                    raise Preempted(step=self._t)
                 # the chunk span covers feed consumption (where stage
                 # waits appear as nested datafeed.consumer_wait spans),
                 # span stacking, and the fused dispatch — one timeline box

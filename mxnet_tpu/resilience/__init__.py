@@ -22,7 +22,14 @@ subsystem):
   numerical-fault tolerance fused INTO the compiled training step
   (branchless NaN/overflow skip, dynamic loss scaling, global-norm
   clipping) plus host-side :class:`AnomalyDetector` and
-  :class:`StepWatchdog` monitors.
+  :class:`StepWatchdog` monitors;
+- :mod:`~mxnet_tpu.resilience.elastic` — process/host-level elasticity:
+  file-rendezvous membership with heartbeats (:class:`ElasticMember` /
+  :class:`ElasticCoordinator`), SIGTERM grace-window preemption with
+  emergency checkpoints (:class:`PreemptionHandler`, :func:`elastic_fit`
+  reshard-on-resume), and a :class:`CollectiveWatchdog` that aborts hung
+  collectives instead of wedging — driven by ``tools/launch.py
+  --supervise``.
 
 All event counters flow into ``profiler.get_aggregate_stats()`` via the
 stats-provider hook, and into the serving ``/metrics`` endpoint.
@@ -45,11 +52,21 @@ from . import resume
 from .guardrails import (GuardedStep, AnomalyDetector, StepWatchdog,
                          AnomalyFault)
 from . import guardrails
+# elastic imports chaos and (lazily) resume/parallel.checkpoint; it must
+# come after resume so elastic_fit's lazy imports resolve a fully-built
+# package
+from .elastic import (Preempted, PreemptionHandler, ElasticMember,
+                      ElasticCoordinator, CollectiveWatchdog,
+                      CollectiveTimeout, elastic_fit)
+from . import elastic
 
-__all__ = ["chaos", "retry", "breaker", "resume", "guardrails",
+__all__ = ["chaos", "retry", "breaker", "resume", "guardrails", "elastic",
            "Fault", "TransientFault", "FatalFault", "SlowFault",
            "RetryPolicy", "RetryExhausted", "retryable", "named_policy",
            "default_policy",
            "CircuitBreaker", "CircuitOpen",
            "resumable_fit", "ResumeGaveUp", "resume_stats",
-           "GuardedStep", "AnomalyDetector", "StepWatchdog", "AnomalyFault"]
+           "GuardedStep", "AnomalyDetector", "StepWatchdog", "AnomalyFault",
+           "Preempted", "PreemptionHandler", "ElasticMember",
+           "ElasticCoordinator", "CollectiveWatchdog", "CollectiveTimeout",
+           "elastic_fit"]

@@ -35,29 +35,68 @@ or via the environment (picked up at import and by :func:`arm_from_env`)::
     MXNET_CHAOS_SPEC="serving.execute:transient:first=2;trainer.step:fatal:at=5"
 
 Grammar: ``point:kind[:trigger]`` rules joined by ``;``. ``kind`` is
-``transient`` | ``fatal`` | ``slow(<delay_ms>)`` | ``nan``. ``trigger`` is
-one of ``first=K`` (default ``first=1``), ``every=N``, ``at=K``, or
-``p=R,seed=S`` (deterministic seeded Bernoulli). ``transient``/``fatal``
-raise :class:`TransientFault`/:class:`FatalFault`; ``slow`` injects latency
-(sleeps, then returns normally); ``nan`` raises nothing — the point
-*returns* ``"nan"`` (see :func:`poisoned`) and data-path callers corrupt
-their in-flight values with non-finite numbers, which is how numerical
-faults reach the compiled training step (a raise could never model a bad
-batch that the hardware happily computes on).
+``transient`` | ``fatal`` | ``slow(<delay_ms>)`` | ``nan`` | ``host_loss``
+| ``preempt``. ``trigger`` is one of ``first=K`` (default ``first=1``),
+``every=N``, ``at=K``, or ``p=R,seed=S`` (deterministic seeded Bernoulli).
+``transient``/``fatal`` raise :class:`TransientFault`/:class:`FatalFault`;
+``slow`` injects latency (sleeps, then returns normally); ``nan`` raises
+nothing — the point *returns* ``"nan"`` (see :func:`poisoned`) and
+data-path callers corrupt their in-flight values with non-finite numbers,
+which is how numerical faults reach the compiled training step (a raise
+could never model a bad batch that the hardware happily computes on).
+
+Two process-level kinds model the fleet faults ``resilience.elastic``
+exists for — neither raises, because the failure modes they model cannot
+be caught:
+
+- ``host_loss`` — the host vanishes NOW: ``os._exit(EXIT_HOST_LOSS)``,
+  no cleanup, no atexit, no emergency checkpoint (a preempted VM that got
+  no grace, a kernel panic, a yanked cable);
+- ``preempt`` — the cloud provider's eviction notice: SIGTERM to the own
+  process, which an installed
+  :class:`~mxnet_tpu.resilience.elastic.PreemptionHandler` turns into an
+  emergency checkpoint inside the grace window.
 
 Fire/call counters per point are exported to the profiler aggregate table
 (rows ``chaos.<point>.calls`` / ``chaos.<point>.fires``).
 """
 from __future__ import annotations
 
+import os as _os
 import random as _random
 import re
+import signal as _signal
+import sys as _sys
 import threading
 import time
 
 __all__ = ["Fault", "TransientFault", "FatalFault", "SlowFault",
            "point", "poisoned", "arm", "arm_from_env", "clear", "stats",
-           "active"]
+           "active", "EXIT_HOST_LOSS"]
+
+# what an abruptly lost host reports to its supervisor (128 + SIGKILL —
+# the rc a kernel-killed worker would produce); resilience.elastic
+# re-exports it for the supervise loop's eviction policy
+EXIT_HOST_LOSS = 137
+
+
+def _host_loss_action(msg):
+    """Kill the process the way a lost host dies: immediately, with no
+    cleanup and no chance to checkpoint. Module-level so tests can
+    monkeypatch the action instead of dying."""
+    _sys.stderr.write("chaos: %s\n" % msg)
+    _sys.stderr.flush()
+    _os._exit(EXIT_HOST_LOSS)
+
+
+def _preempt_action(msg):
+    """Deliver the eviction notice: SIGTERM to self. With a
+    resilience.elastic.PreemptionHandler installed this starts the
+    grace-window emergency-checkpoint path; without one the process dies
+    with the default SIGTERM disposition — exactly the real contract."""
+    _sys.stderr.write("chaos: %s\n" % msg)
+    _sys.stderr.flush()
+    _os.kill(_os.getpid(), _signal.SIGTERM)
 
 
 class Fault(Exception):
@@ -82,7 +121,7 @@ class SlowFault(Fault):
         self.delay_ms = float(delay_ms)
 
 
-_KINDS = ("transient", "fatal", "slow", "nan")
+_KINDS = ("transient", "fatal", "slow", "nan", "host_loss", "preempt")
 
 
 class _Rule:
@@ -146,6 +185,10 @@ class _Rule:
             raise FatalFault(msg)
         if self.kind == "slow":
             time.sleep(self.delay_ms / 1e3)  # slow: latency, not an error
+        if self.kind == "host_loss":
+            _host_loss_action(msg)
+        if self.kind == "preempt":
+            _preempt_action(msg)
         # "nan" raises nothing: point() reports it via its return value and
         # the caller poisons its own in-flight data
 
@@ -203,8 +246,8 @@ def arm(name, kind="transient", **kwargs):
 
 
 _SPEC_RE = re.compile(
-    r"^(?P<point>[\w.\-]+):(?P<kind>transient|fatal|nan|slow(\((?P<delay>"
-    r"[0-9.]+)\))?)(:(?P<trig>[\w=.,\-]+))?$")
+    r"^(?P<point>[\w.\-]+):(?P<kind>transient|fatal|nan|host_loss|preempt|"
+    r"slow(\((?P<delay>[0-9.]+)\))?)(:(?P<trig>[\w=.,\-]+))?$")
 
 
 def arm_from_env(spec=None):
@@ -223,8 +266,8 @@ def arm_from_env(spec=None):
             raise ValueError(
                 "bad MXNET_CHAOS_SPEC rule %r: want "
                 "'point:kind[:trigger]' with kind transient|fatal|nan|"
-                "slow(<delay_ms>) and trigger first=K|every=N|at=K|"
-                "p=R,seed=S" % part)
+                "host_loss|preempt|slow(<delay_ms>) and trigger "
+                "first=K|every=N|at=K|p=R,seed=S" % part)
         kind = m.group("kind")
         kwargs = {}
         if kind.startswith("slow"):

@@ -1,0 +1,770 @@
+"""Elastic, preemption-tolerant multi-host training.
+
+The process/host-level complement to the in-process resilience stack:
+``resilience.resume`` makes a *fault inside one process* survivable
+(restore-and-replay); this module makes the *process itself* expendable.
+The pieces compose into the torchelastic/Orbax-style contract "lose a
+host mid-run, keep the run" (PAPERS.md: elastic membership + preemption-
+tolerant checkpointing):
+
+- **Rendezvous + membership** — :class:`ElasticMember` (worker side) and
+  :class:`ElasticCoordinator` (supervisor side) share a file rendezvous
+  directory: each worker publishes an atomic heartbeat record carrying
+  its step counter; the supervisor declares a host dead after a
+  missed-beat deadline. Files, not sockets, so the protocol needs no new
+  dependencies, survives supervisor restarts, and is driveable from
+  tests with injectable clocks.
+- **Preemption** — :class:`PreemptionHandler` turns SIGTERM/SIGUSR1 into
+  a flag the training loop checks at step boundaries;
+  :func:`emergency_checkpoint` publishes the trainer state through the
+  atomic tmp+rename path of ``parallel/checkpoint.py`` inside the grace
+  window and raises :class:`Preempted` (deliberately NOT a
+  :class:`~mxnet_tpu.resilience.chaos.Fault`: a clean preemption must
+  never count toward ``ResumeGaveUp``'s restore budget).
+- **Elastic resume** — :func:`elastic_fit` restores an existing rolling
+  checkpoint onto the trainer's *current* mesh (the reshard-across-
+  topology path of ``restore_checkpoint``) and replays from the restored
+  step, so a run that started on N hosts continues correctly on N−1.
+  ``tools/launch.py --supervise`` drives the other half: restart with
+  exponential backoff, evict, re-form at the surviving world size.
+- **Collective watchdog** — :class:`CollectiveWatchdog` bounds
+  operations that wedge silently when a peer dies mid-collective (a hung
+  all-reduce blocks forever, it does not fail): deadline passes →
+  counters + tracer instant + :class:`CollectiveTimeout`, a controlled
+  abort the supervisor can see instead of a stuck run. Wired into the
+  kvstore collectives via ``MXNET_ELASTIC_COLLECTIVE_DEADLINE_MS``.
+
+All transitions are exported as ``resilience.elastic.*`` profiler rows
+and as tracer instants (``elastic.preempt``, ``elastic.emergency_
+checkpoint``, ``elastic.resume``, ``elastic.reshard``, ``elastic.
+collective_timeout``), and membership state feeds the serving
+``/healthz``/``/metrics`` endpoints.
+"""
+from __future__ import annotations
+
+import json
+import os
+import signal
+import threading
+import time
+import weakref
+
+from ..observability import tracer as _trace
+from .chaos import EXIT_HOST_LOSS
+
+__all__ = ["Preempted", "CollectiveTimeout", "PreemptionHandler",
+           "ElasticMember", "ElasticCoordinator", "CollectiveWatchdog",
+           "elastic_fit", "emergency_checkpoint", "guard_collective",
+           "install_preemption_handler", "current_handler",
+           "preemption_pending", "membership_gauge", "health",
+           "elastic_stats", "EXIT_PREEMPTED", "EXIT_HOST_LOSS"]
+
+# a preempted worker's exit code after a successful emergency checkpoint
+# (EX_TEMPFAIL: "try again later" — the supervise loop treats it as an
+# eviction notice, not a crash)
+EXIT_PREEMPTED = 75
+
+
+class Preempted(Exception):
+    """The host is being evicted and the emergency checkpoint is on disk.
+
+    Raised at a step boundary, with the trainer state consistent with the
+    published checkpoint. NOT a :class:`Fault`: ``resumable_fit`` must
+    let it escape instead of burning a restore on it."""
+
+    def __init__(self, step, ckpt=None, grace_left_ms=None, signum=None):
+        msg = "preempted at step %s" % step
+        if grace_left_ms is not None:
+            msg += " (%.0f ms of grace left)" % grace_left_ms
+        super().__init__(msg)
+        self.step = step
+        self.ckpt = ckpt
+        self.grace_left_ms = grace_left_ms
+        self.signum = signum
+
+
+class CollectiveTimeout(RuntimeError):
+    """A guarded collective ran past its deadline — the watchdog aborted
+    the wait instead of letting the run wedge. Deliberately NOT a
+    :class:`~mxnet_tpu.resilience.chaos.Fault`: retrying or
+    restore-and-replaying is wrong (the peer is gone — a replay would
+    block in the same dead collective), so neither ``RetryPolicy`` nor
+    ``resumable_fit``'s default ``catch`` may absorb it. It escapes to
+    the process boundary, where the supervisor re-forms the world."""
+
+
+# ---------------------------------------------------------------------------
+# counters / profiler rows
+# ---------------------------------------------------------------------------
+
+_lock = threading.Lock()
+_counters = {"preemptions": 0, "emergency_checkpoints": 0,
+             "grace_overruns": 0, "elastic_resumes": 0,
+             "resharded_restores": 0, "heartbeats": 0, "registrations": 0,
+             "leaves": 0, "dead_declared": 0, "collective_timeouts": 0,
+             "guarded_collectives": 0}
+
+
+def _count(key, n=1):
+    with _lock:
+        _counters[key] += n
+
+
+def elastic_stats():
+    with _lock:
+        return dict(_counters)
+
+
+# ---------------------------------------------------------------------------
+# preemption handling
+# ---------------------------------------------------------------------------
+
+_handler_lock = threading.Lock()
+_current_handler = None  # the most recently installed PreemptionHandler
+
+
+class PreemptionHandler:
+    """Grace-window preemption flag: SIGTERM/SIGUSR1 set it, the training
+    loop polls it at step boundaries.
+
+    Signal handlers can run at any bytecode boundary — including while
+    the interrupted code holds arbitrary locks — so the handler performs
+    PLAIN ATTRIBUTE WRITES ONLY (atomic under the GIL, no lock it could
+    deadlock on); bookkeeping (counter, tracer instant) is deferred to
+    the first :meth:`triggered` poll on a normal thread, and the
+    expensive reaction (emergency checkpoint) happens on the training
+    thread where the trainer state is consistent. ``clock`` is
+    injectable; tests call :meth:`trigger` directly instead of delivering
+    signals.
+
+    Use as a context manager or call :meth:`install`/:meth:`uninstall`
+    (install touches process-global signal dispositions and is only legal
+    on the main thread).
+    """
+
+    def __init__(self, grace_ms=None, signals=None, clock=time.monotonic):
+        if grace_ms is None:
+            from .. import config as _config
+            grace_ms = _config.get("MXNET_ELASTIC_GRACE_MS")
+        self.grace_ms = float(grace_ms)
+        self.signals = tuple(signals) if signals is not None \
+            else (signal.SIGTERM, signal.SIGUSR1)
+        self._clock = clock
+        self._flag = False       # written by the signal handler: plain bool
+        self._t0 = None          # set once, by the FIRST notice
+        self.signum = None
+        self._noticed = False    # deferred bookkeeping done
+        self._note_lock = threading.Lock()  # normal threads only
+        self._old = {}
+
+    def install(self):
+        global _current_handler
+        for s in self.signals:
+            self._old[s] = signal.signal(s, self._on_signal)
+        with _handler_lock:
+            _current_handler = self
+        return self
+
+    def uninstall(self):
+        global _current_handler
+        for s, old in self._old.items():
+            signal.signal(s, old)
+        self._old.clear()
+        with _handler_lock:
+            if _current_handler is self:
+                _current_handler = None
+
+    def _on_signal(self, signum, frame):
+        # async-signal path: plain attribute writes only — the code this
+        # interrupted may hold ANY lock (tracer, counters, this object's)
+        if self._t0 is None:
+            self._t0 = self._clock()
+            self.signum = signum
+        self._flag = True
+
+    def trigger(self, signum=signal.SIGTERM):
+        """Record the eviction notice from a normal thread (tests, chaos
+        drills). Idempotent: the grace clock starts at the FIRST notice;
+        repeated signals don't extend it."""
+        self._on_signal(signum, None)
+        self._note()
+
+    def _note(self):
+        """Deferred bookkeeping, on a normal (non-handler) thread."""
+        if self._noticed or self._t0 is None:
+            return
+        with self._note_lock:
+            if self._noticed:
+                return
+            self._noticed = True
+        _count("preemptions")
+        _trace.instant("elastic.preempt", signum=int(self.signum),
+                       grace_ms=self.grace_ms)
+
+    def triggered(self):
+        if self._flag:
+            self._note()
+            return True
+        return False
+
+    def deadline_left_ms(self):
+        """Grace remaining, or ``None`` before any notice arrived."""
+        t0 = self._t0
+        if t0 is None:
+            return None
+        return self.grace_ms - (self._clock() - t0) * 1e3
+
+    def reset(self):
+        """Forget a delivered notice (tests; or a drill that was not
+        followed by an actual eviction)."""
+        self._flag = False
+        self._t0 = None
+        self.signum = None
+        self._noticed = False
+
+    def __enter__(self):
+        return self.install()
+
+    def __exit__(self, *exc):
+        self.uninstall()
+
+
+def install_preemption_handler(grace_ms=None, signals=None):
+    """Install and return a process-global :class:`PreemptionHandler`."""
+    return PreemptionHandler(grace_ms=grace_ms, signals=signals).install()
+
+
+def current_handler():
+    with _handler_lock:
+        return _current_handler
+
+
+def preemption_pending():
+    """True when the installed process-global handler has a pending
+    eviction notice."""
+    h = current_handler()
+    return h is not None and h.triggered()
+
+
+# ---------------------------------------------------------------------------
+# file rendezvous: membership + heartbeats
+# ---------------------------------------------------------------------------
+
+def _write_json_atomic(path, payload):
+    # same publish discipline as the checkpoints: a reader never observes
+    # a half-written record, only the previous or the next one
+    tmp = "%s.tmp.%d" % (path, os.getpid())
+    with open(tmp, "w") as f:
+        json.dump(payload, f)
+    os.replace(tmp, path)
+
+
+def _member_path(rdzv_dir, rank):
+    return os.path.join(rdzv_dir, "member-%05d.json" % int(rank))
+
+
+_gauge_lock = threading.Lock()
+_gauge_member = None       # weakref to the live ElasticMember (worker)
+_gauge_coordinator = None  # weakref to the live ElasticCoordinator
+
+
+class ElasticMember:
+    """Worker-side membership: publish heartbeat records into the
+    rendezvous directory.
+
+    A record is ``{rank, pid, status, step, beat, time, world, gen}``;
+    ``status`` walks ``up`` → one of the terminal states ``done`` /
+    ``preempted`` / ``failed`` (written by :meth:`leave`). A host that
+    dies abruptly leaves a stale ``up`` record — exactly what the
+    coordinator's missed-beat deadline exists to catch.
+
+    Beats are manual (:meth:`heartbeat` per training step, which makes a
+    wedged step indistinguishable from a dead host — intended), with an
+    optional background beater (:meth:`start`) for phases with no step
+    cadence (long compiles, data stalls).
+    """
+
+    def __init__(self, rdzv_dir, rank, world_size=None, heartbeat_ms=None,
+                 clock=time.time, generation=None):
+        if heartbeat_ms is None:
+            from .. import config as _config
+            heartbeat_ms = _config.get("MXNET_ELASTIC_HEARTBEAT_MS")
+        if generation is None:
+            # the supervise launcher stamps each re-formed generation into
+            # MXTPU_GENERATION, and its coordinator filters records by it
+            # — a worker that defaulted to 0 would become invisible (and
+            # thus un-mournable) after the first re-form
+            generation = int(os.environ.get("MXTPU_GENERATION", "0"))
+        os.makedirs(rdzv_dir, exist_ok=True)
+        self.rdzv_dir = os.path.abspath(rdzv_dir)
+        self.rank = int(rank)
+        self.world_size = None if world_size is None else int(world_size)
+        self.heartbeat_ms = float(heartbeat_ms)
+        self.generation = int(generation)
+        self._clock = clock
+        self._beats = 0
+        self._step = 0
+        self._start = 0  # the step register() resumed from (durable
+        #                  progress marker: it only advances when a restart
+        #                  restored a NEWER checkpoint)
+        self._status = "up"
+        self._thread = None
+        self._stop = threading.Event()
+        # the background beater and the per-step heartbeat share one tmp
+        # path: serialize publishes so os.replace never races on it and a
+        # reader really never sees a torn record
+        self._write_lock = threading.Lock()
+        global _gauge_member
+        with _gauge_lock:
+            _gauge_member = weakref.ref(self)
+
+    def _write(self, status, step):
+        with self._write_lock:
+            self._beats += 1
+            self._status = status
+            self._step = int(step)
+            _write_json_atomic(_member_path(self.rdzv_dir, self.rank), {
+                "rank": self.rank, "pid": os.getpid(), "status": status,
+                "step": int(step), "start": self._start,
+                "beat": self._beats, "time": float(self._clock()),
+                "world": self.world_size, "gen": self.generation})
+
+    def register(self, step=0):
+        """First record: announces the member (and doubles as beat #1, so
+        the missed-beat clock starts at registration, not first step).
+        ``step`` — the checkpoint step this incarnation resumed from — is
+        also persisted as ``start`` in every subsequent record: the
+        supervisor keys its consecutive-crash accounting off it (durable
+        progress, not heartbeat progress)."""
+        self._start = int(step)
+        self._write("up", step)
+        _count("registrations")
+        _trace.instant("elastic.register", rank=self.rank, step=int(step))
+        return self
+
+    def heartbeat(self, step=None, status="up"):
+        self._write(status, self._step if step is None else step)
+        _count("heartbeats")
+
+    def start(self):
+        """Background beater at ``heartbeat_ms`` cadence, re-publishing
+        the last known step — for phases where no step-boundary beat can
+        happen (restore, a long compile). While it runs, a wedged
+        training thread is INVISIBLE to the missed-beat check — stop it
+        as soon as a natural beat cadence exists (``elastic_fit`` stops
+        it at the first step beat)."""
+        if self._thread is None:
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._run, daemon=True,
+                name="elastic-member-%d" % self.rank)
+            self._thread.start()
+        return self
+
+    def _run(self):
+        while not self._stop.wait(self.heartbeat_ms / 1e3):
+            try:
+                self.heartbeat()
+            except OSError:
+                # a transient publish failure (disk pressure, dir swept)
+                # must not silently kill the beater — missing beats would
+                # get a HEALTHY worker declared dead and SIGKILLed
+                continue
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(2.0)
+            self._thread = None
+
+    def leave(self, status="done", step=None):
+        """Terminal record: a clean departure the coordinator must not
+        declare dead (``done`` / ``preempted`` / ``failed``)."""
+        self.stop()
+        self._write(status, self._step if step is None else step)
+        _count("leaves")
+        _trace.instant("elastic.leave", rank=self.rank, status=status,
+                       step=self._step)
+
+    def __enter__(self):
+        return self.register()
+
+    def __exit__(self, *exc):
+        if self._status == "up":
+            self.leave("failed" if exc and exc[0] is not None else "done")
+        else:
+            self.stop()
+
+
+class ElasticCoordinator:
+    """Supervisor-side membership view over the same rendezvous directory.
+
+    Liveness is decided per record: ``status == "up"`` and the record's
+    ``time`` is within ``deadline_ms`` of this coordinator's clock (wall
+    clocks by default — member and coordinator are different processes,
+    so a monotonic clock would not compare; tests inject a shared fake).
+    Terminal statuses are never "dead": a clean ``preempted`` departure
+    is an eviction, not a loss.
+
+    ``generation`` (when given) scopes every view to records stamped with
+    that generation: a zombie worker from a torn-down generation that
+    keeps beating into a SHARED rendezvous dir (real ssh, where the
+    remote side can outlive its local client) must neither inflate
+    ``world()`` nor keep a wedged current-generation rank looking fresh.
+    """
+
+    def __init__(self, rdzv_dir, world_size=None, deadline_ms=None,
+                 clock=time.time, generation=None):
+        if deadline_ms is None:
+            from .. import config as _config
+            deadline_ms = _config.get("MXNET_ELASTIC_DEADLINE_MS")
+        os.makedirs(rdzv_dir, exist_ok=True)
+        self.rdzv_dir = os.path.abspath(rdzv_dir)
+        self.world_size = None if world_size is None else int(world_size)
+        self.deadline_ms = float(deadline_ms)
+        self.generation = None if generation is None else int(generation)
+        self._clock = clock
+        self._declared_dead = set()
+        global _gauge_coordinator
+        with _gauge_lock:
+            _gauge_coordinator = weakref.ref(self)
+
+    def members(self):
+        """Raw member records, ``{rank: payload}``."""
+        out = {}
+        try:
+            names = os.listdir(self.rdzv_dir)
+        except OSError:
+            return out
+        for n in sorted(names):
+            if not (n.startswith("member-") and n.endswith(".json")):
+                continue
+            try:
+                with open(os.path.join(self.rdzv_dir, n)) as f:
+                    rec = json.load(f)
+                if self.generation is not None \
+                        and rec.get("gen") != self.generation:
+                    continue  # zombie from a torn-down generation
+                out[int(rec["rank"])] = rec
+            except (OSError, ValueError, KeyError, TypeError):
+                continue  # mid-replace race or torn file: next poll sees it
+        return out
+
+    def snapshot(self):
+        """Liveness-annotated membership: ``{rank: {..., age_ms, alive}}``."""
+        now = self._clock()
+        snap = {}
+        for rank, rec in self.members().items():
+            age_ms = (now - float(rec.get("time", 0.0))) * 1e3
+            alive = rec.get("status") == "up" and age_ms <= self.deadline_ms
+            snap[rank] = dict(rec, age_ms=age_ms, alive=alive)
+        return snap
+
+    def dead(self, snapshot=None):
+        """Ranks whose last record says ``up`` but whose beat is past the
+        deadline — the silent-loss signal. Each rank is counted into the
+        ``dead_declared`` stat once per incident (a revived rank that
+        beats again re-arms the declaration). Pass a precomputed
+        ``snapshot`` to share one rendezvous scan across views."""
+        snap = self.snapshot() if snapshot is None else snapshot
+        out = _lost_ranks(snap)
+        for rank in out:
+            if rank not in self._declared_dead:
+                self._declared_dead.add(rank)
+                _count("dead_declared")
+                _trace.instant("elastic.dead", rank=rank,
+                               age_ms=snap[rank]["age_ms"])
+        for rank in list(self._declared_dead):
+            if rank not in out and snap.get(rank, {}).get("alive"):
+                self._declared_dead.discard(rank)
+        return out
+
+    def world(self, snapshot=None):
+        """Count of live members."""
+        snap = self.snapshot() if snapshot is None else snapshot
+        return sum(1 for r in snap.values() if r["alive"])
+
+    def clear(self):
+        """Remove all member records (a supervisor starting a new
+        generation must not mistake the previous generation's stale
+        records for dead hosts)."""
+        self._declared_dead.clear()
+        for n in os.listdir(self.rdzv_dir):
+            if n.startswith("member-"):
+                try:
+                    os.remove(os.path.join(self.rdzv_dir, n))
+                except OSError:
+                    pass
+
+
+def _lost_ranks(snapshot):
+    """Ranks silently lost: record still says ``up`` but the beat is past
+    the deadline. THE liveness predicate — the supervisor's kill decision
+    (:meth:`ElasticCoordinator.dead`), the ``/metrics`` gauge, and the
+    ``/healthz`` degradation all share it so they can never diverge."""
+    return sorted(r for r, v in snapshot.items()
+                  if v.get("status") == "up" and not v["alive"])
+
+
+_snap_cache = {}  # id(coordinator) -> (monotonic_t, snapshot)
+
+
+def _gauge_snapshot(coord, ttl_s=0.5):
+    """Snapshot for the serving surfaces, TTL-cached: /healthz probes and
+    /metrics scrapes arrive far faster than heartbeats (~1 Hz), and each
+    uncached snapshot is a listdir + N file parses. The TTL runs on the
+    coordinator's own (injectable) clock so cached staleness and beat
+    staleness share one timebase."""
+    now = coord._clock()
+    hit = _snap_cache.get(id(coord))
+    if hit is not None and 0 <= now - hit[0] < ttl_s:
+        return hit[1]
+    snap = coord.snapshot()
+    _snap_cache.clear()  # one live coordinator per process; no leak
+    _snap_cache[id(coord)] = (now, snap)
+    return snap
+
+
+def membership_gauge():
+    """The ``/metrics`` view: membership snapshot (coordinator side),
+    last published beat (member side), pending preemption, counters."""
+    out = {"counters": elastic_stats(),
+           "preemption_pending": preemption_pending()}
+    with _gauge_lock:
+        m = _gauge_member() if _gauge_member is not None else None
+        c = _gauge_coordinator() if _gauge_coordinator is not None else None
+    if m is not None:
+        out["member"] = {"rank": m.rank, "status": m._status,
+                         "step": m._step, "beats": m._beats,
+                         "gen": m.generation}
+    if c is not None:
+        snap = _gauge_snapshot(c)
+        out["membership"] = {
+            "expected": c.world_size, "records": len(snap),
+            "alive": sum(1 for r in snap.values() if r["alive"]),
+            "dead": _lost_ranks(snap)}
+    return out
+
+
+def health():
+    """Elastic contribution to ``/healthz``: degraded while this process
+    holds an unserved eviction notice, or while the in-process
+    coordinator sees silently-lost members."""
+    if preemption_pending():
+        return {"status": "degraded", "reason": "preemption_pending"}
+    with _gauge_lock:
+        c = _gauge_coordinator() if _gauge_coordinator is not None else None
+    if c is not None:
+        lost = _lost_ranks(_gauge_snapshot(c))
+        if lost:
+            return {"status": "degraded", "reason": "members_lost",
+                    "dead": lost}
+    return {"status": "ok"}
+
+
+# ---------------------------------------------------------------------------
+# emergency checkpoint + elastic fit
+# ---------------------------------------------------------------------------
+
+def emergency_checkpoint(trainer, ckpt_path, preemption=None):
+    """Publish the trainer's state NOW (atomic tmp+rename, same rolling
+    slot ``resumable_fit`` maintains) and raise :class:`Preempted`.
+
+    Called at a step boundary inside the grace window; the save itself is
+    the priority — telemetry records whether it beat the window
+    (``grace_overruns`` counts saves that finished late: the checkpoint
+    is still good, but the host may have been killed mid-publish, which
+    the atomic rename makes safe)."""
+    from ..parallel.checkpoint import save_checkpoint
+
+    with _trace.span("elastic.emergency_checkpoint", path=ckpt_path,
+                     step=trainer._t):
+        save_checkpoint(trainer, ckpt_path)
+    _count("emergency_checkpoints")
+    left = preemption.deadline_left_ms() if preemption is not None else None
+    if left is not None and left <= 0:
+        _count("grace_overruns")
+    _trace.instant("elastic.emergency_checkpoint", step=trainer._t,
+                   grace_left_ms=left)
+    raise Preempted(step=trainer._t, ckpt=ckpt_path, grace_left_ms=left,
+                    signum=getattr(preemption, "signum", None))
+
+
+def elastic_fit(trainer, batches, ckpt_dir, member=None, preemption=None,
+                ckpt_every=None, max_restores=8, seed=None, catch=None,
+                on_restore=None):
+    """Worker-side elastic training entry over ``resumable_fit``.
+
+    ``batches`` is the FULL run starting at absolute step 0, identical
+    across restarts (regenerate it deterministically). If the rolling
+    checkpoint exists the trainer is restored onto its CURRENT mesh —
+    the reshard path, so a checkpoint written at world size N resumes at
+    N−1 — and only the remaining batches run. Per-step membership
+    heartbeats ride ``resumable_fit``'s ``on_step`` hook; a delivered
+    preemption notice becomes an emergency checkpoint + clean
+    ``preempted`` leave + :class:`Preempted` (exit with
+    :data:`EXIT_PREEMPTED` so a supervisor treats it as an eviction).
+
+    Returns ``(start_step, losses)``: the absolute step resumed from and
+    the per-batch losses this call computed (``batches[start_step:]``).
+    """
+    from ..parallel.checkpoint import restore_checkpoint
+    from .resume import resumable_fit
+
+    os.makedirs(ckpt_dir, exist_ok=True)
+    ckpt = os.path.join(os.path.abspath(ckpt_dir), "resume_ckpt")
+    if member is not None:
+        # register BEFORE the (potentially long) orbax restore so the
+        # whole startup is beat-covered; failing loudly here is right — a
+        # broken rendezvous at startup is a deployment error, not a blip
+        member.register(step=int(trainer._t))
+        # the background beater covers ONLY the beat-less startup phase
+        # (restore + the first-step jit compile, both of which easily
+        # exceed the missed-beat deadline). The FIRST step beat stops it:
+        # from then on liveness rides the step cadence, so a training
+        # thread that wedges goes silent and the supervisor's missed-beat
+        # eviction can actually fire. (Consequence:
+        # MXNET_ELASTIC_DEADLINE_MS must exceed the worst MID-RUN compile
+        # gap.)
+        member.start()
+    if os.path.exists(ckpt) or os.path.exists(ckpt + ".old"):
+        restore_checkpoint(trainer, ckpt)
+        _count("elastic_resumes")
+        _trace.instant("elastic.resume", step=trainer._t)
+    start = int(trainer._t)
+    if start > len(batches):
+        raise ValueError(
+            "checkpoint step %d is beyond the %d-batch run — the restarted "
+            "worker must regenerate the SAME batch schedule" %
+            (start, len(batches)))
+    if member is not None:
+        # re-announce with the RESTORED step: `start` is the durable-
+        # progress marker the supervisor's crash accounting keys off
+        member.register(step=start)
+    on_step = None
+    if member is not None:
+        def on_step(step, loss):
+            member.stop()  # idempotent; hand liveness to the step cadence
+            try:
+                member.heartbeat(step)
+            except OSError:
+                # steady-state beats are telemetry: a transient publish
+                # failure must not kill a healthy training step (a
+                # PERSISTENT outage surfaces as missed beats anyway)
+                pass
+
+    def _leave(status):
+        if member is not None:
+            try:
+                member.leave(status, step=trainer._t)
+            except OSError:
+                pass  # never mask the exit path with a telemetry write
+
+    kwargs = {}
+    if catch is not None:
+        kwargs["catch"] = catch
+    try:
+        losses = resumable_fit(trainer, batches[start:], ckpt_dir,
+                               ckpt_every=ckpt_every,
+                               max_restores=max_restores, seed=seed,
+                               on_restore=on_restore, on_step=on_step,
+                               preemption=preemption, **kwargs)
+    except Preempted:
+        _leave("preempted")
+        raise
+    except BaseException:
+        _leave("failed")
+        raise
+    _leave("done")
+    return start, losses
+
+
+# ---------------------------------------------------------------------------
+# collective watchdog
+# ---------------------------------------------------------------------------
+
+class CollectiveWatchdog:
+    """Deadline guard for operations that wedge instead of failing.
+
+    When a peer dies mid-collective the survivors block forever — no
+    exception, no timeout, a silent wedge. :meth:`run` executes the
+    operation on a helper thread and bounds the caller's wait: past the
+    deadline it counts the stall, emits an ``elastic.collective_timeout``
+    instant, calls ``on_abort`` and raises :class:`CollectiveTimeout` — a
+    controlled abort the supervisor observes (missed heartbeats / nonzero
+    exit) instead of a stuck run. The abandoned helper thread stays
+    parked in the hung collective (daemon): by contract the process is
+    about to exit and re-form.
+
+    The per-call thread costs ~100µs — negligible against a cross-host
+    collective, and the guard is entirely off unless armed.
+    """
+
+    def __init__(self, deadline_ms=None, name="collective", on_abort=None):
+        if deadline_ms is None:
+            from .. import config as _config
+            deadline_ms = _config.get("MXNET_ELASTIC_COLLECTIVE_DEADLINE_MS")
+        self.deadline_ms = float(deadline_ms)
+        self.name = name
+        self._on_abort = on_abort
+        self.guarded = 0
+        self.timeouts = 0
+
+    def run(self, fn, *args, op=None, **kwargs):
+        """Run ``fn(*args, **kwargs)`` under the deadline; transparent
+        (same return value / exception) when it finishes in time, or when
+        the watchdog is disabled (``deadline_ms <= 0``)."""
+        if self.deadline_ms <= 0:
+            return fn(*args, **kwargs)
+        op = op or self.name
+        self.guarded += 1
+        _count("guarded_collectives")
+        box = {}
+        done = threading.Event()
+
+        def _worker():
+            try:
+                box["result"] = fn(*args, **kwargs)
+            except BaseException as e:  # noqa: BLE001 — relayed below
+                box["error"] = e
+            finally:
+                done.set()
+
+        t = threading.Thread(target=_worker, daemon=True,
+                             name="collective-watchdog-%s" % op)
+        t.start()
+        if not done.wait(self.deadline_ms / 1e3):
+            self.timeouts += 1
+            _count("collective_timeouts")
+            _trace.instant("elastic.collective_timeout", op=op,
+                           deadline_ms=self.deadline_ms)
+            if self._on_abort is not None:
+                self._on_abort(op, self.deadline_ms)
+            raise CollectiveTimeout(
+                "collective %r still not done after %.0f ms — peer lost? "
+                "aborting instead of wedging" % (op, self.deadline_ms))
+        if "error" in box:
+            raise box["error"]
+        return box.get("result")
+
+
+def guard_collective(fn, *args, op="collective", **kwargs):
+    """Module-level convenience: run ``fn`` under the env-configured
+    deadline (``MXNET_ELASTIC_COLLECTIVE_DEADLINE_MS``; 0 = disabled,
+    zero overhead — the call is made directly on the caller's thread)."""
+    from .. import config as _config
+    deadline = _config.get("MXNET_ELASTIC_COLLECTIVE_DEADLINE_MS")
+    if not deadline or deadline <= 0:
+        return fn(*args, **kwargs)
+    return CollectiveWatchdog(deadline_ms=deadline, name=op).run(
+        fn, *args, op=op, **kwargs)
+
+
+def _profiler_rows():
+    st = elastic_stats()
+    return {("resilience.elastic.%s" % k): (v, 0.0) for k, v in st.items()}
+
+
+from ._stats import export_rows as _export_rows  # noqa: E402
+
+_export_rows(_profiler_rows)

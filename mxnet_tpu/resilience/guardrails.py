@@ -392,6 +392,10 @@ class GuardedStep:
         return self._trainer.mesh
 
     @property
+    def _mesh(self):
+        return self._trainer._mesh
+
+    @property
     def _params(self):
         return self._trainer._params
 

@@ -55,7 +55,7 @@ def resume_stats():
 
 def resumable_fit(trainer, batches, ckpt_dir, ckpt_every=None,
                   max_restores=8, seed=None, catch=(Fault,),
-                  on_restore=None):
+                  on_restore=None, on_step=None, preemption=None):
     """Run ``trainer.step`` over ``batches`` with checkpoint/restore/replay.
 
     Parameters
@@ -83,6 +83,16 @@ def resumable_fit(trainer, batches, ckpt_dir, ckpt_every=None,
         deployment would list device/runtime errors here too).
     on_restore : callable, optional
         ``on_restore(step, exc)`` hook after each successful restore.
+    on_step : callable, optional
+        ``on_step(absolute_step, loss)`` after every completed step —
+        the elastic membership heartbeat hook.
+    preemption : PreemptionHandler, optional
+        Polled at every step boundary. A delivered eviction notice
+        triggers an *emergency checkpoint* (same rolling slot,
+        catch-class faults re-attempted while grace remains) and raises
+        :class:`~mxnet_tpu.resilience.elastic.Preempted` — which is NOT
+        in ``catch``, so a clean preemption never counts toward
+        :class:`ResumeGaveUp`, no matter how many faults preceded it.
 
     Returns
     -------
@@ -91,6 +101,7 @@ def resumable_fit(trainer, batches, ckpt_dir, ckpt_every=None,
         their earlier, lost values).
     """
     from ..parallel.checkpoint import save_checkpoint, restore_checkpoint
+    from .elastic import CollectiveTimeout, Preempted
     from .. import random as _rnd
 
     if ckpt_every is None:
@@ -120,6 +131,24 @@ def resumable_fit(trainer, batches, ckpt_dir, ckpt_every=None,
     replaying_until = 0  # batch indices below this were stepped before
 
     while trainer._t - t0 < total:
+        if preemption is not None and preemption.triggered():
+            # an eviction notice: publish the emergency checkpoint inside
+            # the grace window and leave via Preempted (NOT in `catch`,
+            # so it escapes — a clean preemption never burns a restore).
+            # The save gets the same fault tolerance as the initial
+            # checkpoint: catch-class faults are re-attempted while grace
+            # remains; success raises Preempted out of the loop.
+            from .elastic import emergency_checkpoint
+            for attempt in range(max_restores + 1):
+                try:
+                    emergency_checkpoint(trainer, ckpt, preemption)
+                except Preempted:
+                    raise  # the SUCCESS signal — even if `catch` is wide
+                except catch:
+                    left = preemption.deadline_left_ms()
+                    if attempt >= max_restores or (left is not None
+                                                   and left <= 0):
+                        raise
         i = trainer._t - t0
         try:
             if seed is not None:
@@ -132,11 +161,18 @@ def resumable_fit(trainer, batches, ckpt_dir, ckpt_every=None,
                 else float(loss)
             if i < replaying_until:
                 _count("replayed_steps")
+            if on_step is not None:
+                on_step(trainer._t, losses[i])
             done = trainer._t - t0
             if done % ckpt_every == 0 or done == total:
                 save_checkpoint(trainer, ckpt)
                 _count("checkpoints")
                 restores = 0  # progress was durably made; reset the budget
+        except (Preempted, CollectiveTimeout):
+            # never absorbed, however wide the caller made `catch`: a
+            # clean preemption must escape to the supervisor, and a dead
+            # collective would wedge the very replay a restore starts
+            raise
         except catch as exc:
             restores += 1
             if restores > max_restores:

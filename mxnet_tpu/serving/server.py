@@ -46,6 +46,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 import numpy as _np
 
 from ..observability import tracer as _trace
+from ..resilience import elastic as _elastic
 from ..resilience import guardrails as _guardrails
 from ..resilience import retry as _retry
 from ..resilience.breaker import CircuitBreaker
@@ -228,6 +229,9 @@ class ModelServer:
             self.metrics.set_gauge_fn("breaker", self.breaker.snapshot)
         self.metrics.set_gauge_fn("retry", _retry.all_stats)
         self.metrics.set_gauge_fn("guardrails", _guardrails.all_stats)
+        # elastic membership: the LB-visible view of "how many hosts does
+        # this job still have" plus pending-preemption state
+        self.metrics.set_gauge_fn("elastic", _elastic.membership_gauge)
         from ..parallel import datafeed as _datafeed
         self.metrics.set_gauge_fn("datafeed", _datafeed.feed_stats)
         # trace-derived per-phase latency histograms on /metrics: the
@@ -265,6 +269,11 @@ class ModelServer:
         g = _guardrails.health()
         if g["status"] != "ok":
             return {"status": "degraded", "guardrails": g}
+        e = _elastic.health()
+        if e["status"] != "ok":
+            # a pending eviction notice or lost peers: drain THIS instance
+            # too — traffic routed to a host mid-eviction is wasted work
+            return {"status": "degraded", "elastic": e}
         return {"status": "ok"}
 
     @property

@@ -868,3 +868,155 @@ def test_counters_reach_profiler_aggregate(tmp_path):
     table = profiler.dumps()
     assert "retry.agg_probe_retry.retries" in table
     assert "breaker.agg_probe_breaker.opened" in table
+
+
+# ---------------------------------------------------------------------------
+# elastic: membership with injectable clocks, preemption accounting,
+# reshard-on-resume (ISSUE-6 satellites — the supervisor/e2e surface lives
+# in tests/test_elastic.py)
+# ---------------------------------------------------------------------------
+
+def test_elastic_membership_fake_clock(tmp_path):
+    """Fake multi-process coordinator: two members heartbeat through the
+    file rendezvous on a shared fake clock; a missed-beat deadline
+    declares exactly the silent host dead, a late beat revives it, and a
+    clean terminal leave is never 'dead'."""
+    from mxnet_tpu.resilience.elastic import (ElasticCoordinator,
+                                              ElasticMember)
+
+    clk = [1000.0]
+    fake = lambda: clk[0]  # noqa: E731 — injectable clock, fake-clock style
+    d = str(tmp_path / "rdzv")
+    m0 = ElasticMember(d, 0, world_size=2, clock=fake)
+    m1 = ElasticMember(d, 1, world_size=2, clock=fake)
+    coord = ElasticCoordinator(d, world_size=2, deadline_ms=5000,
+                               clock=fake)
+    m0.register()
+    m1.register()
+    snap = coord.snapshot()
+    assert snap[0]["alive"] and snap[1]["alive"]
+    assert coord.world() == 2 and coord.dead() == []
+
+    # member 1 goes silent; member 0 keeps beating with its step counter
+    clk[0] += 4.0
+    m0.heartbeat(step=7)
+    clk[0] += 2.0  # m1's last beat is now 6s old, m0's 2s
+    assert coord.dead() == [1]
+    assert coord.world() == 1
+    assert coord.snapshot()[0]["step"] == 7
+
+    # a late beat revives it (the supervisor had not killed it yet)
+    m1.heartbeat(step=3)
+    assert coord.dead() == []
+    assert coord.world() == 2
+
+    # terminal leave: silent forever afterwards, but never 'dead'
+    m1.leave("preempted", step=4)
+    clk[0] += 60.0
+    m0.heartbeat(step=9)
+    assert coord.dead() == []
+    assert coord.snapshot()[1]["status"] == "preempted"
+    assert coord.world() == 1
+
+
+def test_elastic_preemption_never_counts_toward_giveup(tmp_path):
+    """A clean preemption must not count toward ResumeGaveUp: with the
+    restore budget fully consumed by real faults, an eviction notice
+    still produces an emergency checkpoint + Preempted — never
+    ResumeGaveUp — and a fault during the emergency save itself is
+    re-attempted inside the grace window."""
+    import os
+
+    from mxnet_tpu.resilience import Preempted, PreemptionHandler
+
+    batches = _batches(6, seed=21)
+    ph = PreemptionHandler(grace_ms=60000)  # no signals: triggered by hand
+    # consume the WHOLE budget: with max_restores=1, the 2nd fault would
+    # raise ResumeGaveUp if the step faulted again before a checkpoint
+    chaos.arm("trainer.step", "fatal", at=2)
+    # ...and fault the emergency save's FIRST attempt too (save #1 is the
+    # initial checkpoint, #2 the emergency): it must be re-attempted
+    chaos.arm("checkpoint.save", "transient", at=2)
+    steps_seen = []
+
+    def on_step(step, loss):
+        steps_seen.append(step)
+        if step == 3:
+            ph.trigger()
+
+    t = _make_trainer(seed=4)
+    with pytest.raises(Preempted) as ei:
+        resumable_fit(t, batches, str(tmp_path / "p"), ckpt_every=100,
+                      max_restores=1, seed=7, on_step=on_step,
+                      preemption=ph)
+    assert ei.value.step == t._t == 3
+    ckpt = str(tmp_path / "p" / "resume_ckpt")
+    assert os.path.exists(ckpt)
+
+    # the restarted process resumes from the emergency checkpoint and the
+    # final state is bitwise-equal to an uninterrupted run
+    t2 = _make_trainer(seed=4)
+    parallel.restore_checkpoint(t2, ckpt)
+    assert t2._t == 3
+    resumed = resumable_fit(t2, batches[3:], str(tmp_path / "p"),
+                            ckpt_every=100, seed=7)
+    tc = _make_trainer(seed=4)
+    clean = resumable_fit(tc, batches, str(tmp_path / "q"),
+                          ckpt_every=100, seed=7)
+    assert resumed == clean[3:]
+    for va, vb in zip(t2._values, tc._values):
+        np.testing.assert_array_equal(np.asarray(va), np.asarray(vb))
+
+
+def test_reshard_on_resume_bitwise(tmp_path):
+    """A checkpoint written under an n-device mesh restores under a
+    smaller mesh bitwise (params AND optimizer state), and the replay at
+    the surviving size is bitwise-deterministic — the elastic re-form
+    contract."""
+    import jax
+
+    from mxnet_tpu.parallel.mesh import replicated
+
+    def trainer_on(dp):
+        mx.random.seed(0)
+        np.random.seed(0)
+        net = gluon.nn.Sequential()
+        net.add(gluon.nn.Dense(16, activation="relu"), gluon.nn.Dense(4))
+        net.initialize(mx.init.Xavier())
+        net(mx.nd.zeros((2, 8)))
+        mesh = parallel.make_mesh(dp=dp, devices=jax.devices()[:dp])
+        return parallel.ShardedTrainer(
+            net, gluon.loss.SoftmaxCrossEntropyLoss(), "adam",
+            {"learning_rate": 1e-2}, mesh=mesh)
+
+    def gathered(t):
+        return [np.asarray(jax.device_put(v, replicated(t._mesh)))
+                for v in t._values]
+
+    batches = _batches(8, seed=22)
+    t4 = trainer_on(4)
+    resumable_fit(t4, batches[:4], str(tmp_path / "w4"), ckpt_every=100,
+                  seed=5)
+    ckpt = str(tmp_path / "w4" / "resume_ckpt")
+    saved = gathered(t4)
+
+    from mxnet_tpu.resilience import elastic as elastic_mod
+    before = elastic_mod.elastic_stats()["resharded_restores"]
+    replays = []
+    for run in range(2):
+        t2 = trainer_on(2)
+        parallel.restore_checkpoint(t2, ckpt)
+        assert t2._t == 4
+        assert len(t2._mesh.devices.flat) == 2
+        # restore across topology is bitwise: every param identical
+        for a, b in zip(saved, gathered(t2)):
+            np.testing.assert_array_equal(a, b)
+        losses = [float(np.asarray(t2.step(x, y).asnumpy()))
+                  for x, y in batches[4:]]
+        replays.append((losses, gathered(t2)))
+    # the reshard was seen and counted (both restores crossed 4 -> 2)
+    assert elastic_mod.elastic_stats()["resharded_restores"] >= before + 2
+    # replay at the surviving size is bitwise-deterministic
+    assert replays[0][0] == replays[1][0]
+    for a, b in zip(replays[0][1], replays[1][1]):
+        np.testing.assert_array_equal(a, b)

@@ -6,55 +6,413 @@ runs the SAME program and `jax.distributed.initialize()` discovers peers
 from the TPU metadata; this launcher exists for CLI parity and for CPU
 multi-process simulation (--launcher local spawns N processes with
 coordinator env, the analogue of the reference's local tracker used by
-`tests/nightly/dist_sync_kvstore.py`)."""
+`tests/nightly/dist_sync_kvstore.py`).
+
+Two modes:
+
+- plain (default): spawn N workers, wait. Hardened: every worker runs in
+  its own process group, per-worker exit codes are collected and
+  reported, and the FIRST hard failure kills the remaining groups — no
+  orphaned workers grinding on after the job is already dead. (Over REAL
+  ssh the kill takes down the local clients; without a tty, sshd reaps
+  the remote command only when it next touches the closed channel — the
+  MXTPU_SSH shim used in CI, and any launcher wrapping the remote side
+  in its own supervisor, are immediate.)
+- ``--supervise``: the elastic supervisor (`mxnet_tpu.resilience.elastic`
+  is the worker-side half). Workers register + heartbeat through a file
+  rendezvous dir; the supervisor restarts crashed workers with
+  exponential backoff, treats exit code 75 (EXIT_PREEMPTED — the worker
+  emergency-checkpointed inside its SIGTERM grace window) and exhausted
+  restart budgets as evictions, and re-forms the world at the surviving
+  size; workers resume from the rolling checkpoint via
+  ``elastic_fit``'s reshard-on-restore path. ``--event-log`` records
+  every transition as JSON lines (the recovery-time source for
+  ``benchmark/elastic_bench.py``).
+
+The ssh binary is overridable via MXTPU_SSH in both modes (CI substitutes
+a local shim where no sshd runs).
+"""
 import argparse
+import collections
+import json
 import os
 import shlex
+import signal
+import socket
 import subprocess
 import sys
+import tempfile
+import time
+
+# keep in sync with mxnet_tpu.resilience.elastic / .chaos — the supervisor
+# must classify exits before (and without) importing jax-heavy modules
+EXIT_PREEMPTED = 75
+EXIT_HOST_LOSS = 137
 
 
-def _rank_env(args, rank):
+def _rank_env(args, rank, world=None, coordinator=None):
+    world = args.num_workers if world is None else world
+    coordinator = args.coordinator if coordinator is None else coordinator
     return {
-        "MXTPU_COORDINATOR": args.coordinator,
-        "MXTPU_NUM_PROCESSES": str(args.num_workers),
+        "MXTPU_COORDINATOR": coordinator,
+        "MXTPU_NUM_PROCESSES": str(world),
         "MXTPU_PROCESS_ID": str(rank),
         # jax distributed CPU backend envs
-        "JAX_COORDINATOR_ADDRESS": args.coordinator,
-        "JAX_NUM_PROCESSES": str(args.num_workers),
+        "JAX_COORDINATOR_ADDRESS": coordinator,
+        "JAX_NUM_PROCESSES": str(world),
         "JAX_PROCESS_ID": str(rank),
     }
 
 
-def _ssh_procs(args):
-    """ssh launcher (reference tracker/ssh.py role): round-robin the
-    workers over the hostfile, forwarding the coordinator env and cwd on
-    the remote command line. The ssh binary is overridable via MXTPU_SSH
-    (CI substitutes a local shim where no sshd runs)."""
+def _read_hosts(args):
     with open(args.hostfile) as f:
         hosts = [ln.strip() for ln in f if ln.strip()
                  and not ln.startswith("#")]
     if not hosts:
         raise SystemExit("hostfile %s is empty" % args.hostfile)
-    ssh = shlex.split(os.environ.get("MXTPU_SSH", "ssh"))
-    fwd = ["PYTHONPATH", "PATH", "JAX_PLATFORMS", "XLA_FLAGS"] + \
-        [v for v in (args.env or "").split(",") if v]
-    procs = []
-    for rank in range(args.num_workers):
+    return hosts
+
+
+def _spawn_worker(args, rank, env, hosts=None):
+    """One worker in its OWN process group (so a launcher-side kill can
+    take the whole worker tree down, not just the direct child)."""
+    if args.launcher == "ssh":
         host = hosts[rank % len(hosts)]
-        env = _rank_env(args, rank)
+        ssh = shlex.split(os.environ.get("MXTPU_SSH", "ssh"))
+        # every MXNET_* knob rides along: the worker-side elastic config
+        # (grace window, collective deadline, chaos spec, ...) must match
+        # what the supervisor resolved from ITS environment
+        fwd = ["PYTHONPATH", "PATH", "JAX_PLATFORMS", "XLA_FLAGS"] + \
+            sorted(k for k in os.environ if k.startswith("MXNET_")) + \
+            [v for v in (args.env or "").split(",") if v]
+        renv = dict(env)
         for var in fwd:
-            if var in os.environ:
-                env[var] = os.environ[var]
+            if var in os.environ and var not in renv:
+                renv[var] = os.environ[var]
         envs = " ".join("%s=%s" % (k, shlex.quote(v))
-                        for k, v in sorted(env.items()))
+                        for k, v in sorted(renv.items()))
         remote = "cd %s && %s %s" % (
             shlex.quote(os.getcwd()), envs,
             " ".join(shlex.quote(c) for c in args.command))
-        procs.append(subprocess.Popen(
+        return subprocess.Popen(
             ssh + ["-n", "-o", "BatchMode=yes",
-                   "-o", "StrictHostKeyChecking=no", host, remote]))
-    return procs
+                   "-o", "StrictHostKeyChecking=no", host, remote],
+            start_new_session=True)
+    penv = dict(os.environ)
+    penv.update(env)
+    return subprocess.Popen(args.command, env=penv, start_new_session=True)
+
+
+def _pg_kill(proc, sig):
+    """Signal the worker's whole process group; fall back to the direct
+    child when the group is already gone."""
+    try:
+        os.killpg(os.getpgid(proc.pid), sig)
+    except (ProcessLookupError, PermissionError, OSError):
+        try:
+            proc.send_signal(sig)
+        except (ProcessLookupError, OSError):
+            pass
+
+
+def _wait_procs(procs, timeout):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if all(p.poll() is not None for p in procs):
+            return True
+        time.sleep(0.05)
+    return all(p.poll() is not None for p in procs)
+
+
+def _kill_all(procs, grace_s, sig_first=signal.SIGTERM):
+    """The one escalation path every mode shares: signal the surviving
+    process groups (SIGTERM first, so elastic workers get their
+    emergency-checkpoint grace), wait it out, SIGKILL the rest."""
+    procs = list(procs)
+    for p in procs:
+        if p.poll() is None:
+            _pg_kill(p, sig_first)
+    if not _wait_procs(procs, grace_s):
+        for p in procs:
+            if p.poll() is None:
+                _pg_kill(p, signal.SIGKILL)
+        _wait_procs(procs, 5.0)
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _run_plain(args, hosts):
+    procs = []
+    try:
+        # spawn INSIDE the try: a failure on rank k (missing MXTPU_SSH
+        # binary, exec error) must still sweep ranks 0..k-1
+        for rank in range(args.num_workers):
+            procs.append(_spawn_worker(args, rank, _rank_env(args, rank),
+                                       hosts))
+        return _wait_plain(procs)
+    finally:
+        # workers run in their own sessions, so a launcher death (Ctrl-C,
+        # uncaught error) no longer takes them down via the tty process
+        # group — sweep any survivors on every exit path
+        if any(p.poll() is None for p in procs):
+            _kill_all(procs, 5.0)
+
+
+def _wait_plain(procs):
+    codes = {}
+    first_bad = None
+    while len(codes) < len(procs):
+        for rank, proc in enumerate(procs):
+            if rank in codes:
+                continue
+            rc = proc.poll()
+            if rc is None:
+                continue
+            codes[rank] = rc
+            if rc != 0 and first_bad is None:
+                # first hard failure: the job is dead — kill the rest of
+                # the gang instead of leaving orphans to grind (and this
+                # launcher to hang on a wedged survivor)
+                first_bad = (rank, rc)
+                sys.stderr.write(
+                    "launch: worker %d exited rc=%d, terminating the "
+                    "remaining %d worker group(s)\n"
+                    % (rank, rc, sum(1 for p in procs
+                                     if p.poll() is None)))
+                _kill_all(procs, 10.0)
+        time.sleep(0.05)
+    sys.stderr.write("launch: per-worker exit codes: %s\n"
+                     % json.dumps({str(r): codes[r] for r in sorted(codes)}))
+    return first_bad[1] if first_bad is not None else 0
+
+
+class _EventLog:
+    def __init__(self, path):
+        self._f = open(path, "a", buffering=1) if path else None
+
+    def emit(self, event, **kw):
+        rec = {"t": time.time(), "event": event}
+        rec.update(kw)
+        sys.stderr.write("launch[supervise]: %s\n" % json.dumps(rec))
+        if self._f is not None:
+            self._f.write(json.dumps(rec) + "\n")
+
+    def close(self):
+        if self._f is not None:
+            self._f.close()
+
+
+def _supervise(args, hosts):
+    # the coordinator protocol lives in the library; import lazily so the
+    # plain launcher stays import-light
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from mxnet_tpu import config as _config
+
+    world = args.num_workers
+    min_world = (args.min_world if args.min_world is not None
+                 else _config.get("MXNET_ELASTIC_MIN_WORLD"))
+    max_restarts = (args.max_restarts if args.max_restarts is not None
+                    else _config.get("MXNET_ELASTIC_MAX_RESTARTS"))
+    backoff_ms = (args.backoff_ms if args.backoff_ms is not None
+                  else _config.get("MXNET_ELASTIC_BACKOFF_MS"))
+    grace_s = (args.grace_ms if args.grace_ms is not None
+               else _config.get("MXNET_ELASTIC_GRACE_MS")) / 1e3
+    rdzv = os.path.abspath(args.rdzv_dir or
+                           tempfile.mkdtemp(prefix="mxtpu_rdzv_"))
+    log = _EventLog(args.event_log)
+    # per-rank consecutive-crash budget: a worker that keeps dying is a
+    # bad host — evict it instead of thrashing restarts forever. The
+    # streak resets only on DURABLE progress (the member's `start` — the
+    # checkpoint step it resumed from — advanced since its last crash):
+    # heartbeat progress would let a worker that reproducibly dies
+    # between checkpoints restart forever.
+    crashes = collections.Counter()
+    fail_start = {}  # rank -> member 'start' at its previous crash
+    # honor the host part of --coordinator (a real multi-machine ssh
+    # deployment needs the supervisor's reachable address, and --rdzv-dir
+    # on a shared filesystem); only the PORT is re-picked per generation
+    coord_host = (args.coordinator or "127.0.0.1:0").rsplit(":", 1)[0]
+    # the CURRENT generation's workers, mutated IN PLACE by the loop so
+    # the teardown closure and the exit sweep below always see it
+    procs = {}
+
+    def _teardown():
+        # graceful first: survivors emergency-checkpoint on SIGTERM
+        _kill_all(procs.values(), grace_s + 5.0)
+
+    def _on_signal(signum, frame):
+        log.emit("supervisor_stopped", signum=int(signum))
+        _teardown()
+        sys.exit(128 + signum)
+
+    signal.signal(signal.SIGTERM, _on_signal)
+    signal.signal(signal.SIGINT, _on_signal)
+
+    hosts_pool = list(hosts) if hosts else None
+    try:
+        return _supervise_loop(args, log, coord_host, hosts_pool, rdzv,
+                               world, min_world, max_restarts, backoff_ms,
+                               crashes, fail_start, procs, _teardown)
+    finally:
+        # any exit path — including an unexpected supervisor error — must
+        # sweep the current generation: workers live in their own
+        # sessions and would otherwise outlive the supervisor
+        if any(p.poll() is None for p in procs.values()):
+            _kill_all(procs.values(), grace_s + 5.0)
+
+
+def _supervise_loop(args, log, coord_host, hosts_pool, rdzv, world,
+                    min_world, max_restarts, backoff_ms, crashes,
+                    fail_start, procs, _teardown):
+    from mxnet_tpu import config as _config
+    from mxnet_tpu.resilience.elastic import ElasticCoordinator
+
+    deadline_ms = (args.deadline_ms if args.deadline_ms is not None
+                   else _config.get("MXNET_ELASTIC_DEADLINE_MS"))
+    # a worker wedged BEFORE its first rendezvous record trips neither the
+    # exit-code check nor the missed-beat check — bound startup too
+    # (generous: jax import + restore + compile precede registration)
+    startup_s = 4.0 * deadline_ms / 1e3
+    gen = 0
+    while True:
+        coordinator = "%s:%d" % (coord_host, _free_port())
+        # generation-scoped: a zombie from a torn-down generation (real
+        # ssh can leave the remote side beating) must not count
+        coord = ElasticCoordinator(rdzv, world_size=world,
+                                   deadline_ms=deadline_ms,
+                                   generation=gen)
+        coord.clear()  # stale records from the previous generation
+        extra = {"MXTPU_RDZV_DIR": rdzv, "MXTPU_GENERATION": str(gen),
+                 "MXTPU_ELASTIC": "1"}
+        if args.total_devices:
+            # CPU-oracle topology simulation: the device pool re-spreads
+            # over the surviving world, so a re-formed run reshards (the
+            # analogue of a pod slice reassigned at a new size)
+            extra["XLA_FLAGS"] = (
+                "--xla_force_host_platform_device_count=%d"
+                % max(1, args.total_devices // world))
+        procs.clear()  # in place: _teardown/exit sweep track this dict
+        for rank in range(world):
+            env = _rank_env(args, rank, world=world, coordinator=coordinator)
+            env.update(extra)
+            procs[rank] = _spawn_worker(args, rank, env, hosts_pool)
+        log.emit("generation_start", gen=gen, world=world,
+                 coordinator=coordinator)
+        failure = None  # (reason, rank, rc)
+        live_emitted = False
+        last_scan = 0.0
+        gen_t0 = time.monotonic()
+        while failure is None:
+            time.sleep(0.05)
+            all_done = True
+            for rank, proc in procs.items():
+                rc = proc.poll()
+                if rc is None:
+                    all_done = False
+                    continue
+                if rc == 0:
+                    crashes.pop(rank, None)  # clean finish clears history
+                    continue
+                if rc == EXIT_PREEMPTED:
+                    reason = "preempted"
+                elif rc == EXIT_HOST_LOSS:
+                    # 137 = SIGKILL-class death (lost host, OOM kill):
+                    # the machine is gone or unreliable — evict, don't
+                    # thrash restarts on it
+                    reason = "host_loss"
+                else:
+                    reason = "crashed"
+                failure = (reason, rank, rc)
+                break
+            if failure is not None:
+                break
+            if all_done:
+                log.emit("run_complete", gen=gen, world=world)
+                log.close()
+                return 0
+            # the membership scan reads+parses every member record: beats
+            # arrive at ~1 Hz and deadlines are seconds, so scanning on
+            # every 50 ms poll would be ~20N wasted file parses/s — the
+            # exit-code checks above stay at full cadence
+            if time.monotonic() - last_scan < 0.5:
+                continue
+            last_scan = time.monotonic()
+            snap = coord.snapshot()  # ONE rendezvous scan per tick
+            if not live_emitted and coord.world(snap) >= world:
+                # every member of this generation is registered and
+                # beating — the recovery-time endpoint for the bench
+                live_emitted = True
+                log.emit("generation_live", gen=gen, world=world)
+            for rank in coord.dead(snap):
+                if rank in procs and procs[rank].poll() is None:
+                    # silent wedge (hung collective the worker-side
+                    # watchdog didn't catch, or a stopped process): it
+                    # will not exit on its own — take it down hard
+                    failure = ("hung", rank, None)
+                    _pg_kill(procs[rank], signal.SIGKILL)
+                    break
+            if failure is None and snap and not live_emitted \
+                    and time.monotonic() - gen_t0 > startup_s:
+                # registration deadline: a worker wedged BEFORE its first
+                # rendezvous record never trips the missed-beat check.
+                # Gated on `snap` (its peers DID register) so a command
+                # that doesn't speak the rendezvous protocol at all is
+                # merely restarted-on-exit, never declared hung.
+                for rank, p in procs.items():
+                    if p.poll() is None and rank not in snap:
+                        failure = ("hung", rank, None)
+                        _pg_kill(p, signal.SIGKILL)
+                        break
+        reason, rank, rc = failure
+        log.emit("worker_failed", gen=gen, rank=rank, reason=reason, rc=rc)
+        if reason == "crashed":
+            cur = coord.members().get(rank, {}).get("start")
+            if cur is not None:
+                if rank in fail_start and cur > fail_start[rank]:
+                    # the checkpoint it resumed from advanced since its
+                    # last crash — durable progress, so this failure
+                    # starts a fresh consecutive streak (a worker that
+                    # reproducibly dies between checkpoints keeps the
+                    # same `start` and still burns its budget)
+                    crashes[rank] = 0
+                fail_start[rank] = cur
+        _teardown()
+        log.emit("generation_stopped", gen=gen)
+        if reason == "crashed" and crashes[rank] < max_restarts:
+            crashes[rank] += 1
+            delay_s = backoff_ms * (2 ** (crashes[rank] - 1)) / 1e3
+            log.emit("restart", rank=rank, attempt=crashes[rank],
+                     backoff_s=delay_s, world=world)
+            time.sleep(delay_s)
+        else:
+            # eviction: a clean preemption, a silent wedge, or a crash
+            # budget spent — re-form at the surviving world size; workers
+            # resume from the rolling checkpoint and reshard
+            world -= 1
+            dropped_host = None
+            if hosts_pool is not None and len(hosts_pool) > 1:
+                # retire the failing worker's HOST, not just its rank slot
+                # — re-packed ranks would otherwise land the survivor back
+                # on the bad machine while a healthy one idles
+                dropped_host = hosts_pool.pop(rank % len(hosts_pool))
+            # ranks re-pack in the re-formed world, so rank-keyed streak
+            # state no longer attributes correctly — start fresh
+            crashes.clear()
+            fail_start.clear()
+            log.emit("evicted", rank=rank, reason=reason, world=world,
+                     host=dropped_host)
+            if world < min_world:
+                log.emit("run_failed", world=world, min_world=min_world)
+                log.close()
+                return 1
+        gen += 1
 
 
 def main():
@@ -67,27 +425,53 @@ def main():
     p.add_argument("--env", type=str, default="",
                    help="comma-separated extra env vars to forward (ssh)")
     p.add_argument("--coordinator", type=str, default="127.0.0.1:12346")
+    p.add_argument("--supervise", action="store_true",
+                   help="elastic supervisor: restart crashed workers with "
+                        "backoff, evict preempted/hung hosts, re-form at "
+                        "the surviving world size")
+    p.add_argument("--rdzv-dir", type=str, default=None,
+                   help="rendezvous dir for membership heartbeats "
+                        "(default: a fresh temp dir; must be on a SHARED "
+                        "filesystem for multi-machine ssh supervision)")
+    p.add_argument("--min-world", type=int, default=None,
+                   help="stop re-forming below this world size "
+                        "(default MXNET_ELASTIC_MIN_WORLD)")
+    p.add_argument("--max-restarts", type=int, default=None,
+                   help="consecutive crash-restarts per worker before "
+                        "eviction (default MXNET_ELASTIC_MAX_RESTARTS)")
+    p.add_argument("--grace-ms", type=float, default=None,
+                   help="SIGTERM grace before SIGKILL on teardown "
+                        "(default MXNET_ELASTIC_GRACE_MS)")
+    p.add_argument("--deadline-ms", type=float, default=None,
+                   help="missed-heartbeat deadline for declaring a worker "
+                        "hung (default MXNET_ELASTIC_DEADLINE_MS)")
+    p.add_argument("--backoff-ms", type=float, default=None,
+                   help="base restart backoff, doubles per consecutive "
+                        "crash (default MXNET_ELASTIC_BACKOFF_MS)")
+    p.add_argument("--total-devices", type=int, default=None,
+                   help="CPU simulation: total forced host devices, "
+                        "re-spread over the surviving world each "
+                        "generation (supervise mode)")
+    p.add_argument("--event-log", type=str, default=None,
+                   help="append supervisor transitions as JSON lines")
     p.add_argument("command", nargs=argparse.REMAINDER)
     args = p.parse_args()
 
     if args.launcher == "tpu":
-        # On a pod slice every host runs the same binary; nothing to spawn.
+        # On a pod slice every host runs the same binary; nothing to spawn
+        # (preemption there is handled by the queued-resource scheduler —
+        # the worker-side elastic pieces still apply).
         os.execvp(args.command[0], args.command)
 
+    hosts = None
     if args.launcher == "ssh":
         if not args.hostfile:
             raise SystemExit("--launcher ssh requires -H/--hostfile")
-        procs = _ssh_procs(args)
-    else:
-        procs = []
-        for rank in range(args.num_workers):
-            env = dict(os.environ)
-            env.update(_rank_env(args, rank))
-            procs.append(subprocess.Popen(args.command, env=env))
-    code = 0
-    for pr in procs:
-        code = pr.wait() or code
-    sys.exit(code)
+        hosts = _read_hosts(args)
+
+    if args.supervise:
+        sys.exit(_supervise(args, hosts))
+    sys.exit(_run_plain(args, hosts))
 
 
 if __name__ == "__main__":
