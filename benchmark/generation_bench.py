@@ -1,0 +1,197 @@
+"""Generation-serving benchmark: continuous batching vs naive re-prefill.
+
+Writes ``benchmark/GENERATION.json``. The committed artifact is the
+CPU-oracle run (``"platform"`` recorded inside, with the ``cpu_caveat``
+convention from ``DATAFEED.json``); rerun on a TPU host for chip numbers —
+the protocol (compile warmup excluded from TTFT only for the *naive*
+baseline's model, mixed-length workload, per-request TTFT measured at the
+submitter) is platform-correct either way.
+
+Two ways to serve the same mixed-length greedy workload:
+
+- ``continuous``: the ``serving/generation`` path — slotted KV-cache,
+  one fused decode step for all live slots, iteration-level admission.
+  Reported: aggregate tokens/s and p50/p99 time-to-first-token.
+- ``naive``: what the PR-1 serving stack would have to do — one request
+  at a time, re-running the FULL growing prefix through the model for
+  every generated token (no KV cache, no batching across requests).
+
+Usage::
+
+    python benchmark/generation_bench.py            # write GENERATION.json
+    python benchmark/generation_bench.py --quick    # smoke sizes
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+import numpy as np
+
+if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import nd  # noqa: E402
+from mxnet_tpu.models import TransformerLM  # noqa: E402
+from mxnet_tpu.serving import GenerationMetrics  # noqa: E402
+from mxnet_tpu.serving.generation import (DecodeEngine,  # noqa: E402
+                                          GenerationScheduler)
+
+VOCAB = 256
+
+
+def _pct(vals, q):
+    vals = sorted(vals)
+    if not vals:
+        return 0.0
+    import math
+    return vals[min(len(vals) - 1,
+                    max(0, math.ceil(q / 100.0 * len(vals)) - 1))]
+
+
+def build_model(units=64, layers=2, heads=4):
+    np.random.seed(0)
+    net = TransformerLM(VOCAB, units=units, num_layers=layers,
+                        num_heads=heads, max_len=256)
+    net.initialize(mx.init.Xavier())
+    net(nd.array(np.zeros((1, 8), "int32")))
+    return net
+
+
+def make_workload(n_requests, rng):
+    """Mixed-length prompts + budgets: the traffic shape continuous
+    batching exists for (uniform workloads hide the join/leave win)."""
+    return [
+        (rng.integers(0, VOCAB, size=int(rng.integers(4, 25))).tolist(),
+         int(rng.integers(8, 33)))
+        for _ in range(n_requests)
+    ]
+
+
+def bench_continuous(net, workload, slots):
+    metrics = GenerationMetrics()
+    eng = DecodeEngine(net, num_slots=slots, max_seq=128,
+                       ladder=(8, 16, 32), name="genbench")
+    sched = GenerationScheduler(eng, metrics=metrics,
+                                max_queue_size=len(workload))
+    try:
+        # warm every compile outside the measured window (ladder + decode)
+        for rung_prompt in (4, 9, 17):
+            sched.submit(list(range(1, rung_prompt + 1)),
+                         max_new_tokens=2).result(timeout=600)
+        t0 = time.perf_counter()
+        reqs = [sched.submit(p, max_new_tokens=m) for p, m in workload]
+        ttfts, n_tokens = [], 0
+        for r in reqs:
+            toks = r.result(timeout=600)
+            n_tokens += len(toks)
+            ttfts.append(r.first_token_t - r.enqueue_t)
+        wall = time.perf_counter() - t0
+        return {
+            "tokens": n_tokens,
+            "wall_s": round(wall, 3),
+            "tokens_s": round(n_tokens / wall, 2),
+            "ttft_ms": {"p50": round(_pct(ttfts, 50) * 1e3, 2),
+                        "p99": round(_pct(ttfts, 99) * 1e3, 2)},
+            "avg_step_occupancy": round(
+                metrics.snapshot()["avg_step_occupancy"], 2),
+            "compiles": eng.compile_stats(),
+        }
+    finally:
+        sched.close()
+        eng.close()
+
+
+def bench_naive(net, workload):
+    """Sequential, cache-free: every token pays a full-prefix forward."""
+    # warm the prefix-length compiles that the loop will hit (XLA compiles
+    # per shape; naive decoding sweeps prompt_len..prompt_len+budget)
+    lens = set()
+    for p, m in workload:
+        lens.update(range(len(p), len(p) + m))
+    for L in sorted(lens):
+        net(nd.array(np.zeros((1, L), "int32")))
+    # TTFT is client-observed under the SAME traffic as the continuous
+    # run: every request "arrives" at t0, and a sequential server makes
+    # later requests wait behind earlier ones end-to-end
+    t0 = time.perf_counter()
+    ttfts, n_tokens = [], 0
+    for prompt, budget in workload:
+        toks = list(prompt)
+        for i in range(budget):
+            logits = net(nd.array(np.asarray(toks, "int32")[None]))
+            nxt = int(logits.asnumpy()[0, -1].argmax())
+            toks.append(nxt)
+            if i == 0:
+                ttfts.append(time.perf_counter() - t0)
+            n_tokens += 1
+    wall = time.perf_counter() - t0
+    return {
+        "tokens": n_tokens,
+        "wall_s": round(wall, 3),
+        "tokens_s": round(n_tokens / wall, 2),
+        "ttft_ms": {"p50": round(_pct(ttfts, 50) * 1e3, 2),
+                    "p99": round(_pct(ttfts, 99) * 1e3, 2)},
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--requests", type=int, default=None)
+    ap.add_argument("--slots", type=int, default=8)
+    ap.add_argument("--out", default=os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "GENERATION.json"))
+    args = ap.parse_args()
+    n_requests = args.requests or (6 if args.quick else 16)
+
+    import jax
+    platform = jax.devices()[0].platform
+    net = build_model()
+    workload = make_workload(n_requests, np.random.default_rng(7))
+
+    print("== continuous batching (%d requests, %d slots) =="
+          % (n_requests, args.slots))
+    cont = bench_continuous(net, workload, args.slots)
+    print(json.dumps(cont, indent=2))
+    print("== naive sequential re-prefill ==")
+    naive = bench_naive(net, workload)
+    print(json.dumps(naive, indent=2))
+
+    out = {
+        "platform": platform,
+        "model": {"vocab": VOCAB, "units": net.units,
+                  "layers": net.num_layers, "heads": net.num_heads},
+        "workload": {"requests": n_requests,
+                     "prompt_len": "4-24", "max_new_tokens": "8-32",
+                     "temperature": 0.0},
+        "slots": args.slots,
+        "continuous": cont,
+        "naive": naive,
+        "speedup_tokens_s": round(cont["tokens_s"] / naive["tokens_s"], 2),
+        "ttft_p50_ratio": round(
+            naive["ttft_ms"]["p50"] / max(cont["ttft_ms"]["p50"], 1e-9), 2),
+        "cpu_caveat": (
+            "XLA-CPU oracle: both paths run the same tiny model on one "
+            "host; the continuous-batching advantage here comes from the "
+            "fused slot batch amortizing per-dispatch overhead and from "
+            "O(1) KV-cache steps vs O(prefix) re-prefill — on chip the "
+            "re-prefill baseline additionally pays one compile per prefix "
+            "length, so chip ratios are larger"),
+    }
+    with open(args.out, "w") as f:
+        json.dump(out, f, indent=2)
+        f.write("\n")
+    print("wrote %s (speedup %.2fx)" % (args.out, out["speedup_tokens_s"]))
+
+
+if __name__ == "__main__":
+    main()

@@ -140,6 +140,23 @@ KNOBS = dict([
        "collective watchdog: abort a kvstore allreduce/barrier that is "
        "still blocked after this many ms (hung-peer wedge -> "
        "CollectiveTimeout; 0 = off)"),
+    _k("MXNET_GEN_SLOTS", 8, int, "wired",
+       "generation serving: KV-cache arena slots == max sequences decoded "
+       "per fused step (serving/generation/kvcache.py)"),
+    _k("MXNET_GEN_MAX_SEQ", 256, int, "wired",
+       "generation serving: per-slot KV capacity (prompt + generated), "
+       "capped to the model's max_len"),
+    _k("MXNET_GEN_LADDER", "16,32,64,128", str, "wired",
+       "generation serving: prefill bucket ladder (comma-separated rungs; "
+       "prompts pad up to a rung, compiles bounded by the ladder)"),
+    _k("MXNET_GEN_MAX_NEW_TOKENS", 128, int, "wired",
+       "generation serving: default per-request token budget"),
+    _k("MXNET_GEN_TOP_K", 0, int, "wired",
+       "generation serving: static top-k sampling filter baked into the "
+       "decode program (0 = off; per-request temperature stays dynamic)"),
+    _k("MXNET_GEN_QUEUE_SIZE", 64, int, "wired",
+       "generation serving: waiting-request bound before ServerBusy "
+       "backpressure (serving/generation/scheduler.py)"),
     _k("MXNET_TRACE_ENABLE", 0, int, "wired",
        "record host-side spans from import (observability/tracer.py); "
        "profiler.set_state('run') enables tracing for its session "

@@ -43,16 +43,58 @@ class MultiHeadAttention(HybridBlock):
         # physical transpose brackets the attention (XPlane study: the
         # BHSD shuffles cost ~12% of a BERT-base s128 training span)
         B, T, C = x.shape
-        H = self._num_heads
-        qkv = self.qkv(x)  # (B, T, 3C)
-        qkv = qkv.reshape((B, T, 3, H, C // H))
-        q = qkv[:, :, 0]
-        k = qkv[:, :, 1]
-        v = qkv[:, :, 2]
+        q, k, v = self._split_qkv(x)
         out = F._contrib_dot_product_attention(
             q, k, v, dropout=self._dropout, causal=self._causal,
             layout="BSHD")
         return self.proj(out.reshape((B, T, C)))
+
+    def _split_qkv(self, x):
+        B, T, C = x.shape
+        H = self._num_heads
+        qkv = self.qkv(x)  # (B, T, 3C)
+        qkv = qkv.reshape((B, T, 3, H, C // H))
+        return qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+
+    # ---- incremental decode (KV-cache) path -------------------------------
+    def forward_kv(self, x, kv_mask=None):
+        """Full-prefix forward that also returns this layer's K/V.
+
+        ``x (B, T, C)``; ``kv_mask (B, T)`` keep-mask for padded prompt
+        tails (``None`` = every position valid). Returns
+        ``(out (B, T, C), k (B, T, H, D), v (B, T, H, D))`` — the K/V the
+        generation prefill copies into its cache arena."""
+        from .. import ndarray as nd
+        B, T, C = x.shape
+        q, k, v = self._split_qkv(x)
+        out = nd._contrib_dot_product_attention(
+            q, k, v, mask=kv_mask, dropout=self._dropout,
+            causal=self._causal, layout="BSHD")
+        return self.proj(out.reshape((B, T, C))), k, v
+
+    def step(self, x, k_cache, v_cache, positions):
+        """One incremental-decode step against cached K/V.
+
+        ``x (B, 1, C)`` is the new token's hidden state; ``k_cache`` /
+        ``v_cache (B, S, H, D)`` hold the first ``positions[b]`` keys and
+        values per row. Writes the new K/V at ``positions`` (per-row
+        ``dynamic_update_slice``), attends the 1-token query against all
+        cached positions ``<= positions[b]``, and returns
+        ``(out (B, 1, C), new_k_cache, new_v_cache)``."""
+        from .. import ndarray as nd
+        B, T, C = x.shape
+        q, k, v = self._split_qkv(x)
+        k_cache = nd.kv_cache_update(k_cache, k, positions)
+        v_cache = nd.kv_cache_update(v_cache, v, positions)
+        S = k_cache.shape[1]
+        span = nd.arange(0, S, dtype="int32").reshape((1, S))
+        kv_mask = span < (positions.reshape((B, 1)) + 1)
+        # single-token query: validity lives entirely in kv_mask, so the
+        # causal flag is off (q's position IS the last unmasked key)
+        out = nd._contrib_dot_product_attention(
+            q, k_cache, v_cache, mask=kv_mask, dropout=0.0, causal=False,
+            layout="BSHD")
+        return self.proj(out.reshape((B, 1, C))), k_cache, v_cache
 
 
 class TransformerEncoderLayer(HybridBlock):
@@ -77,6 +119,23 @@ class TransformerEncoderLayer(HybridBlock):
         h = F.LeakyReLU(self.ffn_up(self.ln2(x)), act_type="gelu")
         x = x + self.dropout(self.ffn_down(h))
         return x
+
+    def _ffn(self, x):
+        from .. import ndarray as nd
+        h = nd.LeakyReLU(self.ffn_up(self.ln2(x)), act_type="gelu")
+        return x + self.dropout(self.ffn_down(h))
+
+    def forward_kv(self, x, kv_mask=None):
+        """Full-prefix forward returning ``(out, k, v)`` (see
+        :meth:`MultiHeadAttention.forward_kv`)."""
+        a, k, v = self.attn.forward_kv(self.ln1(x), kv_mask)
+        return self._ffn(x + self.dropout(a)), k, v
+
+    def step(self, x, k_cache, v_cache, positions):
+        """Incremental-decode step (see :meth:`MultiHeadAttention.step`)."""
+        a, k_cache, v_cache = self.attn.step(self.ln1(x), k_cache, v_cache,
+                                             positions)
+        return self._ffn(x + self.dropout(a)), k_cache, v_cache
 
 
 class TransformerLM(HybridBlock):
@@ -109,6 +168,81 @@ class TransformerLM(HybridBlock):
         x = self.blocks(x)
         x = self.ln_f(x)
         return self.head(x)
+
+    # ---- incremental decode (KV-cache) path -------------------------------
+    @property
+    def num_heads(self):
+        return next(iter(self.blocks)).attn._num_heads
+
+    @property
+    def head_dim(self):
+        return self._units // self.num_heads
+
+    @property
+    def num_layers(self):
+        return len(self.blocks)
+
+    @property
+    def units(self):
+        return self._units
+
+    @property
+    def max_len(self):
+        return self._max_len
+
+    def init_cache(self, batch_size, max_len=None, dtype="float32"):
+        """Zeroed per-layer KV caches: ``[(k, v), ...]`` with each buffer
+        ``(batch_size, max_len, heads, head_dim)``."""
+        from .. import ndarray as nd
+        S = int(max_len or self._max_len)
+        shape = (int(batch_size), S, self.num_heads, self.head_dim)
+        return [(nd.zeros(shape, dtype=dtype), nd.zeros(shape, dtype=dtype))
+                for _ in range(self.num_layers)]
+
+    def prefill(self, tokens, lengths=None):
+        """Fill a KV cache from a (padded) prompt in ONE forward pass.
+
+        ``tokens (B, T)`` int; ``lengths (B,)`` int32 valid lengths
+        (``None`` = all ``T``). Returns ``(logits, cache)`` where
+        ``logits (B, vocab)`` belongs to each row's LAST VALID position
+        and ``cache`` is ``[(k, v), ...]`` with ``(B, T, H, D)`` buffers —
+        positions past ``lengths[b]`` contain garbage that downstream
+        attention must keep masked (``TransformerLM.step`` does)."""
+        from .. import ndarray as nd
+        B, T = tokens.shape
+        pos = nd.arange(0, T, dtype="int32")
+        x = self.embed(tokens) + self.pos_embed(pos)
+        if lengths is None:
+            lengths = nd.full((B,), T, dtype="int32")
+        kv_mask = pos.reshape((1, T)) < lengths.reshape((B, 1))
+        cache = []
+        for blk in self.blocks:
+            x, k, v = blk.forward_kv(x, kv_mask)
+            cache.append((k, v))
+        x = self.ln_f(x)
+        # gather each row's last valid hidden state (one-hot contraction:
+        # stays one fused program under jit, no host round-trip)
+        last = nd.one_hot(lengths - 1, depth=T)              # (B, T)
+        h_last = nd.sum(x * last.reshape((B, T, 1)), axis=1)  # (B, C)
+        return self.head(h_last), cache
+
+    def step(self, tokens, cache, lengths):
+        """One fused decode step for a whole batch of sequences.
+
+        ``tokens (B, 1)`` int — the token to append per row; ``cache`` as
+        returned by :meth:`init_cache`/:meth:`prefill`; ``lengths (B,)``
+        int32 — how many positions are already cached per row (== the
+        position the new token is written at). Returns
+        ``(logits (B, vocab), new_cache)``. Purely functional: the caller
+        owns cache replacement and length bookkeeping."""
+        B = tokens.shape[0]
+        x = self.embed(tokens) + self.pos_embed(lengths.reshape((B, 1)))
+        new_cache = []
+        for (k_c, v_c), blk in zip(cache, self.blocks):
+            x, k_c, v_c = blk.step(x, k_c, v_c, lengths)
+            new_cache.append((k_c, v_c))
+        x = self.ln_f(x)
+        return self.head(x.reshape((B, self._units))), new_cache
 
 
 def tp_rules(spec_cls=None):

@@ -25,6 +25,7 @@ from . import quantized_ops  # noqa: F401  (INT8 quantization op family)
 from . import spatial_ops  # noqa: F401  (grid/sampler/STN, SVM, FFT, corr)
 from . import proposal_ops  # noqa: F401  (RPN/SSD/deformable family)
 from . import contrib_misc  # noqa: F401  (quadratic/index/hawkes etc)
+from . import generation_ops  # noqa: F401  (seeded sampling, KV-cache writes)
 from . import numpy_ops  # noqa: F401  (_npi_/_np_/_npx_ registrations;
 #                                       aliases ops above, keep last)
 

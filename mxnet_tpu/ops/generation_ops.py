@@ -1,0 +1,123 @@
+"""Autoregressive-generation operators: seeded sampling + KV-cache writes.
+
+Not in MXNet 1.6 (generation there was a python loop over ``argmax`` /
+``random.multinomial`` calls, e.g. ``example/gluon/word_language_model``);
+exposed here as first-class ops because the serving decode step compiles
+them INTO the fused per-iteration XLA program (``serving/generation``).
+
+Design rules:
+
+- **Explicit PRNG keys.** Every stochastic sampler takes its key as an
+  argument (a raw ``(2,)`` uint32 jax key, or an NDArray wrapping one) —
+  never the ambient stateful stream. Same key + same logits => same token,
+  eagerly and under jit, across processes. That is what makes generation
+  replayable and the determinism regression test possible.
+- **Pure functions over logits.** No in-place mutation; the cache-write
+  ops return the updated buffer (XLA turns the copy into an in-place
+  ``dynamic-update-slice`` when the input buffer is dead — inside the
+  jitted decode step it always is).
+- **Static hyper-parameters.** ``k`` (top-k) and axis arguments are python
+  ints baked into the trace; per-slot *temperature* is a traced array so
+  one compiled decode step serves greedy and sampling requests mixed in
+  the same batch (temperature 0 == greedy, selected branchlessly).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+__all__ = ["sample_greedy", "sample_temperature", "sample_top_k",
+           "generation_sample", "kv_cache_update", "arena_update"]
+
+_NEG_INF = -1e9  # large-negative fill that stays finite in fp16/bf16
+
+
+def _as_key(key):
+    """Accept a raw (2,) uint32 key array (possibly traced)."""
+    return jnp.asarray(key, dtype=jnp.uint32)
+
+
+@register("_contrib_sample_greedy", aliases=("sample_greedy",),
+          differentiable=False)
+def sample_greedy(logits):
+    """Argmax over the last axis -> int32 token ids ``logits.shape[:-1]``."""
+    return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+
+@register("_contrib_sample_temperature", aliases=("sample_temperature",),
+          differentiable=False)
+def sample_temperature(logits, key, temperature=1.0):
+    """Categorical sample from ``softmax(logits / temperature)``.
+
+    ``temperature`` may be a scalar or a per-row array ``(B,)`` broadcast
+    over ``logits (B, V)``. ``temperature <= 0`` rows degrade to greedy
+    (selected with ``where``, so the op stays branchless under jit).
+    """
+    key = _as_key(key)
+    temp = jnp.asarray(temperature, dtype=logits.dtype)
+    cold = temp <= 0.0                      # scalar or (B,)
+    if temp.ndim == 1:
+        temp = temp[:, None]                # broadcast over vocab
+    safe = jnp.maximum(temp, jnp.asarray(1e-6, logits.dtype))
+    sampled = jax.random.categorical(key, logits / safe, axis=-1)
+    greedy = jnp.argmax(logits, axis=-1)
+    return jnp.where(cold, greedy, sampled).astype(jnp.int32)
+
+
+def _top_k_filter(logits, k):
+    """Keep the k largest logits per row, fill the rest with -inf-ish."""
+    if k <= 0 or k >= logits.shape[-1]:
+        return logits
+    vals = jax.lax.top_k(logits, k)[0]
+    kth = vals[..., -1:]
+    return jnp.where(logits >= kth, logits,
+                     jnp.asarray(_NEG_INF, logits.dtype))
+
+
+@register("_contrib_sample_top_k", aliases=("sample_top_k",),
+          differentiable=False)
+def sample_top_k(logits, key, k=0, temperature=1.0):
+    """Top-k filtered temperature sampling. ``k`` is static (baked into
+    the trace); ``k <= 0`` means no filtering."""
+    return sample_temperature.fn(_top_k_filter(logits, int(k)),
+                                 _as_key(key), temperature)
+
+
+@register("_contrib_generation_sample", aliases=("generation_sample",),
+          differentiable=False)
+def generation_sample(logits, key, temperatures, k=0):
+    """The fused serving sampler: per-row temperatures ``(B,)`` over
+    ``logits (B, V)`` (0 => greedy for that row), optional static top-k.
+    One op so the whole mixed-policy slot batch samples in one program."""
+    return sample_top_k.fn(logits, key, k=int(k), temperature=temperatures)
+
+
+@register("_contrib_kv_cache_update", aliases=("kv_cache_update",),
+          differentiable=False)
+def kv_cache_update(cache, new, positions):
+    """Write ``new (B, n, ...)`` into ``cache (B, S, ...)`` at per-row
+    offsets ``positions (B,)`` along axis 1 — a vmapped
+    ``dynamic_update_slice``, the per-slot cache append of the decode
+    step. Out-of-range positions clamp (lax semantics); callers retire
+    slots before they reach ``S``."""
+    pos = jnp.asarray(positions, dtype=jnp.int32)
+
+    def _row(c, n, p):
+        return jax.lax.dynamic_update_slice_in_dim(c, n, p, axis=0)
+
+    return jax.vmap(_row)(cache, jnp.asarray(new, cache.dtype), pos)
+
+
+@register("_contrib_arena_update", aliases=("arena_update",),
+          differentiable=False)
+def arena_update(arena, block, index, axis=1):
+    """Write ``block`` into ``arena`` at offset ``index`` (traced scalar)
+    on ``axis``, 0 on every other axis — the prefill's slot write into the
+    ``(layers, slots, seq, heads, head_dim)`` K/V arena. ``block`` must
+    match ``arena``'s rank (use a size-1 ``axis`` dim for one slot)."""
+    starts = [jnp.asarray(0, jnp.int32)] * arena.ndim
+    starts[int(axis)] = jnp.asarray(index, jnp.int32).reshape(())
+    return jax.lax.dynamic_update_slice(
+        arena, jnp.asarray(block, arena.dtype), tuple(starts))

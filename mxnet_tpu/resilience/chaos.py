@@ -12,6 +12,9 @@ Injection points wired in this codebase:
 
 ========================  ==================================================
 ``serving.execute``       DynamicBatcher model execution (per attempt)
+``generation.step``       GenerationScheduler fused decode step (per
+                          attempt; fails every live sequence when it
+                          escapes the retry policy)
 ``trainer.step``          ShardedTrainer.step / step_many entry
 ``trainer.grads``         training-step input staging (``nan`` kind poisons
                           the batch so loss/grads go non-finite)

@@ -26,9 +26,11 @@ Quickstart::
 from .batcher import (DeadlineExceeded, DynamicBatcher, ServerBusy,
                       ServerClosed, ServingError)
 from .engine import DEFAULT_BUCKETS, InferenceEngine
-from .metrics import ServingMetrics
+from .metrics import GenerationMetrics, ServingMetrics
 from .server import ModelServer
+from . import generation
 
 __all__ = ["InferenceEngine", "DynamicBatcher", "ModelServer",
-           "ServingMetrics", "ServingError", "ServerBusy",
-           "DeadlineExceeded", "ServerClosed", "DEFAULT_BUCKETS"]
+           "ServingMetrics", "GenerationMetrics", "ServingError",
+           "ServerBusy", "DeadlineExceeded", "ServerClosed",
+           "DEFAULT_BUCKETS", "generation"]

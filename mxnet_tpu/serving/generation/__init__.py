@@ -1,0 +1,49 @@
+"""mxnet_tpu.serving.generation — autoregressive generation serving.
+
+The text-generation counterpart of the one-shot ``/predict`` path: where
+``InferenceEngine`` pads whole requests to bucket shapes and runs ONE
+forward pass, generation traffic needs hundreds of dependent forward
+passes per request — so the unit of scheduling drops from "request" to
+"decode iteration" (Orca) and the KV cache moves into fixed-shape slots
+(vLLM) so XLA never recompiles as batch membership churns.
+
+- :class:`SlotKVCache` (``kvcache.py``) — the preallocated
+  ``(layers, slots, max_seq, heads, head_dim)`` K/V arena: slot
+  acquire/release/reset over a free-list, per-slot length counters,
+  occupancy stats through the resilience registry.
+- :class:`DecodeEngine` (``decode.py``) — the two compiled program
+  families: bucket-laddered prefill (compiles bounded by the ladder) and
+  ONE fused fixed-signature decode step (membership churn compiles
+  nothing), with greedy/temperature/top-k sampling under explicit PRNG
+  keys.
+- :class:`GenerationScheduler` (``scheduler.py``) — continuous batching:
+  admit into free slots at iteration boundaries, one fused step for all
+  live slots, immediate retirement on EOS/budget, streamed tokens,
+  ``DynamicBatcher``-compatible backpressure/drain and a
+  ``generation.step`` chaos point.
+
+``ModelServer`` exposes it as ``POST /generate`` with chunked NDJSON
+token streaming (``serving/server.py``). Quickstart::
+
+    from mxnet_tpu.models import transformer_lm_tiny
+    from mxnet_tpu.serving.generation import (DecodeEngine,
+                                              GenerationScheduler)
+    net = transformer_lm_tiny(); net.initialize()
+    sched = GenerationScheduler(DecodeEngine(net, num_slots=8))
+    for tok in sched.submit([1, 2, 3], max_new_tokens=32).tokens():
+        print(tok)
+"""
+from .decode import DEFAULT_LADDER, DecodeEngine, PromptTooLong
+from .kvcache import CacheFull, SlotKVCache, cache_stats
+from .scheduler import GenerationRequest, GenerationScheduler, \
+    scheduler_stats
+
+__all__ = ["SlotKVCache", "CacheFull", "DecodeEngine", "PromptTooLong",
+           "GenerationScheduler", "GenerationRequest", "DEFAULT_LADDER",
+           "gauge", "cache_stats", "scheduler_stats"]
+
+
+def gauge():
+    """The ``/metrics`` ``"generation"`` gauge: slot-arena occupancy plus
+    scheduler/compile state for every live instance."""
+    return {"kvcache": cache_stats(), "schedulers": scheduler_stats()}

@@ -1,0 +1,219 @@
+"""Compiled generation programs: bucket-laddered prefill + one decode step.
+
+Exactly TWO program families exist, both dispatched through
+:class:`~mxnet_tpu.cached_op.CachedOp` (so XLA compiles are counted,
+LRU-bounded, and traced as ``cachedop.compile`` spans):
+
+- **prefill** — fill one slot from a prompt in a single forward pass.
+  Prompts are padded up to a *bucket ladder* rung (``MXNET_GEN_LADDER``),
+  so compiles are bounded by ``len(ladder)`` regardless of prompt-length
+  traffic; the pad tail is masked out of attention and never becomes
+  readable cache (lengths gate the decode mask). The slot index is a
+  *traced* scalar: one rung's program serves every slot.
+- **decode** — ONE fused step for the whole slot batch, fixed signature
+  ``(num_slots, 1)`` tokens + per-slot lengths/temperatures + the K/V
+  arenas + an explicit PRNG key. Requests joining/leaving the running
+  batch change only data, so membership churn triggers **zero** new XLA
+  compiles (asserted by ``tests/test_generation.py`` via CachedOp stats).
+
+Inside the decode program: embed, per-layer 1-token attention against the
+arena with a keep-mask built from lengths, per-row
+``dynamic_update_slice`` cache writes, and fused greedy/temperature/top-k
+sampling (``ops/generation_ops.py``) under the explicit key.
+"""
+from __future__ import annotations
+
+import bisect
+import threading
+
+import numpy as _np
+
+from ... import config as _config
+from ...cached_op import CachedOp
+from ...observability import tracer as _trace
+from ..batcher import ServingError
+from .kvcache import SlotKVCache
+
+__all__ = ["DecodeEngine", "PromptTooLong", "DEFAULT_LADDER"]
+
+DEFAULT_LADDER = (16, 32, 64, 128)
+
+
+class PromptTooLong(ServingError):
+    """Prompt exceeds the prefill ladder / leaves no room to generate."""
+
+
+def _ladder_from_config(max_seq):
+    raw = _config.get("MXNET_GEN_LADDER")
+    rungs = tuple(int(r) for r in str(raw).split(",") if str(r).strip())
+    return tuple(r for r in sorted(set(rungs)) if r <= max_seq) or (max_seq,)
+
+
+class DecodeEngine:
+    """Slot-batched autoregressive decoder over a :class:`SlotKVCache`.
+
+    Parameters
+    ----------
+    model : TransformerLM-like
+        Must expose ``prefill(tokens, lengths)`` and
+        ``step(tokens, cache, lengths)`` plus the geometry properties
+        (``num_layers``/``num_heads``/``head_dim``/``max_len``) — the
+        incremental-decode contract of ``models/transformer.py``.
+    cache : SlotKVCache, optional
+        Built from the model geometry when omitted (``num_slots`` /
+        ``max_seq`` then apply, defaulting to ``MXNET_GEN_SLOTS`` /
+        ``MXNET_GEN_MAX_SEQ`` capped to the model's ``max_len``).
+    ladder : sequence of int, optional
+        Prefill bucket rungs (default ``MXNET_GEN_LADDER``); rungs above
+        ``max_seq`` are dropped.
+    top_k : int, optional
+        Static top-k filter baked into the decode program
+        (``MXNET_GEN_TOP_K``; 0 = off). Per-request *temperature* is a
+        traced per-slot array — mixing greedy and sampled requests in one
+        batch costs nothing.
+    seed : int
+        Base PRNG key for sampling; each step folds in a monotonically
+        increasing counter, so a fixed seed replays a run exactly.
+    """
+
+    def __init__(self, model, cache=None, num_slots=None, max_seq=None,
+                 ladder=None, top_k=None, seed=0, dtype="float32",
+                 name="generation"):
+        import jax
+        self._model = model
+        self._name = name
+        if cache is None:
+            num_slots = int(num_slots or _config.get("MXNET_GEN_SLOTS"))
+            max_seq = int(max_seq or min(_config.get("MXNET_GEN_MAX_SEQ"),
+                                         model.max_len))
+            # the cache registers stats under its name, prefixed
+            # "generation.kvcache." by the exporter — the engine name
+            # alone keeps the rows readable (generation.kvcache.<name>.*)
+            cache = SlotKVCache.for_model(model, num_slots, max_seq,
+                                          dtype=dtype, name=name)
+        self.cache = cache
+        if ladder is None:
+            ladder = _ladder_from_config(cache.max_seq)
+        self._ladder = tuple(r for r in sorted(set(int(r) for r in ladder))
+                             if 1 <= r <= cache.max_seq)
+        if not self._ladder:
+            raise ValueError("empty prefill ladder for max_seq=%d"
+                             % cache.max_seq)
+        self._top_k = int(_config.get("MXNET_GEN_TOP_K")
+                          if top_k is None else top_k)
+        self._decode_op = CachedOp(self._decode_fn, name=name + ".decode")
+        self._prefill_op = CachedOp(self._prefill_fn, name=name + ".prefill")
+        self._base_key = jax.random.PRNGKey(int(seed))
+        self._fold = jax.jit(jax.random.fold_in)
+        self._step_counter = 0
+        self._key_lock = threading.Lock()
+
+    # ---- configuration ----------------------------------------------------
+    @property
+    def ladder(self):
+        return self._ladder
+
+    @property
+    def num_slots(self):
+        return self.cache.num_slots
+
+    @property
+    def max_seq(self):
+        return self.cache.max_seq
+
+    def rung_for(self, n):
+        """Smallest ladder rung >= n; :class:`PromptTooLong` when the
+        prompt (plus one generated position) can't fit."""
+        if n < 1:
+            raise ServingError("empty prompt")
+        if n > self._ladder[-1] or n >= self.cache.max_seq:
+            raise PromptTooLong(
+                "prompt of %d tokens exceeds the prefill ladder (max rung "
+                "%d) or leaves no room to generate (max_seq %d)"
+                % (n, self._ladder[-1], self.cache.max_seq))
+        return self._ladder[bisect.bisect_left(self._ladder, n)]
+
+    def _next_key(self):
+        with self._key_lock:
+            self._step_counter += 1
+            c = self._step_counter
+        return _np.asarray(self._fold(self._base_key, c))
+
+    # ---- traced programs --------------------------------------------------
+    def _prefill_fn(self, tokens, length, slot, k_arena, v_arena):
+        from ... import ndarray as nd
+        logits, cache = self._model.prefill(tokens, length)
+        k_blk = nd.stack(*[k for k, _ in cache], axis=0)  # (L,1,rung,H,D)
+        v_blk = nd.stack(*[v for _, v in cache], axis=0)
+        k_arena = nd.arena_update(k_arena, k_blk, slot, axis=1)
+        v_arena = nd.arena_update(v_arena, v_blk, slot, axis=1)
+        return logits, k_arena, v_arena
+
+    def _decode_fn(self, tokens, lengths, temps, key, k_arena, v_arena):
+        from ... import ndarray as nd
+        cache = [(k_arena[layer], v_arena[layer])
+                 for layer in range(self.cache.num_layers)]
+        logits, new_cache = self._model.step(tokens, cache, lengths)
+        k_arena = nd.stack(*[k for k, _ in new_cache], axis=0)
+        v_arena = nd.stack(*[v for _, v in new_cache], axis=0)
+        toks = nd.generation_sample(logits, key, temps, k=self._top_k)
+        return toks, k_arena, v_arena
+
+    # ---- host-side entry points -------------------------------------------
+    def prefill(self, slot, prompt, temperature=0.0):
+        """Fill ``slot`` from ``prompt`` (1-D int token ids) and sample the
+        first generated token. Pads to a ladder rung, runs the compiled
+        prefill, commits the arenas, records the slot length, and returns
+        the sampled token (python int)."""
+        from ... import ndarray as nd
+        prompt = _np.asarray(prompt, dtype=_np.int32).reshape(-1)
+        n = int(prompt.shape[0])
+        rung = self.rung_for(n)
+        padded = _np.zeros((1, rung), dtype=_np.int32)
+        padded[0, :n] = prompt
+        with _trace.span("generation.prefill", rung=rung, prompt_len=n,
+                         slot=int(slot)):
+            logits, k_arena, v_arena = self._prefill_op(
+                nd.array(padded), nd.array(_np.array([n], _np.int32)),
+                nd.array(_np.int32(slot)),
+                self.cache.k_arena, self.cache.v_arena)
+            self.cache.commit(k_arena, v_arena)
+            self.cache.set_length(slot, n)
+            temps = _np.asarray([temperature], dtype=_np.float32)
+            tok = nd.generation_sample(logits, nd.array(self._next_key()),
+                                       nd.array(temps), k=self._top_k)
+            return int(tok.asnumpy()[0])
+
+    def decode_step(self, tokens, temperatures):
+        """ONE fused decode iteration for every slot.
+
+        ``tokens (num_slots,)`` int — each held slot's pending token
+        (free slots: any valid id, conventionally 0); ``temperatures
+        (num_slots,)`` float. Appends each token at its slot's current
+        length and returns the sampled next tokens ``(num_slots,)``
+        (numpy int32). The caller advances lengths for the slots it
+        considers live and ignores the rest."""
+        from ... import ndarray as nd
+        tokens = _np.asarray(tokens, dtype=_np.int32).reshape(
+            self.num_slots, 1)
+        temps = _np.asarray(temperatures, dtype=_np.float32).reshape(
+            self.num_slots)
+        lengths = _np.minimum(self.cache.lengths, self.max_seq - 1)
+        with _trace.span("generation.step", slots=int(self.cache.in_use)):
+            toks, k_arena, v_arena = self._decode_op(
+                nd.array(tokens), nd.array(lengths), nd.array(temps),
+                nd.array(self._next_key()),
+                self.cache.k_arena, self.cache.v_arena)
+            self.cache.commit(k_arena, v_arena)
+            return toks.asnumpy().reshape(-1)
+
+    # ---- stats ------------------------------------------------------------
+    def compile_stats(self):
+        """CachedOp cache stats for both program families — the
+        membership-churn-compiles-nothing acceptance check reads
+        ``decode["misses"]``."""
+        return {"decode": self._decode_op.cache_stats(),
+                "prefill": self._prefill_op.cache_stats()}
+
+    def close(self):
+        self.cache.close()

@@ -1,0 +1,221 @@
+"""Slotted KV-cache: a static-shape arena so decode never recompiles.
+
+The vLLM/Orca insight, restated for XLA: the KV cache must be a
+*fixed-shape* device buffer whose membership churns, not a per-request
+tensor whose shape churns. One arena pair
+
+    K, V : (num_layers, num_slots, max_seq, num_heads, head_dim)
+
+is preallocated at engine build; a sequence "owns" a slot index for its
+lifetime, its keys/values live at ``[:, slot, :len]``, and joining/leaving
+only changes *data* (lengths, slot contents) — every decode step therefore
+has the identical input signature and XLA compiles exactly once.
+
+Host-side state (free-list, per-slot length counters, occupancy stats) is
+deliberately tiny and lock-guarded; device-side state is the two arenas,
+replaced wholesale by the functional decode/prefill programs
+(``decode.py``) and committed back here. Stats flow through the resilience
+:class:`~mxnet_tpu.resilience._stats.Registry` → profiler aggregate rows
+(``generation.kvcache.<name>.*``) → the ``/metrics`` ``"generation"``
+gauge (``serving.generation.gauge``).
+"""
+from __future__ import annotations
+
+import threading
+
+import numpy as _np
+
+from ...resilience._stats import Registry, export_rows
+from ..batcher import ServingError
+
+__all__ = ["SlotKVCache", "CacheFull", "cache_stats"]
+
+_registry = Registry()
+
+
+class CacheFull(ServingError):
+    """No free slot in the arena — admission must wait (backpressure)."""
+
+
+class SlotKVCache:
+    """Preallocated K/V slot arena + free-list + per-slot length counters.
+
+    Parameters mirror the model geometry (``for_model`` derives them).
+    ``acquire``/``release``/``reset`` manage slot ownership;
+    ``advance``/``set_length`` maintain the per-slot valid-prefix lengths
+    that the decode step turns into its attention keep-mask. Arenas are
+    plain NDArrays replaced functionally by the compiled programs via
+    :meth:`commit` — release does NOT zero a slot's data: stale positions
+    are unreachable because attention is masked to ``< length`` and the
+    next prefill overwrites the prefix.
+    """
+
+    def __init__(self, num_slots, num_layers, max_seq, num_heads, head_dim,
+                 dtype="float32", name="kvcache"):
+        from ... import ndarray as nd
+        if num_slots < 1 or max_seq < 2:
+            raise ValueError("need num_slots >= 1 and max_seq >= 2")
+        self.name = name
+        self.num_slots = int(num_slots)
+        self.num_layers = int(num_layers)
+        self.max_seq = int(max_seq)
+        self.num_heads = int(num_heads)
+        self.head_dim = int(head_dim)
+        self.dtype = dtype
+        shape = (self.num_layers, self.num_slots, self.max_seq,
+                 self.num_heads, self.head_dim)
+        self.k_arena = nd.zeros(shape, dtype=dtype)
+        self.v_arena = nd.zeros(shape, dtype=dtype)
+        self._lengths = _np.zeros(self.num_slots, dtype=_np.int32)
+        self._free = list(range(self.num_slots - 1, -1, -1))  # pop() -> 0..
+        self._held = set()
+        self._lock = threading.Lock()
+        self._c = {"acquires": 0, "releases": 0, "acquire_failures": 0,
+                   "resets": 0, "peak_in_use": 0}
+        _registry.add(self)
+
+    @classmethod
+    def for_model(cls, model, num_slots, max_seq=None, dtype="float32",
+                  name="kvcache"):
+        """Size an arena from a :class:`~mxnet_tpu.models.TransformerLM`
+        (or anything exposing ``num_layers``/``num_heads``/``head_dim``/
+        ``max_len``)."""
+        max_seq = int(max_seq or model.max_len)
+        return cls(num_slots, model.num_layers, min(max_seq, model.max_len),
+                   model.num_heads, model.head_dim, dtype=dtype, name=name)
+
+    # ---- slot lifecycle ---------------------------------------------------
+    @property
+    def free_slots(self):
+        with self._lock:
+            return len(self._free)
+
+    @property
+    def in_use(self):
+        with self._lock:
+            return len(self._held)
+
+    def acquire(self):
+        """Claim a free slot (length reset to 0). Raises :class:`CacheFull`
+        when the arena is fully occupied."""
+        with self._lock:
+            if not self._free:
+                self._c["acquire_failures"] += 1
+                raise CacheFull("all %d KV-cache slots in use"
+                                % self.num_slots)
+            slot = self._free.pop()
+            self._held.add(slot)
+            self._lengths[slot] = 0
+            self._c["acquires"] += 1
+            self._c["peak_in_use"] = max(self._c["peak_in_use"],
+                                         len(self._held))
+            return slot
+
+    def release(self, slot):
+        """Return a slot to the free-list. Double-release (or releasing a
+        never-acquired slot) raises — a slot leak in reverse is a scheduler
+        bug worth failing loudly on."""
+        slot = int(slot)
+        with self._lock:
+            if slot not in self._held:
+                raise ValueError("slot %d is not held" % slot)
+            self._held.discard(slot)
+            self._lengths[slot] = 0
+            self._free.append(slot)
+            self._c["releases"] += 1
+
+    def reset(self):
+        """Free every slot and zero all length counters (arena data stays;
+        it is unreachable through the masks)."""
+        with self._lock:
+            self._held.clear()
+            self._free = list(range(self.num_slots - 1, -1, -1))
+            self._lengths[:] = 0
+            self._c["resets"] += 1
+
+    # ---- length counters --------------------------------------------------
+    @property
+    def lengths(self):
+        """Copy of the per-slot valid-prefix lengths (int32 numpy)."""
+        with self._lock:
+            return self._lengths.copy()
+
+    def set_length(self, slot, n):
+        """Record that ``slot`` now holds ``n`` valid positions (the
+        prefill's write)."""
+        n = int(n)
+        if not 0 <= n <= self.max_seq:
+            raise ValueError("length %d outside [0, %d]" % (n, self.max_seq))
+        with self._lock:
+            if slot not in self._held:
+                raise ValueError("slot %d is not held" % slot)
+            self._lengths[slot] = n
+
+    def advance(self, slots):
+        """Bump lengths by one for each held slot in ``slots`` (the decode
+        step just wrote one position each). Raises if any slot would exceed
+        ``max_seq`` — the scheduler must retire at the boundary."""
+        with self._lock:
+            for slot in slots:
+                if slot not in self._held:
+                    raise ValueError("slot %d is not held" % int(slot))
+                if self._lengths[slot] >= self.max_seq:
+                    raise ValueError("slot %d already at max_seq %d"
+                                     % (int(slot), self.max_seq))
+                self._lengths[slot] += 1
+
+    # ---- arena commit -----------------------------------------------------
+    def commit(self, k_arena, v_arena):
+        """Adopt the functionally-updated arenas returned by a compiled
+        prefill/decode program."""
+        self.k_arena = k_arena
+        self.v_arena = v_arena
+
+    # ---- stats ------------------------------------------------------------
+    def stats(self):
+        with self._lock:
+            out = dict(self._c)
+            out.update({
+                "num_slots": self.num_slots,
+                "in_use": len(self._held),
+                "free": len(self._free),
+                "occupancy": len(self._held) / float(self.num_slots),
+                "max_seq": self.max_seq,
+                "tokens_cached": int(self._lengths.sum()),
+                "arena_bytes": 2 * self.num_layers * self.num_slots *
+                self.max_seq * self.num_heads * self.head_dim *
+                _np.dtype(self.dtype).itemsize,
+            })
+        return out
+
+    def close(self):
+        """Unregister from the stats registry (finished engines must not
+        pin arenas through the exporter)."""
+        _registry.discard(self)
+
+    def __repr__(self):
+        return ("SlotKVCache(%s: %d slots x %d seq, %d layers, %d heads x "
+                "%d dim, %s)" % (self.name, self.num_slots, self.max_seq,
+                                 self.num_layers, self.num_heads,
+                                 self.head_dim, self.dtype))
+
+
+def cache_stats():
+    """``{name: stats}`` over all registered arenas (the ``/metrics``
+    ``generation.kvcache`` view)."""
+    return _registry.map(lambda c: c.stats())
+
+
+def _profiler_rows():
+    rows = {}
+    for name, st in cache_stats().items():
+        prefix = "generation.kvcache.%s" % name
+        rows[prefix + ".in_use"] = (st["in_use"], 0.0)
+        rows[prefix + ".acquires"] = (st["acquires"], 0.0)
+        rows[prefix + ".releases"] = (st["releases"], 0.0)
+        rows[prefix + ".acquire_failures"] = (st["acquire_failures"], 0.0)
+        rows[prefix + ".tokens_cached"] = (st["tokens_cached"], 0.0)
+    return rows
+
+
+export_rows(_profiler_rows)

@@ -19,7 +19,23 @@ import threading
 import time
 from collections import deque
 
-__all__ = ["ServingMetrics"]
+__all__ = ["ServingMetrics", "GenerationMetrics"]
+
+
+def _percentiles(values, qs=(50, 95, 99), scale=1e3):
+    """Nearest-rank percentiles over ``values`` (seconds -> ms by
+    default); zeros when empty. Shared by the request-latency, TTFT, and
+    tokens/s windows so every percentile on /metrics means the same
+    thing."""
+    vals = sorted(values)
+    if not vals:
+        return {("p%d" % q): 0.0 for q in qs}
+    import math
+    out = {}
+    for q in qs:
+        idx = min(len(vals) - 1, max(0, math.ceil(q / 100.0 * len(vals)) - 1))
+        out["p%d" % q] = vals[idx] * scale
+    return out
 
 
 class ServingMetrics:
@@ -98,16 +114,8 @@ class ServingMetrics:
     def percentiles(self, qs=(50, 95, 99)):
         """Latency percentiles (ms) over the sliding window; nearest-rank."""
         with self._lock:
-            lats = sorted(l for _, l in self._window)
-        if not lats:
-            return {("p%d" % q): 0.0 for q in qs}
-        import math
-        out = {}
-        for q in qs:
-            idx = min(len(lats) - 1,
-                      max(0, math.ceil(q / 100.0 * len(lats)) - 1))
-            out["p%d" % q] = lats[idx] * 1e3
-        return out
+            lats = [l for _, l in self._window]
+        return _percentiles(lats, qs)
 
     def snapshot(self):
         """All counters + derived gauges as one JSON-able dict."""
@@ -185,6 +193,174 @@ class ServingMetrics:
         """Register these counters into ``mxnet_tpu.profiler``'s aggregate
         table (idempotent); they then show up in ``profiler.dumps()`` and
         ``profiler.get_aggregate_stats()``."""
+        from .. import profiler as _profiler
+        if self._bound_provider is None:
+            self._bound_provider = self.profiler_rows
+            _profiler.register_stats_provider(self._bound_provider)
+        return self
+
+    def unbind_profiler(self):
+        from .. import profiler as _profiler
+        if self._bound_provider is not None:
+            _profiler.unregister_stats_provider(self._bound_provider)
+            self._bound_provider = None
+
+
+class GenerationMetrics:
+    """Generation-serving counters: time-to-first-token and per-slot
+    decode throughput percentiles, plus the admit/step/retire ledger.
+
+    The two latency families that matter for generation and that plain
+    request latency can't express:
+
+    - **TTFT** — submit → first streamed token (queue wait + prefill);
+      the interactivity number, reported p50/p95/p99 over a sliding
+      window.
+    - **tokens/s/slot** — each retired request's decode rate
+      (``tokens/(done - first_token)``), i.e. per-sequence speed under
+      whatever batch occupancy it ran at. The fleet-throughput view
+      (``decode_tokens_s``) is total emitted tokens over total step time.
+
+    Exported like :class:`ServingMetrics`: :meth:`snapshot` (the
+    ``/metrics`` ``generation`` section when bound by ``ModelServer``)
+    and :meth:`bind_profiler` aggregate rows (``generation.*``).
+    """
+
+    def __init__(self, window=2048, name="generation"):
+        self.name = name
+        self._lock = threading.Lock()
+        self._ttft = deque(maxlen=window)       # seconds
+        self._tps = deque(maxlen=window)        # per-request tokens/s
+        self._c = {"requests": 0, "ok": 0, "errors": 0, "rejected": 0,
+                   "expired": 0, "prefills": 0, "steps": 0,
+                   "step_failures": 0, "tokens_out": 0, "retired_eos": 0,
+                   "retired_length": 0, "retired_max_seq": 0}
+        self._ttft_total = 0.0
+        self._step_time = 0.0
+        self._prefill_time = 0.0
+        self._step_slots = 0
+        self._queue_depth_fn = None
+        self._engine = None
+        self._bound_provider = None
+
+    # ---- recording (scheduler hot path) -----------------------------------
+    def record_rejected(self):
+        with self._lock:
+            self._c["rejected"] += 1
+
+    def record_expired(self):
+        with self._lock:
+            self._c["expired"] += 1
+
+    def record_ttft(self, seconds):
+        with self._lock:
+            self._ttft.append(seconds)
+            self._ttft_total += seconds
+
+    def record_prefill(self, seconds):
+        with self._lock:
+            self._c["prefills"] += 1
+            self._prefill_time += seconds
+
+    def record_step(self, live_slots, seconds):
+        """One fused decode iteration over ``live_slots`` sequences."""
+        with self._lock:
+            self._c["steps"] += 1
+            self._c["tokens_out"] += live_slots
+            self._step_slots += live_slots
+            self._step_time += seconds
+
+    def record_step_failure(self):
+        with self._lock:
+            self._c["step_failures"] += 1
+
+    def record_done(self, n_tokens, reason, gen_seconds):
+        """A sequence retired cleanly after ``n_tokens`` in
+        ``gen_seconds`` (first token -> done). The per-slot rate is
+        measured over the ``n_tokens - 1`` decode *intervals* inside that
+        window — a 1-token sequence spans zero intervals and records no
+        rate (its gen_seconds is ~0, and 1/epsilon would poison the
+        percentile window)."""
+        with self._lock:
+            self._c["requests"] += 1
+            self._c["ok"] += 1
+            key = "retired_%s" % reason
+            if key in self._c:
+                self._c[key] += 1
+            if n_tokens > 1:
+                self._tps.append((n_tokens - 1) / max(gen_seconds, 1e-9))
+
+    def record_error(self):
+        """A sequence failed (prefill fault, step fault, shutdown)."""
+        with self._lock:
+            self._c["requests"] += 1
+            self._c["errors"] += 1
+
+    # ---- hookups ----------------------------------------------------------
+    def set_queue_depth_fn(self, fn):
+        self._queue_depth_fn = fn
+
+    def set_engine(self, engine):
+        """Wire a ``DecodeEngine`` so snapshots carry its cache occupancy
+        and compile counters."""
+        self._engine = engine
+
+    # ---- reading ----------------------------------------------------------
+    def snapshot(self):
+        with self._lock:
+            c = dict(self._c)
+            ttft = list(self._ttft)
+            tps = list(self._tps)
+            ttft_total = self._ttft_total
+            step_time = self._step_time
+            step_slots = self._step_slots
+        ttft_ms = _percentiles(ttft)
+        ttft_ms["mean"] = (ttft_total / c["prefills"] * 1e3
+                           if c["prefills"] else 0.0)
+        out = {
+            "name": self.name,
+            "ttft_ms": ttft_ms,
+            # per-request decode rate percentiles (already tokens/s: no
+            # ms scaling)
+            "tokens_s_per_slot": _percentiles(tps, scale=1.0),
+            "decode_tokens_s": (c["tokens_out"] / step_time
+                                if step_time > 0 else 0.0),
+            "avg_step_occupancy": (step_slots / c["steps"]
+                                   if c["steps"] else 0.0),
+        }
+        out.update(c)
+        if self._queue_depth_fn is not None:
+            try:
+                out["queue_depth"] = self._queue_depth_fn()
+            except Exception:
+                out["queue_depth"] = None
+        if self._engine is not None:
+            try:
+                out["kvcache"] = self._engine.cache.stats()
+                out["compile"] = self._engine.compile_stats()
+            except Exception:
+                pass
+        return out
+
+    # ---- profiler integration ---------------------------------------------
+    def profiler_rows(self):
+        with self._lock:
+            c = dict(self._c)
+            ttft_total = self._ttft_total
+            step_time = self._step_time
+            prefill_time = self._prefill_time
+        prefix = self.name
+        return {
+            prefix + ".requests": (c["requests"], ttft_total),
+            prefix + ".tokens": (c["tokens_out"], step_time),
+            prefix + ".steps": (c["steps"], step_time),
+            prefix + ".prefills": (c["prefills"], prefill_time),
+            prefix + ".rejected": (c["rejected"], 0.0),
+            prefix + ".expired": (c["expired"], 0.0),
+            prefix + ".step_failures": (c["step_failures"], 0.0),
+        }
+
+    def bind_profiler(self):
         from .. import profiler as _profiler
         if self._bound_provider is None:
             self._bound_provider = self.profiler_rows

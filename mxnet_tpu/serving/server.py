@@ -15,6 +15,14 @@ Endpoints (JSON):
   ``{"output": [...]}`` (or ``{"outputs": [...]}``). Typed failures map
   to load-balancer-friendly codes: ServerBusy→503, DeadlineExceeded→504,
   malformed input→400.
+- ``POST /generate`` — autoregressive generation (requires a
+  ``generator=`` :class:`~.generation.GenerationScheduler`): body
+  ``{"prompt": [token ids], "max_new_tokens": n, "temperature": t,
+  "eos_id": id, "stream": true}``. With ``stream`` (default) the reply is
+  ``Transfer-Encoding: chunked`` NDJSON, one ``{"token", "index"}`` line
+  per generated token as the continuous-batching loop produces it, closed
+  by a ``{"done": true, "reason": ...}`` line — time-to-first-token is
+  one prefill away regardless of how many other sequences are mid-flight.
 - ``GET /healthz`` — liveness + degradation: ``{"status": "ok"}`` in
   normal service, ``"degraded"`` (with breaker state) while the circuit
   breaker is open/half-open, ``"draining"`` during shutdown — load
@@ -51,7 +59,7 @@ from ..resilience import guardrails as _guardrails
 from ..resilience import retry as _retry
 from ..resilience.breaker import CircuitBreaker
 from .batcher import (DeadlineExceeded, DynamicBatcher, ServerBusy,
-                      ServerClosed)
+                      ServerClosed, ServingError)
 from .engine import InferenceEngine
 from .metrics import ServingMetrics
 
@@ -113,8 +121,14 @@ class _Handler(BaseHTTPRequestHandler):
             self.close_connection = True  # unknown length: can't resync
             self._reply(400, {"error": "bad Content-Length"})
             return
+        if self.path == "/generate":
+            self._handle_generate(rid, srv, body)
+            return
         if self.path != "/predict":
             self._reply(404, {"error": "unknown path %s" % self.path})
+            return
+        if srv.batcher is None:
+            self._reply(404, {"error": "no predict model loaded"})
             return
         if srv.draining:
             # shutdown in progress: shed new work BEFORE the socket goes
@@ -181,13 +195,180 @@ class _Handler(BaseHTTPRequestHandler):
         else:
             self._reply(200, {"output": _np.asarray(row).tolist()})
 
+    # ---- generation (streamed tokens) -------------------------------------
+    def _write_chunk(self, payload):
+        """One HTTP/1.1 chunk carrying one NDJSON line."""
+        data = (json.dumps(payload) + "\n").encode("utf-8")
+        self.wfile.write(b"%x\r\n" % len(data))
+        self.wfile.write(data)
+        self.wfile.write(b"\r\n")
+        self.wfile.flush()
+
+    def _handle_generate(self, rid, srv, body):
+        """``POST /generate``: continuous-batched autoregressive decoding
+        with tokens streamed back as they are produced.
+
+        Body: ``{"prompt": [id, ...]}`` plus optional ``max_new_tokens``,
+        ``temperature`` (0 = greedy), ``eos_id``, ``timeout_ms`` (queue
+        deadline) and ``stream`` (default true). Streaming responses are
+        ``Transfer-Encoding: chunked`` NDJSON — one ``{"token": id,
+        "index": i}`` line per token, then a ``{"done": true, ...}``
+        summary line; ``stream=false`` collects everything into one
+        ``{"tokens": [...], "reason": ...}`` JSON reply. Typed failures
+        map exactly like ``/predict`` (busy→503, queue deadline→504,
+        malformed/oversized prompt→400); a fault mid-stream becomes an
+        ``{"error": ...}`` line and the connection closes."""
+        if srv.generator is None:
+            self._reply(404, {"error": "no generation model loaded"})
+            return
+        if srv.draining:
+            self._reply(503, {"error": "server draining"},
+                        headers={"Retry-After": "1"})
+            return
+        try:
+            payload = json.loads(body or b"{}")
+            prompt = payload["prompt"]
+            if (not isinstance(prompt, list) or not prompt
+                    or not all(isinstance(t, int) for t in prompt)):
+                raise ValueError('"prompt" must be a non-empty list of '
+                                 'token ids')
+            # every optional field is coerced HERE so a bad type is a 400,
+            # never an exception escaping into the socket layer
+            max_new = payload.get("max_new_tokens")
+            max_new = None if max_new is None else int(max_new)
+            temperature = float(payload.get("temperature", 0.0))
+            eos_id = payload.get("eos_id")
+            eos_id = None if eos_id is None else int(eos_id)
+            timeout_ms = payload.get("timeout_ms")
+            timeout_ms = None if timeout_ms is None else float(timeout_ms)
+            stream = bool(payload.get("stream", True))
+        except (ValueError, TypeError, KeyError,
+                json.JSONDecodeError) as e:
+            self._reply(400, {"error": str(e)})
+            return
+        breaker = srv.breaker
+        admission = breaker.allow() if breaker is not None else True
+        if not admission:
+            retry_after = max(1, int(round(breaker.retry_after_s())))
+            snap = breaker.snapshot()
+            self._reply(503, {"error": "circuit open: %s" % snap["state"],
+                              "breaker": snap},
+                        headers={"Retry-After": str(retry_after)})
+            return
+        try:
+            req = srv.generator.submit(
+                prompt, max_new_tokens=max_new, temperature=temperature,
+                eos_id=eos_id, timeout_ms=timeout_ms, request_id=rid)
+        except ServerBusy as e:
+            if breaker is not None:
+                breaker.release(admission)
+            self._reply(503, {"error": str(e)},
+                        headers={"Retry-After": "1"})
+            return
+        except ServerClosed as e:
+            if breaker is not None:
+                breaker.release(admission)
+            self._reply(503, {"error": str(e)},
+                        headers={"Retry-After": "1"})
+            return
+        except ServingError as e:  # PromptTooLong / bad request shape
+            if breaker is not None:
+                breaker.release(admission)
+            self._reply(400, {"error": str(e)})
+            return
+        if not stream:
+            try:
+                toks = req.result()
+            except DeadlineExceeded as e:  # expired in queue: not a fault
+                if breaker is not None:
+                    breaker.release(admission)
+                self._reply(504, {"error": str(e)})
+                return
+            except ServerClosed as e:
+                if breaker is not None:
+                    breaker.release(admission)
+                self._reply(503, {"error": str(e)},
+                            headers={"Retry-After": "1"})
+                return
+            except Exception as e:  # noqa: BLE001 — model fault
+                if breaker is not None:
+                    breaker.record_failure(admission)
+                self._reply(500, {"error": "%s: %s"
+                                  % (type(e).__name__, e)})
+                return
+            if breaker is not None:
+                breaker.record_success(admission)
+            self._reply(200, {"tokens": toks, "reason": req.finish_reason})
+            return
+        # streamed: hold the status line until the FIRST event so
+        # pre-first-token failures (queue deadline, drain, prefill fault)
+        # keep their typed HTTP codes exactly like the non-streamed path;
+        # only once a token exists do we commit to 200 + chunked, after
+        # which failures ride in-band as an "error" line
+        kind, val = req.next_event()
+        if kind == "error":
+            if isinstance(val, DeadlineExceeded):
+                if breaker is not None:
+                    breaker.release(admission)
+                self._reply(504, {"error": str(val)})
+            elif isinstance(val, (ServerBusy, ServerClosed)):
+                if breaker is not None:
+                    breaker.release(admission)
+                self._reply(503, {"error": str(val)},
+                            headers={"Retry-After": "1"})
+            else:
+                if breaker is not None:
+                    breaker.record_failure(admission)
+                self._reply(500, {"error": "%s: %s"
+                                  % (type(val).__name__, val)})
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", "application/x-ndjson")
+        self.send_header("X-Request-Id", rid)
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+        try:
+            if kind == "token":
+                self._write_chunk({"token": val, "index": 0})
+                for i, tok in enumerate(req.tokens(), start=1):
+                    self._write_chunk({"token": tok, "index": i})
+            self._write_chunk({"done": True, "request_id": rid,
+                               "n_tokens": len(req.tokens_out),
+                               "reason": req.finish_reason})
+            self.wfile.write(b"0\r\n\r\n")
+            if breaker is not None:
+                breaker.record_success(admission)
+        except Exception as e:  # noqa: BLE001 — fault mid-stream
+            # the consumer is gone or broken either way: retire the
+            # sequence at the next iteration instead of decoding the rest
+            # of its budget into an unread queue
+            req.cancel()
+            if breaker is not None:
+                if isinstance(e, (DeadlineExceeded, ServerClosed, OSError)):
+                    # queue expiry / drain / client went away: not a model
+                    # fault — the breaker must not trip
+                    breaker.release(admission)
+                else:
+                    breaker.record_failure(admission)
+            try:
+                self._write_chunk({"error": "%s: %s"
+                                   % (type(e).__name__, e)})
+                self.wfile.write(b"0\r\n\r\n")
+            except Exception:
+                pass
+            self.close_connection = True
+
 
 class ModelServer:
     """Wire engine + batcher + metrics + breaker behind one HTTP listener.
 
     ``model`` may be an :class:`InferenceEngine` (pre-configured buckets /
     warmup) or any batched callable, in which case an engine is built with
-    ``buckets``. ``port=0`` picks an ephemeral port (tests).
+    ``buckets``; ``None`` serves generation only. ``port=0`` picks an
+    ephemeral port (tests). ``generator`` is a
+    :class:`~.generation.GenerationScheduler` backing ``POST /generate``
+    (closed with the server; its ``GenerationMetrics``, when present,
+    become the ``/metrics`` ``"generation"`` section).
 
     ``breaker=None`` (default) builds a :class:`CircuitBreaker` from the
     ``MXNET_BREAKER_*`` env knobs (set ``MXNET_BREAKER_FAILURE_THRESHOLD``
@@ -202,9 +383,15 @@ class ModelServer:
                  max_latency_ms=5.0, max_queue_size=128,
                  default_timeout_ms=None, metrics=None,
                  breaker=None, retry_policy=None,
-                 bind_profiler=True):
+                 bind_profiler=True, generator=None):
         self.metrics = metrics or ServingMetrics()
-        if isinstance(model, InferenceEngine):
+        self.generator = generator
+        if model is None:
+            # generation-only server: no /predict path
+            if generator is None:
+                raise ValueError("need a model, a generator, or both")
+            self.engine = None
+        elif isinstance(model, InferenceEngine):
             self.engine = model
             self.metrics.set_cache_stats_fn(self.engine.stats)
         else:
@@ -237,10 +424,22 @@ class ModelServer:
         # trace-derived per-phase latency histograms on /metrics: the
         # timeline's aggregate view without parsing the dumped JSON
         self.metrics.set_gauge_fn("trace", _trace.summary_gauge)
+        # generation lane: slot-arena occupancy + scheduler state, plus
+        # this server's TTFT / tokens-per-slot percentiles when a
+        # generator with GenerationMetrics is attached
+        from . import generation as _generation
+        if self.generator is not None and \
+                getattr(self.generator, "metrics", None) is not None:
+            gen_metrics = self.generator.metrics
+            self.metrics.set_gauge_fn("generation", gen_metrics.snapshot)
+            if bind_profiler:
+                gen_metrics.bind_profiler()
+        else:
+            self.metrics.set_gauge_fn("generation", _generation.gauge)
         if bind_profiler:
             self.metrics.bind_profiler()
         self._draining = False
-        self.batcher = DynamicBatcher(
+        self.batcher = None if self.engine is None else DynamicBatcher(
             self.engine, max_batch_size=max_batch_size,
             max_latency_ms=max_latency_ms, max_queue_size=max_queue_size,
             default_timeout_ms=default_timeout_ms, metrics=self.metrics,
@@ -314,12 +513,25 @@ class ModelServer:
         listener. ``drain=False`` fails queued work immediately with
         ``ServerClosed``."""
         self._draining = True
-        self.batcher.close(drain=drain, timeout=timeout)
+        if self.generator is not None:
+            # in-flight sequences finish streaming over the still-open
+            # listener (same ordering argument as the batcher drain)
+            self.generator.close(drain=drain, timeout=timeout)
+        if self.batcher is not None:
+            self.batcher.close(drain=drain, timeout=timeout)
         self._httpd.shutdown()
         self._httpd.server_close()
         if self._thread is not None:
             self._thread.join(5.0)
             self._thread = None
+        if self.generator is not None:
+            if getattr(self.generator, "metrics", None) is not None:
+                self.generator.metrics.unbind_profiler()
+            # drop the slot arena's stats registration too — a stopped
+            # server must not pin its K/V buffers through the exporter
+            gen_engine = getattr(self.generator, "engine", None)
+            if gen_engine is not None and hasattr(gen_engine, "close"):
+                gen_engine.close()
         self.metrics.unbind_profiler()
 
     def __enter__(self):
