@@ -106,6 +106,14 @@ class CachedOp:
         out["capacity"] = self._capacity
         return out
 
+    def clear(self):
+        """Drop every compiled executable (the LRU empties; counters
+        keep their history). Unloading a served model must free its XLA
+        programs — a retired fleet version holding ``len(buckets)``
+        executables through this cache would be a device-memory leak."""
+        with self._dispatch_lock:
+            self._cache.clear()
+
     def _signature(self, args):
         return (tuple((a.shape, str(a.dtype)) for a in args),
                 _tape.is_training())

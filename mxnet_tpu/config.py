@@ -157,6 +157,29 @@ KNOBS = dict([
     _k("MXNET_GEN_QUEUE_SIZE", 64, int, "wired",
        "generation serving: waiting-request bound before ServerBusy "
        "backpressure (serving/generation/scheduler.py)"),
+    _k("MXNET_HTTP_MAX_BODY", 8 * 1024 * 1024, int, "wired",
+       "ModelServer POST body cap in bytes: a larger client-declared "
+       "Content-Length is consumed in bounded chunks and refused with "
+       "413 (keep-alive stays in sync); <= 0 disables the cap"),
+    _k("MXNET_FLEET_CANARY_FRACTION", 0.1, float, "wired",
+       "fleet serving: default share of a model's traffic routed to its "
+       "canary version (deterministic by request-id hash; "
+       "serving/fleet.py)"),
+    _k("MXNET_FLEET_CANARY_MIN_SAMPLES", 20, int, "wired",
+       "fleet serving: canary-window outcomes required before the "
+       "CanaryController judges error-rate/p99 SLOs"),
+    _k("MXNET_FLEET_CANARY_ERROR_RATE", 0.25, float, "wired",
+       "fleet serving: canary error rate in excess of the baseline's "
+       "(absolute) that triggers automatic rollback"),
+    _k("MXNET_FLEET_CANARY_P99_FACTOR", 3.0, float, "wired",
+       "fleet serving: canary p99 latency >= this multiple of the "
+       "baseline's p99 triggers automatic rollback"),
+    _k("MXNET_FLEET_WINDOW", 128, int, "wired",
+       "fleet serving: per-lane sliding outcome window (requests) the "
+       "canary SLO comparison runs over"),
+    _k("MXNET_FLEET_DRAIN_TIMEOUT_MS", 10000.0, float, "wired",
+       "fleet serving: bound on draining a retiring version's in-flight "
+       "leases + batcher backlog before its lane is closed"),
     _k("MXNET_TRACE_ENABLE", 0, int, "wired",
        "record host-side spans from import (observability/tracer.py); "
        "profiler.set_state('run') enables tracing for its session "

@@ -216,6 +216,24 @@ class CircuitBreaker:
             if trip:
                 self._open_locked()
 
+    def trip(self):
+        """Force the circuit OPEN immediately, bypassing failure
+        accounting — the fleet's canary rollback (and any admin
+        kill-switch) must stop traffic NOW, not after ``threshold`` more
+        doomed calls. Already-open circuits restart their recovery
+        timer."""
+        with self._lock:
+            if self._state != OPEN:
+                self._open_locked()
+            else:
+                self._opened_at = self._clock()
+
+    def deregister(self):
+        """Drop this breaker from the exported stats registry (no-op if a
+        newer same-name instance superseded it). Retired fleet lanes call
+        this so a closed version stops exporting ``breaker.*`` rows."""
+        _registry.discard(self)
+
     def call(self, fn, *args, **kwargs):
         """Convenience wrapper: fast-fail with :class:`CircuitOpen` when the
         circuit is open, otherwise run ``fn`` and record the outcome."""

@@ -15,6 +15,11 @@ Injection points wired in this codebase:
 ``generation.step``       GenerationScheduler fused decode step (per
                           attempt; fails every live sequence when it
                           escapes the retry policy)
+``fleet.rollout``         canary-lane request execution
+                          (``serving/fleet.py``): arming it makes a
+                          canary fail/stall deterministically so
+                          detection -> automatic rollback is testable
+                          end-to-end
 ``trainer.step``          ShardedTrainer.step / step_many entry
 ``trainer.grads``         training-step input staging (``nan`` kind poisons
                           the batch so loss/grads go non-finite)

@@ -26,11 +26,20 @@ Quickstart::
 from .batcher import (DeadlineExceeded, DynamicBatcher, ServerBusy,
                       ServerClosed, ServingError)
 from .engine import DEFAULT_BUCKETS, InferenceEngine
+from .fleet import (CanaryController, ChecksumMismatch,
+                    CompileBudgetExceeded, FleetError, ManifestError,
+                    ModelNotFound, ModelRegistry, ModelVersion,
+                    VersionNotFound, verify_manifest, write_manifest)
 from .metrics import GenerationMetrics, ServingMetrics
 from .server import ModelServer
+from . import fleet
 from . import generation
 
 __all__ = ["InferenceEngine", "DynamicBatcher", "ModelServer",
            "ServingMetrics", "GenerationMetrics", "ServingError",
            "ServerBusy", "DeadlineExceeded", "ServerClosed",
-           "DEFAULT_BUCKETS", "generation"]
+           "DEFAULT_BUCKETS", "generation", "fleet", "ModelRegistry",
+           "ModelVersion", "CanaryController", "FleetError",
+           "ModelNotFound", "VersionNotFound", "ManifestError",
+           "ChecksumMismatch", "CompileBudgetExceeded",
+           "write_manifest", "verify_manifest"]

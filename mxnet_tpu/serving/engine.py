@@ -198,6 +198,16 @@ class InferenceEngine:
             self._run_bucketed(batch)
         return self
 
+    def close(self):
+        """Release the executor cache: every compiled bucket program is
+        dropped so a retired model version frees its XLA executables
+        instead of pinning them for the process lifetime. The engine
+        stays callable (programs recompile on demand) — ``close()`` is a
+        resource release, not a poison pill, so a drain that races one
+        last request cannot turn it into an error. Idempotent."""
+        if self._op is not None:
+            self._op.clear()
+
     def stats(self):
         """Executor-cache counters for /metrics: bucket ladder, buckets
         actually hit, and the CachedOp LRU's hit/miss/evict counts

@@ -1,0 +1,992 @@
+"""Fleet serving: multi-model registry, atomic hot-swap, guarded canary.
+
+Role parity: MXNet Model Server — the TF-Serving-style model server the
+engine docstring cites — managed N models x versions behind one port
+(register/unregister, versioned rollout). ``ModelServer`` here bound
+exactly one engine; this module closes that gap with the robustness the
+reference ecosystem delegated to its fronting infrastructure:
+
+- **Bulkheads** (Clipper's per-model isolation): every
+  :class:`ModelVersion` owns its own ``InferenceEngine``, bucket ladder,
+  ``DynamicBatcher`` queue + worker thread, ``CircuitBreaker``, and
+  metrics/trace lane. A wedged or 100%-faulting model saturates only its
+  own queue and trips only its own breaker — it cannot starve or 503 the
+  other registered models.
+- **Atomic hot-swap** (TF-Serving's version manager): the incoming
+  version is fully built and warmed *before* the serving pointer flips —
+  the same stage-everything-then-rename idiom as the checkpoint publish
+  in ``parallel/checkpoint.py`` / ``resilience/resume.py``, with a
+  pointer assignment as the rename. In-flight requests hold a lease on
+  the version that routed them; the outgoing version drains those leases
+  and its batcher backlog before its lane is unloaded, so a swap under
+  live traffic drops zero requests.
+- **Guarded canary rollout**: :meth:`ModelRegistry.start_canary` splits
+  traffic deterministically by hash of the request id, and a
+  :class:`CanaryController` watches the canary lane's sliding-window
+  error rate and p99 against the baseline lane. On SLO breach it rolls
+  the canary back automatically and trips the canary's breaker — a bad
+  deploy burns at most ``fraction`` of traffic for ``min_samples``
+  requests, never the fleet. End-to-end testable via the
+  ``fleet.rollout`` chaos point, which fires on every canary-lane
+  execution.
+- **Checksummed artifacts**: a version loaded from disk must carry a
+  ``manifest.json`` whose per-file SHA-256 digests verify
+  (:func:`verify_manifest`); corrupt or truncated artifacts are rejected
+  with a typed :class:`ManifestError` / :class:`ChecksumMismatch` before
+  a lane is ever built on them.
+- **Shared compile budget**: every lane's ladder compiles into the same
+  process, so :class:`ModelRegistry` admits a new version only while the
+  sum of compiled programs across live lanes fits
+  ``MXNET_CACHED_OP_CAPACITY`` (:class:`CompileBudgetExceeded`
+  otherwise) — N models cannot silently melt the executor cache that
+  one model was tuned for.
+
+``ModelServer(registry=...)`` exposes the fleet over the existing HTTP
+surface: ``/predict`` and ``/generate`` take a ``model`` body field or
+path segment (``/predict/<model>``; the default model keeps the old
+wire format working), ``/healthz`` and ``/metrics`` grow per-model
+sections, and every response echoes ``X-Model-Version``.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+import time
+import uuid
+from collections import deque
+from contextlib import contextmanager
+
+from .. import config as _config
+from ..observability import tracer as _trace
+from ..resilience import chaos as _chaos
+from ..resilience._stats import Registry as _NamedRegistry
+from ..resilience._stats import export_rows as _export_rows
+from ..resilience.breaker import CircuitBreaker, CircuitOpen
+from .batcher import (DeadlineExceeded, DynamicBatcher, ServerBusy,
+                      ServerClosed, ServingError)
+from .engine import DEFAULT_BUCKETS, InferenceEngine
+from .metrics import ServingMetrics, _percentiles
+
+__all__ = ["ModelRegistry", "ModelVersion", "CanaryController",
+           "FleetError", "ModelNotFound", "VersionNotFound",
+           "ManifestError", "ChecksumMismatch", "CompileBudgetExceeded",
+           "StaleVersion", "write_manifest", "verify_manifest",
+           "MANIFEST_NAME"]
+
+MANIFEST_NAME = "manifest.json"
+
+
+class FleetError(ServingError):
+    """Base class for typed fleet failures."""
+
+
+class ModelNotFound(FleetError):
+    """No such model registered (HTTP 404)."""
+
+
+class VersionNotFound(FleetError):
+    """Model exists but the named/live version doesn't (HTTP 404)."""
+
+
+class ManifestError(FleetError):
+    """Version artifacts have no readable manifest — refuse to load."""
+
+
+class ChecksumMismatch(ManifestError):
+    """An artifact's bytes don't match its manifest digest (corrupt or
+    tampered) — refuse to load."""
+
+
+class CompileBudgetExceeded(FleetError):
+    """Admitting this version's ladder would push the fleet past the
+    process-wide compile budget (``MXNET_CACHED_OP_CAPACITY``)."""
+
+
+class StaleVersion(FleetError):
+    """The routed version began draining before this request entered its
+    lane; the registry re-routes (internal — ``ModelRegistry.predict``
+    retries, callers never see it)."""
+
+
+# ---------------------------------------------------------------------------
+# checksummed artifact manifests
+# ---------------------------------------------------------------------------
+
+def _hash_file(path, chunk=1 << 20):
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for block in iter(lambda: f.read(chunk), b""):
+            h.update(block)
+    return h.hexdigest()
+
+
+def write_manifest(version_dir, extra=None):
+    """Write ``manifest.json`` into ``version_dir``: per-file SHA-256 +
+    size over every artifact file under it. Published atomically (staged
+    to ``manifest.json.tmp``, then renamed — the checkpoint-publish
+    idiom), so a crash mid-write never leaves a half-manifest that
+    :func:`verify_manifest` would trust. ``extra`` merges additional
+    metadata keys (model name, framework version, training run id...).
+    Returns the manifest dict."""
+    version_dir = os.path.abspath(version_dir)
+    files = {}
+    for root, _, names in os.walk(version_dir):
+        for n in sorted(names):
+            if n in (MANIFEST_NAME, MANIFEST_NAME + ".tmp"):
+                continue
+            p = os.path.join(root, n)
+            rel = os.path.relpath(p, version_dir)
+            files[rel] = {"sha256": _hash_file(p),
+                          "bytes": os.path.getsize(p)}
+    if not files:
+        raise ManifestError("no artifact files under %s" % version_dir)
+    manifest = {"format": 1, "files": files}
+    if extra:
+        manifest.update(extra)
+    tmp = os.path.join(version_dir, MANIFEST_NAME + ".tmp")
+    with open(tmp, "w") as f:
+        json.dump(manifest, f, indent=2, sort_keys=True)
+        f.flush()
+        os.fsync(f.fileno())
+    os.rename(tmp, os.path.join(version_dir, MANIFEST_NAME))
+    return manifest
+
+
+def verify_manifest(version_dir):
+    """Validate ``version_dir`` against its ``manifest.json``. Raises
+    :class:`ManifestError` (missing/unreadable/empty manifest, missing
+    artifact) or :class:`ChecksumMismatch` (size or digest mismatch).
+    Returns the manifest dict on success."""
+    version_dir = os.path.abspath(version_dir)
+    path = os.path.join(version_dir, MANIFEST_NAME)
+    if not os.path.exists(path):
+        raise ManifestError("no %s in %s — refusing to load unverifiable "
+                            "artifacts" % (MANIFEST_NAME, version_dir))
+    try:
+        with open(path) as f:
+            manifest = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        raise ManifestError("unreadable %s: %s" % (path, e)) from e
+    files = manifest.get("files")
+    if not isinstance(files, dict) or not files:
+        raise ManifestError("%s lists no files" % path)
+    for rel, meta in files.items():
+        p = os.path.join(version_dir, rel)
+        if not os.path.exists(p):
+            raise ManifestError("artifact %s listed in manifest is "
+                                "missing" % rel)
+        size = os.path.getsize(p)
+        if size != int(meta.get("bytes", -1)):
+            raise ChecksumMismatch(
+                "artifact %s is %d bytes, manifest says %s (truncated or "
+                "partially written?)" % (rel, size, meta.get("bytes")))
+        digest = _hash_file(p)
+        if digest != meta.get("sha256"):
+            raise ChecksumMismatch(
+                "artifact %s sha256 %s != manifest %s (corrupt or "
+                "tampered)" % (rel, digest[:12], str(meta.get("sha256"))[:12]))
+    return manifest
+
+
+# ---------------------------------------------------------------------------
+# one version == one bulkhead lane
+# ---------------------------------------------------------------------------
+
+class ModelVersion:
+    """One loaded model version: engine + batcher + breaker + metrics,
+    isolated from every other lane. Built by :meth:`ModelRegistry.load`.
+
+    States: ``standby`` (loaded, not routed) → ``live`` / ``canary``
+    (routed) → ``draining`` (pointer moved away; in-flight leases finish)
+    → ``retired`` (lane closed, executables freed); ``rolled_back`` is a
+    canary that breached its SLO (kept loaded for inspection, breaker
+    open, no traffic).
+    """
+
+    def __init__(self, model, version, engine=None, generator=None,
+                 metrics=None, breaker=None, batcher_kwargs=None,
+                 window=None):
+        self.model = str(model)
+        self.version = str(version)
+        self.engine = engine
+        self.generator = generator
+        self.metrics = metrics
+        self.breaker = breaker
+        self.state = "standby"
+        self._vlock = threading.Lock()
+        self._idle = threading.Condition(self._vlock)
+        self._inflight = 0
+        if window is None:
+            window = _config.get("MXNET_FLEET_WINDOW")
+        # (ok, latency_s) over recent lane executions — what the canary
+        # controller compares; separate from ServingMetrics' latency ring
+        # because the comparison needs per-outcome ok flags
+        self._outcomes = deque(maxlen=int(window))
+        self._on_outcome = None   # CanaryController hook
+        self._closed = False
+        self.batcher = None
+        if engine is not None:
+            self.batcher = DynamicBatcher(
+                engine, metrics=metrics,
+                name="fleet.%s.%s" % (self.model, self.version),
+                **(batcher_kwargs or {}))
+
+    @property
+    def label(self):
+        """The ``X-Model-Version`` attribution string."""
+        return "%s/%s" % (self.model, self.version)
+
+    # ---- lease protocol (zero-drop hot-swap) ------------------------------
+    @contextmanager
+    def lease(self):
+        """Pin this version for one request. A version flips to
+        ``draining`` only via :meth:`ModelRegistry.promote`/``unload``;
+        after that no new lease is granted (:class:`StaleVersion` — the
+        caller re-routes) and the drain waits for every held lease, so a
+        request that entered the lane always completes on it."""
+        with self._vlock:
+            if self.state in ("draining", "retired"):
+                raise StaleVersion("%s is %s" % (self.label, self.state))
+            self._inflight += 1
+        try:
+            yield self
+        finally:
+            with self._vlock:
+                self._inflight -= 1
+                if self._inflight <= 0:
+                    self._idle.notify_all()
+
+    def _wait_idle(self, timeout):
+        """Block until every lease is returned (or ``timeout`` seconds)."""
+        deadline = time.monotonic() + timeout
+        with self._vlock:
+            while self._inflight > 0:
+                rem = deadline - time.monotonic()
+                if rem <= 0:
+                    return False
+                self._idle.wait(rem)
+        return True
+
+    # ---- outcome window (canary SLO input) --------------------------------
+    def record_outcome(self, ok, latency_s):
+        """One lane execution verdict; feeds the canary controller."""
+        with self._vlock:
+            self._outcomes.append((bool(ok), float(latency_s)))
+        hook = self._on_outcome
+        if hook is not None:
+            hook(bool(ok), float(latency_s))
+
+    def _notify(self):
+        """A fast-fail (breaker open) — no model verdict, but the
+        controller must still get a chance to act on breaker state."""
+        hook = self._on_outcome
+        if hook is not None:
+            hook(None, None)
+
+    def outcomes(self):
+        with self._vlock:
+            return list(self._outcomes)
+
+    # ---- execution --------------------------------------------------------
+    def rollout_gate(self):
+        """The ``fleet.rollout`` chaos point, fired once per canary-lane
+        execution — the predict AND generate paths both route through
+        here, so an armed rule makes this canary's traffic fail/stall
+        deterministically whichever surface drives it."""
+        if self.state == "canary":
+            _chaos.point("fleet.rollout")
+
+    def predict(self, *inputs, timeout_ms=None, request_id=None):
+        """Run one request through this lane: breaker admission →
+        batcher → breaker verdict + outcome window. Raises
+        :class:`~mxnet_tpu.resilience.breaker.CircuitOpen` on fast-fail;
+        backpressure (``ServerBusy``/``DeadlineExceeded``/
+        ``ServerClosed``) releases the admission without a verdict —
+        load-shed must never trip a breaker or skew the canary window."""
+        if self.batcher is None:
+            raise VersionNotFound(
+                "%s has no predict lane (generation-only)" % self.label)
+        breaker = self.breaker
+        admission = breaker.allow() if breaker is not None else True
+        if not admission:
+            self._notify()
+            raise CircuitOpen("%s: circuit open" % self.label,
+                              retry_after_s=breaker.retry_after_s())
+        t0 = time.monotonic()
+        try:
+            with _trace.span("fleet.request", model=self.model,
+                             version=self.version, state=self.state,
+                             request_id=request_id):
+                self.rollout_gate()
+                row = self.batcher.predict(*inputs, timeout_ms=timeout_ms,
+                                           request_id=request_id)
+        except (ServerBusy, DeadlineExceeded, ServerClosed):
+            if breaker is not None:
+                breaker.release(admission)
+            raise
+        except Exception:
+            if breaker is not None:
+                breaker.record_failure(admission)
+            self.record_outcome(False, time.monotonic() - t0)
+            raise
+        if breaker is not None:
+            breaker.record_success(admission)
+        self.record_outcome(True, time.monotonic() - t0)
+        return row
+
+    # ---- lifecycle --------------------------------------------------------
+    def close(self, drain=True, timeout=None):
+        """Tear the lane fully down: drain/close the batcher and
+        generator, free the engine's compiled executables, unbind the
+        metrics provider, deregister the breaker — a retired version must
+        not pin device memory or keep exporting rows. Idempotent."""
+        if self._closed:
+            return
+        self._closed = True
+        if self.batcher is not None:
+            self.batcher.close(drain=drain, timeout=timeout)
+        if self.generator is not None:
+            self.generator.close(drain=drain, timeout=timeout)
+            gm = getattr(self.generator, "metrics", None)
+            if gm is not None:
+                gm.unbind_profiler()
+            geng = getattr(self.generator, "engine", None)
+            if geng is not None and hasattr(geng, "close"):
+                geng.close()
+        if self.engine is not None and hasattr(self.engine, "close"):
+            self.engine.close()
+        if self.metrics is not None:
+            self.metrics.unbind_profiler()
+        if self.breaker is not None:
+            self.breaker.deregister()
+
+    # ---- observability ----------------------------------------------------
+    def health(self):
+        """This lane's ``/healthz`` section: ``ok`` | ``degraded`` |
+        ``draining`` | ``retired`` + breaker state."""
+        with self._vlock:
+            state = self.state
+            inflight = self._inflight
+        out = {"state": state, "inflight": inflight}
+        status = "ok"
+        if state in ("draining", "retired"):
+            status = state
+        if self.breaker is not None:
+            snap = self.breaker.snapshot()
+            out["breaker"] = snap
+            if snap["state"] != "closed" and status == "ok":
+                status = "degraded"
+        if state == "rolled_back":
+            status = "degraded"
+        out["status"] = status
+        return out
+
+    def __repr__(self):
+        return "<ModelVersion %s state=%s>" % (self.label, self.state)
+
+
+# ---------------------------------------------------------------------------
+# canary SLO watchdog
+# ---------------------------------------------------------------------------
+
+class CanaryController:
+    """Watch a canary lane against its baseline; roll back on SLO breach.
+
+    Runs inline on the request threads (checked after every canary
+    outcome — no poller thread, so tests and rollback timing are
+    deterministic). Breach conditions, first match wins:
+
+    - ``breaker_open`` — the canary's own breaker left ``closed`` (e.g.
+      a fault storm tripped it before the window filled);
+    - ``error_rate`` — canary window error rate exceeds the baseline's
+      by ``error_rate`` (absolute), with ≥ ``min_samples`` canary
+      outcomes observed;
+    - ``p99`` — canary p99 latency ≥ ``p99_factor`` × baseline p99,
+      both windows ≥ ``min_samples``.
+
+    On breach: :meth:`ModelRegistry.rollback` — traffic snaps back to
+    100% baseline, the canary's breaker is tripped open, and the
+    decision (reason, rates, detection latency) is recorded on the
+    model entry for ``/metrics`` and the bench artifact.
+    """
+
+    def __init__(self, registry, model, baseline, canary, min_samples=None,
+                 error_rate=None, p99_factor=None):
+        self.registry = registry
+        self.model = model
+        self.baseline = baseline
+        self.canary = canary
+        self.min_samples = int(
+            min_samples if min_samples is not None
+            else _config.get("MXNET_FLEET_CANARY_MIN_SAMPLES"))
+        self.error_rate = float(
+            error_rate if error_rate is not None
+            else _config.get("MXNET_FLEET_CANARY_ERROR_RATE"))
+        self.p99_factor = float(
+            p99_factor if p99_factor is not None
+            else _config.get("MXNET_FLEET_CANARY_P99_FACTOR"))
+        self.started_t = time.monotonic()
+        self.first_error_t = None
+        self.decision = None
+        self._lock = threading.Lock()
+        canary._on_outcome = self._on_canary_outcome
+
+    def _on_canary_outcome(self, ok, latency_s):
+        if ok is False and self.first_error_t is None:
+            self.first_error_t = time.monotonic()
+        self.check()
+
+    def check(self):
+        """Evaluate the SLO once; rolls back (at most once) on breach."""
+        if self.decision is not None:
+            return
+        br = self.canary.breaker
+        if br is not None and br.snapshot()["state"] != "closed":
+            self._breach("breaker_open")
+            return
+        can = self.canary.outcomes()
+        if len(can) < self.min_samples:
+            return
+        can_err = sum(1 for ok, _ in can if not ok) / float(len(can))
+        base = self.baseline.outcomes()
+        base_err = (sum(1 for ok, _ in base if not ok) / float(len(base))
+                    if base else 0.0)
+        if can_err - base_err >= self.error_rate:
+            self._breach("error_rate", canary_error_rate=can_err,
+                         baseline_error_rate=base_err)
+            return
+        if len(base) >= self.min_samples:
+            can_p99 = _percentiles([l for _, l in can], qs=(99,))["p99"]
+            base_p99 = _percentiles([l for _, l in base], qs=(99,))["p99"]
+            if base_p99 > 0 and can_p99 >= self.p99_factor * base_p99:
+                self._breach("p99", canary_p99_ms=can_p99,
+                             baseline_p99_ms=base_p99)
+
+    def _breach(self, reason, **details):
+        with self._lock:
+            if self.decision is not None:
+                return  # a racing request thread already decided
+            now = time.monotonic()
+            self.decision = {
+                "reason": reason,
+                # detection latency: first observed canary error (or
+                # canary start, for pure-latency breaches) → decision
+                "detect_ms": (now - (self.first_error_t or self.started_t))
+                * 1e3,
+                **details,
+            }
+        self.registry.rollback(self.model, reason=reason)
+
+
+# ---------------------------------------------------------------------------
+# the registry
+# ---------------------------------------------------------------------------
+
+class _Entry:
+    """One named model: its versions, serving/canary pointers, history."""
+
+    __slots__ = ("name", "lock", "versions", "serving", "canary",
+                 "canary_fraction", "controller", "history",
+                 "last_rollback")
+
+    def __init__(self, name):
+        self.name = name
+        self.lock = threading.Lock()
+        self.versions = {}
+        self.serving = None
+        self.canary = None
+        self.canary_fraction = 0.0
+        self.controller = None
+        self.history = []
+        self.last_rollback = None
+
+
+class ModelRegistry:
+    """Named models × versions behind one process — load/unload, atomic
+    promote, canary split, per-model bulkheads.
+
+    ``compile_budget`` (default ``MXNET_CACHED_OP_CAPACITY``) bounds the
+    total compiled programs admitted across every live lane's ladder;
+    ``<= 0`` disables the admission check (the per-op LRU still bounds
+    memory). The first version loaded for a model starts serving it; the
+    first model loaded becomes the default (``model=None`` routing) —
+    both overridable.
+    """
+
+    def __init__(self, default_model=None, compile_budget=None,
+                 name="fleet"):
+        self.name = name
+        self._lock = threading.Lock()
+        self._entries = {}
+        self._default = default_model
+        self._budget = int(
+            compile_budget if compile_budget is not None
+            else _config.get("MXNET_CACHED_OP_CAPACITY"))
+        self._c = {"loads": 0, "unloads": 0, "promotes": 0, "rollbacks": 0,
+                   "canaries": 0, "reroutes": 0}
+        # serializes budget check -> lane registration so two concurrent
+        # load()s cannot both pass the admission check and overshoot
+        self._admit_lock = threading.Lock()
+        self._closed = False
+        _registries.add(self)
+
+    # ---- admission: the shared compile budget -----------------------------
+    @staticmethod
+    def _lane_programs(mv):
+        """Compiled programs a lane can hold: its predict ladder, plus a
+        generator's prefill rungs + the one fused decode step."""
+        n = 0
+        if mv.engine is not None:
+            n += len(mv.engine.buckets)
+        if mv.generator is not None:
+            geng = getattr(mv.generator, "engine", None)
+            n += len(getattr(geng, "ladder", ()) or ()) + 1
+        return n
+
+    def _programs_in_use(self):
+        total = 0
+        with self._lock:
+            entries = list(self._entries.values())
+        for entry in entries:
+            with entry.lock:
+                versions = list(entry.versions.values())
+            for mv in versions:
+                if mv.state != "retired":
+                    total += self._lane_programs(mv)
+        return total
+
+    # ---- load / unload ----------------------------------------------------
+    def load(self, model, version, source=None, path=None,
+             input_names=("data",), artifact_prefix="model", buckets=None,
+             jit=True, warmup=None, generator=None, breaker=None,
+             verify=True, max_batch_size=32, max_latency_ms=5.0,
+             max_queue_size=128, default_timeout_ms=None,
+             retry_policy=None, metrics_window=2048):
+        """Load one version into a fresh bulkhead lane (state
+        ``standby`` — or ``live`` when it is the model's first version).
+
+        ``source`` is an :class:`InferenceEngine` or a batched callable;
+        ``path`` instead loads export artifacts (``<prefix>-symbol.json``
+        + params) from a version directory whose ``manifest.json`` must
+        verify (``verify=False`` skips — tests only). ``generator``
+        attaches a :class:`~.generation.GenerationScheduler` for
+        ``/generate`` routing (its metrics are renamed into the
+        ``generation.<model>.<version>`` namespace when they still carry
+        the default name). ``warmup`` pre-compiles every bucket NOW so
+        the later pointer flip costs zero compiles.
+        """
+        model, version = str(model), str(version)
+        for label, value in (("model", model), ("version", version)):
+            if not value or "/" in value:
+                raise FleetError("bad %s name %r (non-empty, no '/')"
+                                 % (label, value))
+        if source is None and path is None and generator is None:
+            raise FleetError("need source=, path=, or generator=")
+        engine = None
+        if isinstance(source, InferenceEngine):
+            engine = source
+        elif source is not None:
+            engine = InferenceEngine(
+                source, buckets=buckets or DEFAULT_BUCKETS, jit=jit,
+                retry_policy=False,
+                name="fleet.%s.%s" % (model, version))
+        elif path is not None:
+            if verify:
+                verify_manifest(path)
+            engine = InferenceEngine.load(
+                os.path.join(path, artifact_prefix),
+                input_names=input_names,
+                buckets=buckets or DEFAULT_BUCKETS, jit=jit,
+                retry_policy=False,
+                name="fleet.%s.%s" % (model, version))
+        metrics = ServingMetrics(window=metrics_window,
+                                 name="serving.%s.%s" % (model, version))
+        if engine is not None:
+            metrics.set_cache_stats_fn(engine.stats)
+        if breaker is None:
+            threshold = _config.get("MXNET_BREAKER_FAILURE_THRESHOLD")
+            breaker = CircuitBreaker(
+                failure_threshold=threshold,
+                recovery_ms=_config.get("MXNET_BREAKER_RECOVERY_MS"),
+                half_open_probes=_config.get(
+                    "MXNET_BREAKER_HALF_OPEN_PROBES"),
+                name="fleet.%s.%s" % (model, version)) \
+                if threshold > 0 else False
+        mv = ModelVersion(
+            model, version, engine=engine, generator=generator,
+            metrics=metrics, breaker=breaker or None,
+            batcher_kwargs=dict(max_batch_size=max_batch_size,
+                                max_latency_ms=max_latency_ms,
+                                max_queue_size=max_queue_size,
+                                default_timeout_ms=default_timeout_ms,
+                                retry_policy=retry_policy))
+        if generator is not None:
+            gm = getattr(generator, "metrics", None)
+            if gm is not None and gm.name == "generation":
+                # namespace the lane's generation rows so two models'
+                # stats cannot collide in the aggregate table
+                gm.name = "generation.%s.%s" % (model, version)
+        # admission AFTER construction (ladder sizes known), BEFORE the
+        # lane becomes routable; _admit_lock spans check -> registration
+        # so the budget cannot be overshot by racing loads. ANY failure
+        # past this point tears the lane down — a half-loaded version
+        # must not leak its batcher worker, exported rows, or breaker.
+        with self._admit_lock:
+            if self._budget > 0:
+                need = self._lane_programs(mv)
+                in_use = self._programs_in_use()
+                if in_use + need > self._budget:
+                    mv.close(drain=False)
+                    raise CompileBudgetExceeded(
+                        "loading %s needs %d compiled programs; %d of "
+                        "MXNET_CACHED_OP_CAPACITY=%d already committed"
+                        % (mv.label, need, in_use, self._budget))
+            try:
+                metrics.bind_profiler()
+                if generator is not None:
+                    gm = getattr(generator, "metrics", None)
+                    if gm is not None:
+                        gm.bind_profiler()   # lane close unbinds
+                if warmup is not None and engine is not None:
+                    engine.warmup(warmup)
+                with self._lock:
+                    if self._closed:
+                        raise ServerClosed("registry is closed")
+                    entry = self._entries.setdefault(model, _Entry(model))
+                    if self._default is None:
+                        self._default = model
+                with entry.lock:
+                    if version in entry.versions:
+                        raise FleetError("%s/%s already loaded"
+                                         % (model, version))
+                    entry.versions[version] = mv
+                    if entry.serving is None:
+                        entry.serving = version
+                        mv.state = "live"
+            except BaseException:
+                mv.close(drain=False)
+                raise
+        with self._lock:
+            self._c["loads"] += 1
+        _trace.instant("fleet.load", model=model, version=version,
+                       state=mv.state)
+        return mv
+
+    def unload(self, model, version, drain=True, timeout=None):
+        """Drain and fully close a non-routed version. The serving or
+        canary version must be promoted away / rolled back first."""
+        entry = self._entry(model)
+        with entry.lock:
+            mv = entry.versions.get(version)
+            if mv is None:
+                raise VersionNotFound("%s/%s not loaded" % (model, version))
+            if version == entry.serving:
+                raise FleetError("%s/%s is serving — promote a replacement "
+                                 "first" % (model, version))
+            if version == entry.canary:
+                raise FleetError("%s/%s is the live canary — rollback or "
+                                 "promote first" % (model, version))
+        self._retire(entry, mv, drain=drain, timeout=timeout)
+        with self._lock:
+            self._c["unloads"] += 1
+        _trace.instant("fleet.unload", model=model, version=version)
+        return mv
+
+    def _retire(self, entry, mv, drain=True, timeout=None):
+        """Drain leases + backlog, close the lane, drop it from routing."""
+        if timeout is None:
+            timeout = _config.get("MXNET_FLEET_DRAIN_TIMEOUT_MS") / 1e3
+        with mv._vlock:
+            mv.state = "draining"   # no new leases from here on
+        mv._wait_idle(timeout)
+        mv.close(drain=drain, timeout=timeout)
+        with mv._vlock:
+            mv.state = "retired"
+        with entry.lock:
+            if entry.versions.get(mv.version) is mv:
+                del entry.versions[mv.version]
+            entry.history.append({"version": mv.version,
+                                  "retired_at": time.time()})
+
+    # ---- promote / canary / rollback --------------------------------------
+    def promote(self, model, version, drain=True, timeout=None):
+        """Atomically flip ``model``'s serving pointer to ``version``
+        (which must already be loaded — and ideally warmed). The flip is
+        one pointer assignment under the entry lock: requests routed
+        before it finish on the outgoing version (leases), requests
+        routed after it run on the incoming one; nothing is dropped. The
+        outgoing version then drains and unloads. A promoted canary
+        graduates (controller detaches)."""
+        entry = self._entry(model)
+        with entry.lock:
+            incoming = entry.versions.get(version)
+            if incoming is None:
+                raise VersionNotFound("%s/%s not loaded" % (model, version))
+            if entry.serving == version:
+                return incoming
+            outgoing = entry.versions.get(entry.serving) \
+                if entry.serving else None
+            previous = entry.serving
+            # ---- the atomic flip ----
+            entry.serving = version
+            incoming.state = "live"
+            incoming._on_outcome = None
+            if entry.canary == version:   # canary graduates
+                entry.canary = None
+                entry.canary_fraction = 0.0
+                entry.controller = None
+            elif entry.controller is not None:
+                # a DIFFERENT version was promoted while a canary is
+                # live: the old baseline is about to retire with a frozen
+                # window — rebase the SLO comparison onto the version
+                # that now actually serves the baseline traffic
+                entry.controller.baseline = incoming
+        with self._lock:
+            self._c["promotes"] += 1
+        _trace.instant("fleet.promote", model=model, version=version,
+                       previous=previous)
+        if outgoing is not None:
+            self._retire(entry, outgoing, drain=drain, timeout=timeout)
+        return incoming
+
+    def start_canary(self, model, version, fraction=None, min_samples=None,
+                     error_rate=None, p99_factor=None):
+        """Route ``fraction`` of ``model``'s traffic (deterministic by
+        request-id hash; default ``MXNET_FLEET_CANARY_FRACTION``) to
+        ``version`` and arm a :class:`CanaryController` against the
+        current serving version. Promote on success, or let the
+        controller roll it back on breach."""
+        entry = self._entry(model)
+        if fraction is None:
+            fraction = _config.get("MXNET_FLEET_CANARY_FRACTION")
+        fraction = float(fraction)
+        if not 0.0 < fraction <= 1.0:
+            raise FleetError("canary fraction %r not in (0, 1]" % fraction)
+        with entry.lock:
+            mv = entry.versions.get(version)
+            if mv is None:
+                raise VersionNotFound("%s/%s not loaded" % (model, version))
+            if entry.serving == version:
+                raise FleetError("%s/%s is already serving" % (model, version))
+            if entry.serving is None:
+                raise FleetError("model %s has no baseline to canary "
+                                 "against" % model)
+            baseline = entry.versions[entry.serving]
+            mv.state = "canary"
+            entry.canary = version
+            entry.canary_fraction = fraction
+            entry.controller = CanaryController(
+                self, model, baseline, mv, min_samples=min_samples,
+                error_rate=error_rate, p99_factor=p99_factor)
+        with self._lock:
+            self._c["canaries"] += 1
+        _trace.instant("fleet.canary", model=model, version=version,
+                       fraction=fraction)
+        return entry.controller
+
+    def rollback(self, model, reason="manual"):
+        """Stop the canary NOW: traffic snaps to 100% baseline, the
+        canary's breaker is tripped open, the lane stays loaded (state
+        ``rolled_back``) for post-mortem. Returns the rolled-back
+        :class:`ModelVersion`, or ``None`` when no canary is live."""
+        entry = self._entry(model)
+        with entry.lock:
+            name = entry.canary
+            if name is None:
+                return None
+            mv = entry.versions[name]
+            entry.canary = None
+            entry.canary_fraction = 0.0
+            controller = entry.controller
+            entry.controller = None
+            mv.state = "rolled_back"
+            mv._on_outcome = None
+            entry.last_rollback = {
+                "version": name, "reason": reason, "at": time.time(),
+                **({k: v for k, v in (controller.decision or {}).items()}
+                   if controller is not None and controller.decision
+                   else {}),
+            }
+        if mv.breaker is not None:
+            mv.breaker.trip()
+        with self._lock:
+            self._c["rollbacks"] += 1
+        _trace.instant("fleet.rollback", model=model, version=name,
+                       reason=reason)
+        return mv
+
+    # ---- routing ----------------------------------------------------------
+    def _entry(self, model):
+        name = model or self._default
+        if name is None:
+            raise ModelNotFound("no default model configured")
+        entry = self._entries.get(name)
+        if entry is None:
+            raise ModelNotFound("model %r not registered" % name)
+        return entry
+
+    @staticmethod
+    def _canary_pick(request_id, fraction):
+        """Deterministic traffic split: the same request id always lands
+        on the same side, so retries and traces stay on one lane."""
+        if fraction <= 0.0:
+            return False
+        h = int(hashlib.sha256(request_id.encode("utf-8")).hexdigest()[:8],
+                16)
+        return (h % 10000) < fraction * 10000.0
+
+    def route(self, model=None, request_id=None):
+        """Resolve (model, request id) → the :class:`ModelVersion` that
+        should serve it: the canary for its hash share of traffic, the
+        serving version otherwise."""
+        entry = self._entry(model)
+        rid = request_id or uuid.uuid4().hex
+        with entry.lock:
+            if entry.canary is not None and \
+                    self._canary_pick(rid, entry.canary_fraction):
+                return entry.versions[entry.canary]
+            if entry.serving is None:
+                raise VersionNotFound("model %s has no live version"
+                                      % entry.name)
+            return entry.versions[entry.serving]
+
+    def predict(self, *inputs, model=None, timeout_ms=None,
+                request_id=None):
+        """Route + lease + execute one request; returns ``(row,
+        version)`` for attribution. Re-routes (bounded) when the routed
+        version starts draining under a concurrent swap — the zero-drop
+        contract. Exceptions carry ``.model_version`` when a lane was
+        reached."""
+        last = None
+        for _ in range(8):
+            mv = self.route(model, request_id)
+            try:
+                with mv.lease():
+                    try:
+                        return mv.predict(*inputs, timeout_ms=timeout_ms,
+                                          request_id=request_id), mv
+                    except Exception as exc:
+                        exc.model_version = mv
+                        raise
+            except StaleVersion as exc:
+                with self._lock:
+                    self._c["reroutes"] += 1
+                last = exc
+        raise ServerClosed("model %r kept draining across re-routes"
+                           % (model or self._default,)) from last
+
+    # ---- observability ----------------------------------------------------
+    @property
+    def default_model(self):
+        return self._default
+
+    def models(self):
+        with self._lock:
+            return sorted(self._entries)
+
+    def healthz(self):
+        """Per-model health lanes for ``/healthz``: each model reports
+        its pointers and every loaded version's lane status; the model's
+        own status is its *serving* lane's — a degraded canary never
+        degrades the model."""
+        out = {}
+        with self._lock:
+            entries = dict(self._entries)
+        for name, entry in entries.items():
+            with entry.lock:
+                serving, canary = entry.serving, entry.canary
+                versions = dict(entry.versions)
+            lanes = {v: mv.health() for v, mv in versions.items()}
+            out[name] = {
+                "serving": serving,
+                "canary": canary,
+                "status": lanes.get(serving, {}).get("status", "degraded"),
+                "lanes": lanes,
+            }
+        return out
+
+    def metrics_snapshot(self):
+        """Per-model × version metrics for ``/metrics``."""
+        out = {}
+        with self._lock:
+            entries = dict(self._entries)
+        for name, entry in entries.items():
+            with entry.lock:
+                serving, canary = entry.serving, entry.canary
+                versions = dict(entry.versions)
+            vs = {}
+            for vname, mv in versions.items():
+                d = {"state": mv.state}
+                if mv.metrics is not None:
+                    d.update(mv.metrics.snapshot())
+                gm = getattr(mv.generator, "metrics", None) \
+                    if mv.generator is not None else None
+                if gm is not None:
+                    d["generation"] = gm.snapshot()
+                vs[vname] = d
+            out[name] = {"serving": serving, "canary": canary,
+                         "versions": vs}
+        return out
+
+    def stats(self):
+        with self._lock:
+            c = dict(self._c)
+            entries = dict(self._entries)
+        models = {}
+        for name, entry in entries.items():
+            with entry.lock:
+                models[name] = {
+                    "serving": entry.serving,
+                    "canary": entry.canary,
+                    "canary_fraction": entry.canary_fraction,
+                    "versions": {v: mv.state
+                                 for v, mv in entry.versions.items()},
+                    "last_rollback": entry.last_rollback,
+                    "history": list(entry.history),
+                }
+        return {"name": self.name, "models": models,
+                "compile_budget": {"budget": self._budget,
+                                   "in_use": self._programs_in_use()},
+                **c}
+
+    # ---- lifecycle --------------------------------------------------------
+    def close(self, drain=True, timeout=None):
+        """Drain and close every lane; the registry stops admitting
+        loads. Idempotent."""
+        with self._lock:
+            self._closed = True
+            entries = dict(self._entries)
+        for entry in entries.values():
+            with entry.lock:
+                versions = list(entry.versions.values())
+                entry.serving = None
+                entry.canary = None
+                entry.controller = None
+            for mv in versions:
+                self._retire(entry, mv, drain=drain, timeout=timeout)
+        _registries.discard(self)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+# ---- profiler export -------------------------------------------------------
+
+_registries = _NamedRegistry()   # live ModelRegistry instances, by name
+
+
+def _profiler_rows():
+    rows = {}
+    for name, st in _registries.map(lambda r: r.stats()).items():
+        for key in ("loads", "unloads", "promotes", "rollbacks",
+                    "canaries", "reroutes"):
+            rows["fleet.%s.%s" % (name, key)] = (st[key], 0.0)
+    return rows
+
+
+_export_rows(_profiler_rows)

@@ -350,7 +350,7 @@ class GenerationMetrics:
             step_time = self._step_time
             prefill_time = self._prefill_time
         prefix = self.name
-        return {
+        rows = {
             prefix + ".requests": (c["requests"], ttft_total),
             prefix + ".tokens": (c["tokens_out"], step_time),
             prefix + ".steps": (c["steps"], step_time),
@@ -359,6 +359,15 @@ class GenerationMetrics:
             prefix + ".expired": (c["expired"], 0.0),
             prefix + ".step_failures": (c["step_failures"], 0.0),
         }
+        if self._queue_depth_fn is not None:
+            # live backlog gauge: the admission-pressure number operators
+            # page on, visible without hitting /metrics
+            try:
+                rows[prefix + ".queue_depth"] = \
+                    (int(self._queue_depth_fn()), 0.0)
+            except Exception:
+                pass
+        return rows
 
     def bind_profiler(self):
         from .. import profiler as _profiler

@@ -14,7 +14,11 @@ Endpoints (JSON):
   ``"dtype"`` (default float32) and ``"timeout_ms"``. Response
   ``{"output": [...]}`` (or ``{"outputs": [...]}``). Typed failures map
   to load-balancer-friendly codes: ServerBusy→503, DeadlineExceeded→504,
-  malformed input→400.
+  malformed input→400, body over ``MXNET_HTTP_MAX_BODY``→413 (consumed
+  first, so keep-alive stays in sync). With a fleet ``registry=``,
+  ``/predict/<model>`` (or a ``"model"`` body field) routes to that
+  model's serving/canary version and the response carries
+  ``X-Model-Version``.
 - ``POST /generate`` — autoregressive generation (requires a
   ``generator=`` :class:`~.generation.GenerationScheduler`): body
   ``{"prompt": [token ids], "max_new_tokens": n, "temperature": t,
@@ -48,19 +52,22 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as _np
 
+from .. import config as _config
 from ..observability import tracer as _trace
 from ..resilience import elastic as _elastic
 from ..resilience import guardrails as _guardrails
 from ..resilience import retry as _retry
-from ..resilience.breaker import CircuitBreaker
+from ..resilience.breaker import CircuitBreaker, CircuitOpen
 from .batcher import (DeadlineExceeded, DynamicBatcher, ServerBusy,
                       ServerClosed, ServingError)
 from .engine import InferenceEngine
+from .fleet import ModelNotFound, StaleVersion, VersionNotFound
 from .metrics import ServingMetrics
 
 __all__ = ["ModelServer"]
@@ -107,6 +114,18 @@ class _Handler(BaseHTTPRequestHandler):
         with _trace.span("serving.http", request_id=rid, path=self.path):
             self._handle_post(rid)
 
+    @staticmethod
+    def _split_model_path(path):
+        """``/predict`` → ``("/predict", None)``; ``/predict/resnet`` →
+        ``("/predict", "resnet")`` (same for ``/generate``) — the fleet's
+        path-segment routing. Unrecognized paths pass through as-is."""
+        for base in ("/predict", "/generate"):
+            if path == base:
+                return base, None
+            if path.startswith(base + "/"):
+                return base, path[len(base) + 1:] or None
+        return path, None
+
     def _handle_post(self, rid):
         srv = self.server.model_server
         # consume the body FIRST: an early reply with the body still unread
@@ -116,16 +135,41 @@ class _Handler(BaseHTTPRequestHandler):
             length = int(self.headers.get("Content-Length", 0))
             if length < 0:  # read(-1) would block until client EOF
                 raise ValueError("negative Content-Length")
-            body = self.rfile.read(length)
         except (ValueError, TypeError):
             self.close_connection = True  # unknown length: can't resync
             self._reply(400, {"error": "bad Content-Length"})
             return
-        if self.path == "/generate":
-            self._handle_generate(rid, srv, body)
+        max_body = _config.get("MXNET_HTTP_MAX_BODY")
+        if max_body > 0 and length > max_body:
+            # client-declared Content-Length is untrusted input: never
+            # buffer an arbitrarily large body. Still CONSUME it (in
+            # bounded chunks) before the 413 so the keep-alive connection
+            # stays in sync for the next request.
+            remaining = length
+            while remaining > 0:
+                chunk = self.rfile.read(min(remaining, 1 << 16))
+                if not chunk:  # client gave up mid-body: can't resync
+                    self.close_connection = True
+                    break
+                remaining -= len(chunk)
+            self._reply(413, {"error": "request body %d bytes exceeds "
+                                       "MXNET_HTTP_MAX_BODY=%d"
+                                       % (length, max_body)})
             return
-        if self.path != "/predict":
+        body = self.rfile.read(length)
+        path, model_name = self._split_model_path(self.path)
+        if path == "/generate":
+            self._handle_generate(rid, srv, body, model_name)
+            return
+        if path != "/predict":
             self._reply(404, {"error": "unknown path %s" % self.path})
+            return
+        if srv.registry is not None:
+            self._handle_fleet_predict(rid, srv, body, model_name)
+            return
+        if model_name is not None:
+            self._reply(404, {"error": "no model registry configured "
+                                       "(single-model server)"})
             return
         if srv.batcher is None:
             self._reply(404, {"error": "no predict model loaded"})
@@ -195,6 +239,77 @@ class _Handler(BaseHTTPRequestHandler):
         else:
             self._reply(200, {"output": _np.asarray(row).tolist()})
 
+    # ---- fleet routing ----------------------------------------------------
+    def _handle_fleet_predict(self, rid, srv, body, model_name):
+        """``/predict`` against a :class:`~.fleet.ModelRegistry`: resolve
+        the model (path segment beats body ``"model"`` field; ``None``
+        routes to the default model for wire back-compat), run the
+        request through that model's bulkhead lane, and echo
+        ``X-Model-Version`` so every response attributes the exact
+        version that produced it."""
+        if srv.draining:
+            self._reply(503, {"error": "server draining"},
+                        headers={"Retry-After": "1"})
+            return
+        try:
+            payload = json.loads(body or b"{}")
+            if model_name is None:
+                model_name = payload.get("model") or None
+            if "inputs" in payload:
+                raw = payload["inputs"]
+            elif "data" in payload:
+                raw = [payload["data"]]
+            else:
+                raise ValueError('body needs "data" or "inputs"')
+            dtype = payload.get("dtype", "float32")
+            inputs = [_np.asarray(x, dtype=dtype) for x in raw]
+            timeout_ms = payload.get("timeout_ms")
+        except (ValueError, TypeError, KeyError, json.JSONDecodeError) as e:
+            self._reply(400, {"error": str(e)})
+            return
+
+        def _ver_headers(exc=None, mv=None, extra=None):
+            mv = mv or getattr(exc, "model_version", None)
+            out = dict(extra or {})
+            if mv is not None:
+                out["X-Model-Version"] = mv.label
+            return out
+
+        try:
+            row, mv = srv.registry.predict(
+                *inputs, model=model_name, timeout_ms=timeout_ms,
+                request_id=rid)
+        except (ModelNotFound, VersionNotFound) as e:
+            self._reply(404, {"error": str(e)})
+            return
+        except CircuitOpen as e:
+            # the LANE's breaker — one bad model fast-fails its own
+            # traffic while every other model keeps serving
+            retry_after = max(1, int(round(e.retry_after_s)))
+            self._reply(503, {"error": str(e)},
+                        headers=_ver_headers(
+                            e, extra={"Retry-After": str(retry_after)}))
+            return
+        except (ServerBusy, ServerClosed) as e:
+            self._reply(503, {"error": str(e)},
+                        headers=_ver_headers(
+                            e, extra={"Retry-After": "1"}))
+            return
+        except DeadlineExceeded as e:
+            self._reply(504, {"error": str(e)}, headers=_ver_headers(e))
+            return
+        except Exception as e:  # noqa: BLE001 — model failure (this lane)
+            self._reply(500, {"error": "%s: %s" % (type(e).__name__, e)},
+                        headers=_ver_headers(e))
+            return
+        headers = _ver_headers(mv=mv)
+        if isinstance(row, tuple):
+            self._reply(200, {"outputs": [_np.asarray(r).tolist()
+                                          for r in row]}, headers=headers)
+        else:
+            self._reply(200, {"output": _np.asarray(row).tolist()},
+                        headers=headers)
+
     # ---- generation (streamed tokens) -------------------------------------
     def _write_chunk(self, payload):
         """One HTTP/1.1 chunk carrying one NDJSON line."""
@@ -204,7 +319,7 @@ class _Handler(BaseHTTPRequestHandler):
         self.wfile.write(b"\r\n")
         self.wfile.flush()
 
-    def _handle_generate(self, rid, srv, body):
+    def _handle_generate(self, rid, srv, body, model_name=None):
         """``POST /generate``: continuous-batched autoregressive decoding
         with tokens streamed back as they are produced.
 
@@ -217,16 +332,80 @@ class _Handler(BaseHTTPRequestHandler):
         ``{"tokens": [...], "reason": ...}`` JSON reply. Typed failures
         map exactly like ``/predict`` (busy→503, queue deadline→504,
         malformed/oversized prompt→400); a fault mid-stream becomes an
-        ``{"error": ...}`` line and the connection closes."""
-        if srv.generator is None:
-            self._reply(404, {"error": "no generation model loaded"})
-            return
+        ``{"error": ...}`` line and the connection closes.
+
+        With a fleet registry, ``/generate/<model>`` (or a ``"model"``
+        body field) routes to that model's serving/canary version; the
+        request holds the version's lease for the WHOLE stream, so a
+        hot-swap drains behind in-flight generations instead of cutting
+        them off, and replies carry ``X-Model-Version``."""
         if srv.draining:
             self._reply(503, {"error": "server draining"},
                         headers={"Retry-After": "1"})
             return
+        # parse ONCE — the fleet's model-field routing and the request
+        # fields below share this dict (bodies run up to
+        # MXNET_HTTP_MAX_BODY; re-parsing long prompts would double the
+        # hot path's parse cost)
         try:
             payload = json.loads(body or b"{}")
+        except (ValueError, TypeError) as e:
+            self._reply(400, {"error": str(e)})
+            return
+        mv = None
+        lease = None
+        if srv.registry is not None:
+            try:
+                payload_model = model_name
+                if payload_model is None and isinstance(payload, dict):
+                    payload_model = payload.get("model") or None
+                for _ in range(8):
+                    mv = srv.registry.route(payload_model, rid)
+                    try:
+                        lease = mv.lease()
+                        lease.__enter__()
+                        break
+                    except StaleVersion:
+                        lease = None
+                else:
+                    self._reply(503, {"error": "model kept draining"},
+                                headers={"Retry-After": "1"})
+                    return
+            except (ModelNotFound, VersionNotFound) as e:
+                self._reply(404, {"error": str(e)})
+                return
+            try:
+                self._generate_on(rid, srv, payload, mv.generator,
+                                  mv.breaker, mv)
+            finally:
+                lease.__exit__(None, None, None)
+            return
+        if model_name is not None:
+            self._reply(404, {"error": "no model registry configured "
+                                       "(single-model server)"})
+            return
+        self._generate_on(rid, srv, payload, srv.generator, srv.breaker,
+                          None)
+
+    def _generate_on(self, rid, srv, payload, generator, breaker, mv):
+        """Run one ``/generate`` against a resolved (generator, breaker)
+        lane; ``mv`` (fleet mode) adds ``X-Model-Version`` attribution
+        and feeds the lane's outcome window (what the canary controller
+        watches)."""
+        extra = {} if mv is None else {"X-Model-Version": mv.label}
+        if generator is None:
+            self._reply(404, {"error": "no generation model loaded"
+                              if mv is None else
+                              "%s has no generation lane" % mv.label},
+                        headers=extra)
+            return
+        t_start = time.monotonic()
+
+        def _outcome(ok):
+            if mv is not None:
+                mv.record_outcome(ok, time.monotonic() - t_start)
+
+        try:
             prompt = payload["prompt"]
             if (not isinstance(prompt, list) or not prompt
                     or not all(isinstance(t, int) for t in prompt)):
@@ -244,37 +423,49 @@ class _Handler(BaseHTTPRequestHandler):
             stream = bool(payload.get("stream", True))
         except (ValueError, TypeError, KeyError,
                 json.JSONDecodeError) as e:
-            self._reply(400, {"error": str(e)})
+            self._reply(400, {"error": str(e)}, headers=extra)
             return
-        breaker = srv.breaker
         admission = breaker.allow() if breaker is not None else True
         if not admission:
             retry_after = max(1, int(round(breaker.retry_after_s())))
             snap = breaker.snapshot()
             self._reply(503, {"error": "circuit open: %s" % snap["state"],
                               "breaker": snap},
-                        headers={"Retry-After": str(retry_after)})
+                        headers={**extra,
+                                 "Retry-After": str(retry_after)})
             return
         try:
-            req = srv.generator.submit(
+            if mv is not None:
+                # canary generation traffic passes the same fleet.rollout
+                # chaos point as predict: injected faults surface as lane
+                # failures below and feed the controller's window
+                mv.rollout_gate()
+            req = generator.submit(
                 prompt, max_new_tokens=max_new, temperature=temperature,
                 eos_id=eos_id, timeout_ms=timeout_ms, request_id=rid)
         except ServerBusy as e:
             if breaker is not None:
                 breaker.release(admission)
             self._reply(503, {"error": str(e)},
-                        headers={"Retry-After": "1"})
+                        headers={**extra, "Retry-After": "1"})
             return
         except ServerClosed as e:
             if breaker is not None:
                 breaker.release(admission)
             self._reply(503, {"error": str(e)},
-                        headers={"Retry-After": "1"})
+                        headers={**extra, "Retry-After": "1"})
             return
         except ServingError as e:  # PromptTooLong / bad request shape
             if breaker is not None:
                 breaker.release(admission)
-            self._reply(400, {"error": str(e)})
+            self._reply(400, {"error": str(e)}, headers=extra)
+            return
+        except Exception as e:  # noqa: BLE001 — injected/submit-time fault
+            if breaker is not None:
+                breaker.record_failure(admission)
+            _outcome(False)
+            self._reply(500, {"error": "%s: %s" % (type(e).__name__, e)},
+                        headers=extra)
             return
         if not stream:
             try:
@@ -282,23 +473,26 @@ class _Handler(BaseHTTPRequestHandler):
             except DeadlineExceeded as e:  # expired in queue: not a fault
                 if breaker is not None:
                     breaker.release(admission)
-                self._reply(504, {"error": str(e)})
+                self._reply(504, {"error": str(e)}, headers=extra)
                 return
             except ServerClosed as e:
                 if breaker is not None:
                     breaker.release(admission)
                 self._reply(503, {"error": str(e)},
-                            headers={"Retry-After": "1"})
+                            headers={**extra, "Retry-After": "1"})
                 return
             except Exception as e:  # noqa: BLE001 — model fault
                 if breaker is not None:
                     breaker.record_failure(admission)
+                _outcome(False)
                 self._reply(500, {"error": "%s: %s"
-                                  % (type(e).__name__, e)})
+                                  % (type(e).__name__, e)}, headers=extra)
                 return
             if breaker is not None:
                 breaker.record_success(admission)
-            self._reply(200, {"tokens": toks, "reason": req.finish_reason})
+            _outcome(True)
+            self._reply(200, {"tokens": toks, "reason": req.finish_reason},
+                        headers=extra)
             return
         # streamed: hold the status line until the FIRST event so
         # pre-first-token failures (queue deadline, drain, prefill fault)
@@ -310,21 +504,25 @@ class _Handler(BaseHTTPRequestHandler):
             if isinstance(val, DeadlineExceeded):
                 if breaker is not None:
                     breaker.release(admission)
-                self._reply(504, {"error": str(val)})
+                self._reply(504, {"error": str(val)}, headers=extra)
             elif isinstance(val, (ServerBusy, ServerClosed)):
                 if breaker is not None:
                     breaker.release(admission)
                 self._reply(503, {"error": str(val)},
-                            headers={"Retry-After": "1"})
+                            headers={**extra, "Retry-After": "1"})
             else:
                 if breaker is not None:
                     breaker.record_failure(admission)
+                _outcome(False)
                 self._reply(500, {"error": "%s: %s"
-                                  % (type(val).__name__, val)})
+                                  % (type(val).__name__, val)},
+                            headers=extra)
             return
         self.send_response(200)
         self.send_header("Content-Type", "application/x-ndjson")
         self.send_header("X-Request-Id", rid)
+        for k, v in extra.items():
+            self.send_header(k, v)
         self.send_header("Transfer-Encoding", "chunked")
         self.end_headers()
         try:
@@ -338,18 +536,21 @@ class _Handler(BaseHTTPRequestHandler):
             self.wfile.write(b"0\r\n\r\n")
             if breaker is not None:
                 breaker.record_success(admission)
+            _outcome(True)
         except Exception as e:  # noqa: BLE001 — fault mid-stream
             # the consumer is gone or broken either way: retire the
             # sequence at the next iteration instead of decoding the rest
             # of its budget into an unread queue
             req.cancel()
-            if breaker is not None:
-                if isinstance(e, (DeadlineExceeded, ServerClosed, OSError)):
-                    # queue expiry / drain / client went away: not a model
-                    # fault — the breaker must not trip
+            if isinstance(e, (DeadlineExceeded, ServerClosed, OSError)):
+                # queue expiry / drain / client went away: not a model
+                # fault — the breaker must not trip
+                if breaker is not None:
                     breaker.release(admission)
-                else:
+            else:
+                if breaker is not None:
                     breaker.record_failure(admission)
+                _outcome(False)
             try:
                 self._write_chunk({"error": "%s: %s"
                                    % (type(e).__name__, e)})
@@ -376,20 +577,43 @@ class ModelServer:
     explicitly. ``retry_policy`` is forwarded to the batcher — the single
     retry layer in this stack; an engine built here gets
     ``retry_policy=False`` (pass a pre-built engine to layer differently).
+
+    ``registry`` (exclusive with ``model``/``generator``) serves a
+    :class:`~.fleet.ModelRegistry` fleet instead: ``/predict`` and
+    ``/generate`` route by model name (path segment or body field,
+    default-model back-compat), each model×version runs in its own
+    bulkhead lane with its own breaker, responses echo
+    ``X-Model-Version``, and ``/healthz`` + ``/metrics`` grow per-model
+    sections. The registry's lanes are drained and closed by
+    :meth:`stop`.
     """
 
-    def __init__(self, model, host="127.0.0.1", port=8080,
+    def __init__(self, model=None, host="127.0.0.1", port=8080,
                  buckets=None, jit=True, max_batch_size=32,
                  max_latency_ms=5.0, max_queue_size=128,
                  default_timeout_ms=None, metrics=None,
                  breaker=None, retry_policy=None,
-                 bind_profiler=True, generator=None):
+                 bind_profiler=True, generator=None, registry=None):
         self.metrics = metrics or ServingMetrics()
         self.generator = generator
-        if model is None:
+        self.registry = registry
+        if registry is not None:
+            # fleet mode: every lane owns its own engine/batcher/breaker;
+            # the server is pure routing + the process-level gauges
+            if model is not None or generator is not None:
+                raise ValueError("pass EITHER registry= OR "
+                                 "model/generator, not both")
+            if breaker is not None:
+                raise ValueError(
+                    "registry= servers take no server-level breaker: "
+                    "each lane owns its own (pass breaker= to "
+                    "ModelRegistry.load)")
+            self.engine = None
+        elif model is None:
             # generation-only server: no /predict path
             if generator is None:
-                raise ValueError("need a model, a generator, or both")
+                raise ValueError(
+                    "need a model, a generator, or a registry")
             self.engine = None
         elif isinstance(model, InferenceEngine):
             self.engine = model
@@ -402,8 +626,9 @@ class ModelServer:
             self.engine = InferenceEngine(
                 model, buckets=buckets or DEFAULT_BUCKETS, jit=jit,
                 metrics=self.metrics, retry_policy=False)
-        if breaker is None:
-            from .. import config as _config
+        if registry is not None:
+            breaker = False   # rejected above unless None: lanes own theirs
+        elif breaker is None:
             threshold = _config.get("MXNET_BREAKER_FAILURE_THRESHOLD")
             breaker = CircuitBreaker(
                 failure_threshold=threshold,
@@ -414,6 +639,11 @@ class ModelServer:
         self.breaker = breaker or None
         if self.breaker is not None:
             self.metrics.set_gauge_fn("breaker", self.breaker.snapshot)
+        if registry is not None:
+            # per-model × version sections on /metrics, plus the fleet's
+            # pointer/rollback ledger
+            self.metrics.set_gauge_fn("models", registry.metrics_snapshot)
+            self.metrics.set_gauge_fn("fleet", registry.stats)
         self.metrics.set_gauge_fn("retry", _retry.all_stats)
         self.metrics.set_gauge_fn("guardrails", _guardrails.all_stats)
         # elastic membership: the LB-visible view of "how many hosts does
@@ -473,6 +703,15 @@ class ModelServer:
             # a pending eviction notice or lost peers: drain THIS instance
             # too — traffic routed to a host mid-eviction is wasted work
             return {"status": "degraded", "elastic": e}
+        if self.registry is not None:
+            # per-model lanes: one degraded model degrades ITS section
+            # only (bulkhead semantics — the LB keys off the lane it
+            # routes to); the process goes degraded only when no model
+            # has a healthy serving lane left
+            models = self.registry.healthz()
+            status = "ok" if not models or any(
+                m["status"] == "ok" for m in models.values()) else "degraded"
+            return {"status": status, "models": models}
         return {"status": "ok"}
 
     @property
@@ -519,6 +758,10 @@ class ModelServer:
             self.generator.close(drain=drain, timeout=timeout)
         if self.batcher is not None:
             self.batcher.close(drain=drain, timeout=timeout)
+        if self.registry is not None:
+            # every lane drains while the listener is still up, so
+            # in-flight responses (streams included) reach their clients
+            self.registry.close(drain=drain, timeout=timeout)
         self._httpd.shutdown()
         self._httpd.server_close()
         if self._thread is not None:

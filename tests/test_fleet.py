@@ -1,0 +1,604 @@
+"""Fleet serving tests — multi-model registry, atomic hot-swap, canary
+auto-rollback, per-model bulkheads (ISSUE 8).
+
+Acceptance criteria covered on the CPU oracle:
+(a) atomic flip: a version swap under concurrent live traffic drops zero
+    requests, compiles nothing beyond the incoming version's prewarmed
+    ladder, and fully closes the retired lane (executor cache emptied,
+    profiler rows unregistered);
+(b) guarded rollout: a canary with 100% injected faults (``fleet.rollout``
+    chaos point) is detected and auto-rolled-back — canary breaker open,
+    canary health lane degraded, baseline lane ``ok`` and unaffected;
+(c) bulkhead isolation: with one model faulting at 100%, every other
+    registered model serves at 100% success and reports ``ok``;
+plus the satellites: checksummed manifests, the shared compile budget,
+``MXNET_HTTP_MAX_BODY`` 413 with keep-alive resync, per-model profiler
+row namespacing, and the generation queue-depth gauge.
+"""
+import http.client
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.cached_op import cache_stats
+from mxnet_tpu.resilience import chaos
+from mxnet_tpu.resilience.breaker import CircuitOpen
+from mxnet_tpu.serving import (ChecksumMismatch, CompileBudgetExceeded,
+                               FleetError, GenerationMetrics, ManifestError,
+                               ModelNotFound, ModelRegistry, ModelServer,
+                               VersionNotFound, verify_manifest,
+                               write_manifest)
+
+D = 4
+
+
+def _times(k):
+    def fn(x):
+        return x * float(k)
+    return fn
+
+
+def _boom(x):
+    raise RuntimeError("model exploded")
+
+
+@pytest.fixture(autouse=True)
+def _disarm_chaos():
+    chaos.clear()
+    yield
+    chaos.clear()
+
+
+def _post_json(url, payload, timeout=10, headers=None):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(), method="POST",
+        headers={"Content-Type": "application/json", **(headers or {})})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.status, json.loads(resp.read()), dict(resp.headers)
+
+
+def _get_json(url, timeout=10):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+# ---------------------------------------------------------------------------
+# checksummed manifests
+# ---------------------------------------------------------------------------
+
+def test_manifest_roundtrip_and_corruption(tmp_path):
+    d = tmp_path / "v1"
+    d.mkdir()
+    (d / "weights.bin").write_bytes(b"\x01\x02\x03" * 100)
+    (d / "symbol.json").write_text('{"nodes": []}')
+    manifest = write_manifest(str(d))
+    assert set(manifest["files"]) == {"weights.bin", "symbol.json"}
+    assert verify_manifest(str(d))["format"] == 1
+    # truncation -> size mismatch, typed
+    (d / "weights.bin").write_bytes(b"\x01\x02\x03")
+    with pytest.raises(ChecksumMismatch):
+        verify_manifest(str(d))
+    # same size, different bytes -> digest mismatch
+    (d / "weights.bin").write_bytes(b"\x09" * 300)
+    with pytest.raises(ChecksumMismatch):
+        verify_manifest(str(d))
+    # missing artifact / missing manifest
+    (d / "weights.bin").unlink()
+    with pytest.raises(ManifestError):
+        verify_manifest(str(d))
+    (d / "manifest.json").unlink()
+    with pytest.raises(ManifestError):
+        verify_manifest(str(d))
+
+
+def test_registry_load_from_verified_artifacts(tmp_path):
+    net = mx.gluon.nn.Dense(2, in_units=D)
+    net.initialize()
+    x = nd.array(np.random.randn(2, D).astype("float32"))
+    ref = net(x).asnumpy()
+    vdir = tmp_path / "dense" / "v1"
+    vdir.mkdir(parents=True)
+    net.export(str(vdir / "model"))
+    write_manifest(str(vdir))
+    with ModelRegistry(name="loadreg") as reg:
+        reg.load("dense", "v1", path=str(vdir), buckets=(2, 4))
+        row, mv = reg.predict(x.asnumpy()[0], model="dense",
+                              request_id="r0")
+        np.testing.assert_allclose(np.asarray(row), ref[0],
+                                   rtol=1e-5, atol=1e-6)
+        assert mv.label == "dense/v1"
+        # corrupt artifact -> typed rejection BEFORE any lane exists
+        params = next(vdir.glob("model-*.params"))
+        params.write_bytes(b"\x00" * params.stat().st_size)
+        with pytest.raises(ChecksumMismatch):
+            reg.load("dense", "v2", path=str(vdir), buckets=(2,))
+
+
+# ---------------------------------------------------------------------------
+# registry basics: routing, namespacing, budget
+# ---------------------------------------------------------------------------
+
+def test_registry_routing_and_defaults():
+    with ModelRegistry(name="basics") as reg:
+        m1 = reg.load("alpha", "v1", source=_times(1), jit=False)
+        reg.load("beta", "v1", source=_times(3), jit=False)
+        assert reg.default_model == "alpha"   # first loaded
+        assert m1.state == "live"             # first version auto-serves
+        row, mv = reg.predict(np.ones(D, "float32"), request_id="a")
+        assert np.asarray(row)[0] == 1.0 and mv.model == "alpha"
+        row, mv = reg.predict(np.ones(D, "float32"), model="beta",
+                              request_id="b")
+        assert np.asarray(row)[0] == 3.0 and mv.model == "beta"
+        with pytest.raises(ModelNotFound):
+            reg.predict(np.ones(D, "float32"), model="nope")
+        with pytest.raises(FleetError):
+            reg.load("alpha", "v1", source=_times(9), jit=False)  # dup
+        with pytest.raises(FleetError):
+            reg.unload("alpha", "v1")   # serving version can't unload
+
+
+def test_per_model_profiler_rows_namespaced():
+    from mxnet_tpu import profiler
+    with ModelRegistry(name="nsreg") as reg:
+        reg.load("nsa", "v1", source=_times(1), jit=False)
+        reg.load("nsb", "v7", source=_times(2), jit=False)
+        reg.predict(np.ones(D, "float32"), model="nsa", request_id="x")
+        reg.predict(np.ones(D, "float32"), model="nsb", request_id="y")
+        rows = profiler.get_aggregate_stats()
+        # two models cannot collide: each version exports its own rows
+        assert rows["serving.nsa.v1.requests"]["calls"] == 1
+        assert rows["serving.nsb.v7.requests"]["calls"] == 1
+        assert "fleet.nsreg.loads" in rows
+    # closing the registry unbinds every lane's provider
+    rows = profiler.get_aggregate_stats()
+    assert "serving.nsa.v1.requests" not in rows
+
+
+def test_generation_metrics_queue_depth_row():
+    gm = GenerationMetrics(name="genq_probe")
+    gm.set_queue_depth_fn(lambda: 7)
+    rows = gm.profiler_rows()
+    assert rows["genq_probe.queue_depth"] == (7, 0.0)
+    assert gm.snapshot()["queue_depth"] == 7
+
+
+def test_compile_budget_admission():
+    with ModelRegistry(name="budget", compile_budget=4) as reg:
+        reg.load("bm", "v1", source=_times(1), buckets=(1, 2, 4))  # 3 rungs
+        with pytest.raises(CompileBudgetExceeded):
+            reg.load("bm", "v2", source=_times(2), buckets=(1, 2))
+        # a ladder that fits the remaining budget is admitted
+        reg.load("bm", "v2", source=_times(2), buckets=(2,))
+        assert reg.stats()["compile_budget"] == {"budget": 4, "in_use": 4}
+
+
+# ---------------------------------------------------------------------------
+# (a) atomic hot-swap under load
+# ---------------------------------------------------------------------------
+
+def test_hot_swap_under_load_zero_drops():
+    """Flip v1 -> v2 while 4 client threads hammer the model: zero failed
+    requests, every result is a valid v1 or v2 output, no compiles beyond
+    the prewarmed ladders, and the retired lane is fully closed."""
+    from mxnet_tpu import profiler
+    buckets = (1, 2, 4)
+    warm = np.zeros((1, D), "float32")
+    reg = ModelRegistry(name="swapreg")
+    mv1 = reg.load("swapm", "v1", source=_times(1), buckets=buckets,
+                   warmup=warm)
+    reg.load("swapm", "v2", source=_times(2), buckets=buckets, warmup=warm)
+    misses_before = cache_stats()["misses"]
+
+    results, errors = [], []
+    stop = threading.Event()
+
+    def client(k):
+        i = 0
+        while not stop.is_set():
+            try:
+                row, mv = reg.predict(np.ones(D, "float32"),
+                                      request_id="c%d-%d" % (k, i))
+                results.append((float(np.asarray(row)[0]), mv.version))
+            except Exception as e:  # noqa: BLE001 — any drop fails the test
+                errors.append(e)
+            i += 1
+
+    threads = [threading.Thread(target=client, args=(k,), daemon=True)
+               for k in range(4)]
+    for t in threads:
+        t.start()
+    time.sleep(0.25)
+    t0 = time.monotonic()
+    reg.promote("swapm", "v2")       # atomic flip + drain v1
+    swap_s = time.monotonic() - t0
+    time.sleep(0.25)
+    stop.set()
+    for t in threads:
+        t.join(10)
+    try:
+        assert not errors, "hot swap dropped %d requests: %r" \
+            % (len(errors), errors[:3])
+        assert results, "clients made no progress"
+        vals = {v for v, _ in results}
+        assert vals <= {1.0, 2.0}, vals
+        # after promote() returned, traffic is exclusively v2
+        row, mv = reg.predict(np.ones(D, "float32"), request_id="post")
+        assert float(np.asarray(row)[0]) == 2.0 and mv.version == "v2"
+        # every result attributed to v1 is a v1 output and vice versa
+        assert all(v == (1.0 if ver == "v1" else 2.0)
+                   for v, ver in results)
+        # both ladders were prewarmed at load: the swap itself compiled
+        # NOTHING (no compile storm under live traffic)
+        assert cache_stats()["misses"] == misses_before
+        # the retired lane is fully closed: executors freed, stats
+        # providers unregistered — no pinning through the exporter
+        assert mv1.state == "retired"
+        assert mv1.engine._op.cache_stats()["size"] == 0
+        rows = profiler.get_aggregate_stats()
+        assert not any(k.startswith("serving.swapm.v1.") for k in rows)
+        assert any(k.startswith("serving.swapm.v2.") for k in rows)
+        assert swap_s < 30.0
+    finally:
+        reg.close()
+
+
+# ---------------------------------------------------------------------------
+# (b) canary rollout + automatic rollback
+# ---------------------------------------------------------------------------
+
+@pytest.mark.chaos
+def test_canary_auto_rollback_on_injected_faults():
+    """Arm ``fleet.rollout`` at 100% on the canary: the controller must
+    detect the error-rate breach, roll back, and trip the canary's
+    breaker, while the baseline lane keeps serving untouched — asserted
+    through the HTTP surface including /healthz lane statuses."""
+    reg = ModelRegistry(name="canreg")
+    reg.load("canm", "v1", source=_times(1), jit=False)
+    reg.load("canm", "v2", source=_times(2), jit=False)
+    controller = reg.start_canary("canm", "v2", fraction=0.5,
+                                  min_samples=4, error_rate=0.25)
+    chaos.arm("fleet.rollout", "fatal", every=1)
+    with ModelServer(registry=reg, port=0) as srv:
+        url = srv.url
+        baseline_ok = canary_errors = 0
+        for i in range(60):
+            try:
+                code, body, hdr = _post_json(
+                    url + "/predict", {"data": [1.0] * D},
+                    headers={"X-Request-Id": "can-%03d" % i})
+                assert code == 200
+                if hdr.get("X-Model-Version") == "canm/v1":
+                    baseline_ok += 1
+            except urllib.error.HTTPError as e:
+                assert e.headers.get("X-Model-Version") == "canm/v2"
+                canary_errors += 1
+            if controller.decision is not None:
+                break
+        # detection -> rollback happened, attributed to the injected
+        # faults (error_rate breach or the breaker they tripped)
+        assert controller.decision is not None, \
+            "no rollback after %d canary errors" % canary_errors
+        assert controller.decision["reason"] in ("error_rate",
+                                                 "breaker_open")
+        assert canary_errors >= 1 and baseline_ok >= 1
+        st = reg.stats()
+        assert st["rollbacks"] == 1
+        assert st["models"]["canm"]["canary"] is None
+        assert st["models"]["canm"]["versions"]["v2"] == "rolled_back"
+        assert st["models"]["canm"]["last_rollback"]["version"] == "v2"
+        # canary breaker tripped open; /healthz: canary lane degraded,
+        # baseline lane (and the model, which keys off its serving lane)
+        # stays ok
+        code, h = _get_json(url + "/healthz")
+        lanes = h["models"]["canm"]["lanes"]
+        assert h["models"]["canm"]["status"] == "ok"
+        assert lanes["v1"]["status"] == "ok"
+        assert lanes["v2"]["status"] == "degraded"
+        assert lanes["v2"]["breaker"]["state"] != "closed"
+        # after rollback EVERY hash lands on the baseline and succeeds —
+        # including ids that previously routed to the canary
+        for i in range(20):
+            code, body, hdr = _post_json(
+                url + "/predict", {"data": [1.0] * D},
+                headers={"X-Request-Id": "can-%03d" % i})
+            assert code == 200
+            assert hdr.get("X-Model-Version") == "canm/v1"
+            assert body["output"][0] == 1.0
+
+
+@pytest.mark.chaos
+def test_canary_rollback_on_latency_slo():
+    """A canary that is merely SLOW (injected latency, zero errors) still
+    breaches: p99 >= factor x baseline p99 rolls it back."""
+    reg = ModelRegistry(name="slowreg")
+    reg.load("slowm", "v1", source=_times(1), jit=False)
+    reg.load("slowm", "v2", source=_times(2), jit=False)
+    controller = reg.start_canary("slowm", "v2", fraction=0.5,
+                                  min_samples=5, p99_factor=2.0)
+    chaos.arm("fleet.rollout", "slow", delay_ms=60, every=1)
+    try:
+        for i in range(80):
+            reg.predict(np.ones(D, "float32"), model="slowm",
+                        request_id="slow-%03d" % i)
+            if controller.decision is not None:
+                break
+        assert controller.decision is not None
+        assert controller.decision["reason"] == "p99"
+        assert controller.decision["canary_p99_ms"] >= \
+            2.0 * controller.decision["baseline_p99_ms"]
+        assert reg.stats()["models"]["slowm"]["versions"]["v2"] \
+            == "rolled_back"
+    finally:
+        reg.close()
+
+
+def test_promote_while_canary_rebases_baseline():
+    """Promoting a THIRD version while a canary is live must rebase the
+    controller's baseline onto the new serving version — not keep judging
+    against the retired lane's frozen window."""
+    with ModelRegistry(name="rebreg") as reg:
+        reg.load("rb", "v1", source=_times(1), jit=False)
+        reg.load("rb", "v2", source=_times(2), jit=False)
+        mv3 = reg.load("rb", "v3", source=_times(3), jit=False)
+        ctl = reg.start_canary("rb", "v2", fraction=0.5, min_samples=5)
+        reg.promote("rb", "v3")
+        assert ctl.baseline is mv3
+        st = reg.stats()["models"]["rb"]
+        assert st["serving"] == "v3" and st["canary"] == "v2"
+
+
+class _StubReq:
+    def __init__(self, toks):
+        self.tokens_out = list(toks)
+        self.finish_reason = "length"
+
+    def result(self, timeout=None):
+        return list(self.tokens_out)
+
+    def cancel(self):
+        pass
+
+
+class _StubGen:
+    """Minimal GenerationScheduler stand-in: enough surface for the
+    non-streamed /generate path without paying an LM compile."""
+    metrics = None
+
+    def submit(self, prompt, **kwargs):
+        return _StubReq([1, 2])
+
+    def close(self, drain=True, timeout=None):
+        pass
+
+
+@pytest.mark.chaos
+def test_fleet_rollout_chaos_reaches_generate_lane():
+    """The fleet.rollout point must fire for canary GENERATION traffic
+    too — injected faults surface as lane errors and drive rollback."""
+    reg = ModelRegistry(name="genchaos")
+    reg.load("gc", "v1", generator=_StubGen())
+    reg.load("gc", "v2", generator=_StubGen())
+    ctl = reg.start_canary("gc", "v2", fraction=1.0, min_samples=3)
+    chaos.arm("fleet.rollout", "fatal", every=1)
+    with ModelServer(registry=reg, port=0) as srv:
+        errors = 0
+        for i in range(20):
+            try:
+                _post_json(srv.url + "/generate/gc",
+                           {"prompt": [1], "stream": False},
+                           headers={"X-Request-Id": "g%02d" % i})
+            except urllib.error.HTTPError as e:
+                assert e.code == 500
+                assert e.headers.get("X-Model-Version") == "gc/v2"
+                errors += 1
+            if ctl.decision is not None:
+                break
+        assert errors >= 1 and ctl.decision is not None
+        # rolled back: every request now lands on the baseline generator
+        code, body, hdr = _post_json(srv.url + "/generate/gc",
+                                     {"prompt": [1], "stream": False})
+        assert code == 200 and body["tokens"] == [1, 2]
+        assert hdr["X-Model-Version"] == "gc/v1"
+
+
+def test_registry_server_rejects_server_level_breaker():
+    with ModelRegistry(name="rejreg") as reg:
+        reg.load("rm", "v1", source=_times(1), jit=False)
+        with pytest.raises(ValueError):
+            ModelServer(registry=reg, port=0, breaker=object())
+
+
+def test_load_failure_tears_lane_down():
+    """A warmup that blows up must not leak the half-built lane (worker
+    thread, profiler rows, breaker registration)."""
+    from mxnet_tpu import profiler
+
+    def bad_warmup_model(x):
+        raise RuntimeError("bad weights at warmup")
+
+    with ModelRegistry(name="leakreg") as reg:
+        with pytest.raises(RuntimeError, match="bad weights"):
+            reg.load("leakm", "v1", source=bad_warmup_model, jit=False,
+                     warmup=np.zeros((1, D), "float32"))
+        assert "leakm" not in reg.healthz() or \
+            not reg.healthz()["leakm"]["lanes"]
+        rows = profiler.get_aggregate_stats()
+        assert not any(k.startswith("serving.leakm.") for k in rows)
+
+
+def test_promoted_canary_graduates():
+    with ModelRegistry(name="gradreg") as reg:
+        reg.load("gm", "v1", source=_times(1), jit=False)
+        reg.load("gm", "v2", source=_times(2), jit=False)
+        reg.start_canary("gm", "v2", fraction=0.5)
+        reg.promote("gm", "v2")
+        st = reg.stats()["models"]["gm"]
+        assert st["serving"] == "v2" and st["canary"] is None
+        assert st["versions"] == {"v2": "live"}   # v1 retired + dropped
+        row, mv = reg.predict(np.ones(D, "float32"), model="gm",
+                              request_id="g")
+        assert float(np.asarray(row)[0]) == 2.0
+
+
+# ---------------------------------------------------------------------------
+# (c) bulkhead isolation
+# ---------------------------------------------------------------------------
+
+def test_bulkhead_isolation_one_model_faulting_100pct():
+    """The faulting model degrades only its own lane: the healthy models
+    keep a 100% success rate and report ok on their health lanes."""
+    with ModelRegistry(name="isoreg") as reg:
+        reg.load("isogood", "v1", source=_times(1), jit=False)
+        reg.load("isoalso", "v1", source=_times(2), jit=False)
+        reg.load("isobad", "v1", source=_boom, jit=False)
+        good = also = bad_failures = 0
+        for i in range(40):
+            row, _ = reg.predict(np.ones(D, "float32"), model="isogood",
+                                 request_id="g%d" % i)
+            good += 1
+            row, _ = reg.predict(np.ones(D, "float32"), model="isoalso",
+                                 request_id="a%d" % i)
+            also += 1
+            try:
+                reg.predict(np.ones(D, "float32"), model="isobad",
+                            request_id="b%d" % i)
+            except (RuntimeError, CircuitOpen):
+                bad_failures += 1
+        assert good == 40 and also == 40       # 100% success, both lanes
+        assert bad_failures == 40              # 100% fault rate observed
+        h = reg.healthz()
+        assert h["isogood"]["status"] == "ok"
+        assert h["isoalso"]["status"] == "ok"
+        assert h["isobad"]["status"] == "degraded"
+        assert h["isobad"]["lanes"]["v1"]["breaker"]["state"] != "closed"
+
+
+# ---------------------------------------------------------------------------
+# HTTP surface
+# ---------------------------------------------------------------------------
+
+def test_fleet_http_routing_and_attribution():
+    reg = ModelRegistry(name="httpreg")
+    reg.load("hm1", "v1", source=_times(1), jit=False)
+    reg.load("hm2", "v3", source=_times(5), jit=False)
+    with ModelServer(registry=reg, port=0) as srv:
+        url = srv.url
+        # default model: the old single-model wire format keeps working
+        code, body, hdr = _post_json(url + "/predict",
+                                     {"data": [1.0] * D})
+        assert code == 200 and body["output"][0] == 1.0
+        assert hdr["X-Model-Version"] == "hm1/v1"
+        # path segment beats body field
+        code, body, hdr = _post_json(url + "/predict/hm2",
+                                     {"data": [1.0] * D})
+        assert code == 200 and body["output"][0] == 5.0
+        assert hdr["X-Model-Version"] == "hm2/v3"
+        code, body, hdr = _post_json(
+            url + "/predict", {"model": "hm2", "data": [1.0] * D})
+        assert body["output"][0] == 5.0
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post_json(url + "/predict/ghost", {"data": [1.0] * D})
+        assert ei.value.code == 404
+        # per-model sections on /healthz and /metrics
+        code, h = _get_json(url + "/healthz")
+        assert h["status"] == "ok"
+        assert set(h["models"]) == {"hm1", "hm2"}
+        assert h["models"]["hm1"]["lanes"]["v1"]["status"] == "ok"
+        code, m = _get_json(url + "/metrics")
+        assert m["models"]["hm2"]["versions"]["v3"]["requests"] >= 2
+        assert m["fleet"]["loads"] == 2
+
+
+def test_http_max_body_413_keeps_connection_in_sync(monkeypatch):
+    monkeypatch.setenv("MXNET_HTTP_MAX_BODY", "1024")
+    reg = ModelRegistry(name="bodyreg")
+    reg.load("bodym", "v1", source=_times(1), jit=False)
+    with ModelServer(registry=reg, port=0) as srv:
+        host, port = srv.address
+        conn = http.client.HTTPConnection(host, port, timeout=10)
+        try:
+            big = json.dumps({"data": [0.0] * 4096}).encode()
+            assert len(big) > 1024
+            conn.request("POST", "/predict", body=big)
+            resp = conn.getresponse()
+            assert resp.status == 413
+            assert b"MXNET_HTTP_MAX_BODY" in resp.read()
+            # the oversized body was consumed: the SAME keep-alive
+            # connection serves the next request (no desync)
+            small = json.dumps({"data": [1.0] * D}).encode()
+            conn.request("POST", "/predict", body=small)
+            resp = conn.getresponse()
+            assert resp.status == 200
+            assert json.loads(resp.read())["output"][0] == 1.0
+        finally:
+            conn.close()
+
+
+def test_http_max_body_default_is_a_few_mb():
+    from mxnet_tpu import config
+    assert config.get("MXNET_HTTP_MAX_BODY") == 8 * 1024 * 1024
+
+
+def test_single_model_server_rejects_model_segment():
+    # a non-fleet server must not silently serve /predict/<model> as if
+    # routing happened
+    with ModelServer(_times(1), port=0, jit=False,
+                     max_latency_ms=1) as srv:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post_json(srv.url + "/predict/other", {"data": [1.0] * D})
+        assert ei.value.code == 404
+
+
+# ---------------------------------------------------------------------------
+# generation lanes in the fleet
+# ---------------------------------------------------------------------------
+
+def test_fleet_generation_routing():
+    from mxnet_tpu.models import transformer_lm_tiny
+    from mxnet_tpu.serving.generation import (DecodeEngine,
+                                              GenerationScheduler)
+    np.random.seed(0)
+    net = transformer_lm_tiny(vocab_size=32)
+    net.initialize(mx.init.Xavier())
+    net(nd.array(np.zeros((1, 8), "int32")))
+    sched = GenerationScheduler(
+        DecodeEngine(net, num_slots=2, max_seq=32, ladder=(8,)))
+    reg = ModelRegistry(name="genreg")
+    reg.load("lm", "v1", generator=sched)
+    reg.load("plain", "v1", source=_times(1), jit=False)
+    # lane metrics renamed into the per-model namespace (no collision)
+    assert sched.metrics.name == "generation.lm.v1"
+    with ModelServer(registry=reg, port=0) as srv:
+        url = srv.url
+        code, body, hdr = _post_json(
+            url + "/generate/lm",
+            {"prompt": [1, 2, 3], "max_new_tokens": 4, "stream": False},
+            timeout=120)
+        assert code == 200
+        assert 1 <= len(body["tokens"]) <= 4
+        assert hdr["X-Model-Version"] == "lm/v1"
+        # a predict-only model has no generation lane
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post_json(url + "/generate/plain", {"prompt": [1, 2]})
+        assert ei.value.code == 404
+        # and the generation lane has no predict path
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post_json(url + "/predict/lm", {"data": [1.0] * D})
+        assert ei.value.code == 404
+        code, m = _get_json(url + "/metrics")
+        gen = m["models"]["lm"]["versions"]["v1"]["generation"]
+        assert gen["requests"] >= 1
+        from mxnet_tpu import profiler
+        rows = profiler.get_aggregate_stats()
+        assert rows["generation.lm.v1.requests"]["calls"] >= 1
+        assert "generation.lm.v1.queue_depth" in rows
+    # server stop closed the registry: the lane's rows are unregistered
+    rows = profiler.get_aggregate_stats()
+    assert "generation.lm.v1.requests" not in rows
