@@ -32,6 +32,7 @@ import jax
 from . import _tape
 from . import config as _config
 from . import random as _random
+from .observability import telemetry as _telemetry
 from .observability import tracer as _trace
 
 __all__ = ["CachedOp", "cache_stats", "reset_cache_stats"]
@@ -106,6 +107,18 @@ class CachedOp:
         out["capacity"] = self._capacity
         return out
 
+    def flops_per_call(self):
+        """Analytic FLOPs of each resident executable, keyed by the full
+        cache signature — input shapes/dtypes AND train mode, since the
+        same shapes compile distinct train/eval executables (XLA cost
+        model, computed at compile time): the per-executable number the
+        MFU accounting multiplies by dispatch count. 0.0 entries mean
+        the backend's cost model was unavailable (MFU is then
+        underreported, never fabricated)."""
+        with self._dispatch_lock:
+            return {"%s|train=%s" % (sig[0], sig[1]): entry[4]
+                    for sig, entry in self._cache.items()}
+
     def clear(self):
         """Drop every compiled executable (the LRU empties; counters
         keep their history). Unloading a served model must free its XLA
@@ -148,12 +161,31 @@ class CachedOp:
             return tuple(o._data for o in outs_t) + tuple(v for _, v in sink)
 
         jitted = jax.jit(pure)
-        # force trace now so n_out is known before first real dispatch
-        jax.eval_shape(jitted, jax.random.PRNGKey(0),
-                       *[jax.ShapeDtypeStruct(a.shape, a._data.dtype)
-                         for a in args])
+        # force trace now so n_out is known before first real dispatch;
+        # with FLOPs accounting on, the forcing trace is lower() instead
+        # of eval_shape() so the analytic FLOPs (XLA cost model, cached
+        # on the cache entry — every dispatch then feeds the process
+        # FlopsMeter at the cost of one float add, the source behind the
+        # live mxtpu_mfu_percent / mxtpu_flops_total series) ride the
+        # SAME trace rather than paying a second one
+        specs = [jax.ShapeDtypeStruct(a.shape, a._data.dtype)
+                 for a in args]
+        flops = 0.0
+        if int(_config.get("MXNET_TELEMETRY_FLOPS") or 0):
+            try:
+                lowered = jitted.lower(jax.random.PRNGKey(0), *specs)
+            except Exception:  # fall back to the plain forcing trace
+                jax.eval_shape(jitted, jax.random.PRNGKey(0), *specs)
+            else:
+                try:
+                    cost = lowered.cost_analysis()
+                    flops = float((cost or {}).get("flops", 0.0) or 0.0)
+                except Exception:  # cost model unavailable on this backend
+                    flops = 0.0
+        else:
+            jax.eval_shape(jitted, jax.random.PRNGKey(0), *specs)
         n_out, multi = n_out_box[0]
-        return jitted, n_out, multi, aux_handles_box[0]
+        return jitted, n_out, multi, aux_handles_box[0], flops
 
     def __call__(self, *args, **kwargs):
         import jax as _jax
@@ -172,6 +204,9 @@ class CachedOp:
             if entry is not None:
                 self._cache.move_to_end(sig)
                 self._stats["hits"] += 1
+                if entry[4]:
+                    self._stats["flops"] = \
+                        self._stats.get("flops", 0.0) + entry[4]
         if entry is None:
             # compile outside the lock (see __init__); the span makes XLA
             # compiles first-class timeline citizens, labeled with the
@@ -193,6 +228,9 @@ class CachedOp:
                     # ours; still a miss (an XLA compile really happened)
                     self._cache.move_to_end(sig)
                 self._stats["misses"] += 1
+                if entry[4]:
+                    self._stats["flops"] = \
+                        self._stats.get("flops", 0.0) + entry[4]
                 if self._capacity > 0:
                     while len(self._cache) > self._capacity:
                         self._cache.popitem(last=False)
@@ -204,7 +242,11 @@ class CachedOp:
         else:
             with _STATS_LOCK:
                 _GLOBAL_STATS["hits"] += 1
-        jitted, n_out, multi, aux_handles = entry
+        # per-op flops already accounted inside the hit/miss critical
+        # sections above — no second lock acquisition on the hot path
+        jitted, n_out, multi, aux_handles, flops = entry
+        if flops:
+            _telemetry.add_flops(flops)
 
         key = _random.next_key()
         vals = [a._data for a in args]

@@ -187,6 +187,32 @@ KNOBS = dict([
     _k("MXNET_TRACE_BUFFER", 65536, int, "wired",
        "span ring-buffer capacity in events — full buffer drops the "
        "OLDEST record, so long runs trace at bounded memory"),
+    _k("MXNET_TRACE_SAMPLE", 0.01, float, "wired",
+       "tail sampler: random fraction of non-error traces kept "
+       "(observability/telemetry.py TailSampler; error/deadline spans "
+       "are always kept)"),
+    _k("MXNET_TRACE_SAMPLE_BUDGET", 10.0, float, "wired",
+       "tail sampler: token-bucket bound on random keeps per second so "
+       "a traffic spike cannot explode the kept set (<=0 = no budget)"),
+    _k("MXNET_TRACE_SLOW_MS", 0.0, float, "wired",
+       "tail sampler: spans at/over this duration are kept like errors "
+       "(latency anomalies; 0 = off)"),
+    _k("MXNET_TELEMETRY_FLOPS", 1, int, "wired",
+       "cache analytic FLOPs per CachedOp executable at compile time "
+       "(XLA cost model) and account them per dispatch — the "
+       "mxtpu_flops_total / mxtpu_mfu_percent source (cached_op.py)"),
+    _k("MXNET_TELEMETRY_PEAK_FLOPS", 0.0, float, "wired",
+       "per-device peak FLOP/s for MFU; 0 = use the built-in "
+       "device-kind table (unknown kinds report no MFU rather than a "
+       "made-up one)"),
+    _k("MXNET_TELEMETRY_WINDOW_S", 60.0, float, "wired",
+       "trailing window for the FLOP/s rate behind mxtpu_mfu_percent"),
+    _k("MXNET_TELEMETRY_HEADROOM_MIN", 0.05, float, "wired",
+       "degrade /healthz when any device's free-HBM fraction drops "
+       "below this — the pre-OOM drain signal (<=0 disables)"),
+    _k("MXNET_ENGINE_BULK_SIZE", 15, int, "wired",
+       "engine bulk-dispatch size set via the C API "
+       "(MXEngineSetBulkSize parity; _c_api_impl.py)"),
     # ---- subsumed by XLA/PJRT --------------------------------------------
     _k("MXNET_EXEC_BULK_EXEC_INFERENCE", 1, int, "subsumed",
        "XLA compiles whole programs; bulking is implicit"),

@@ -125,13 +125,20 @@ def num_gpus():
 
 
 def gpu_memory_info(device_id=0):
+    """(free, total) device bytes — reference ``mx.context
+    .gpu_memory_info`` parity. A failed probe is COUNTED
+    (``telemetry.memory_probe_errors``) and warned once instead of
+    silently reported as ``(0, 0)``: zero capacity is a statement of
+    fact callers size buffers against, not an acceptable error value."""
     d = Context("tpu", device_id).jax_device
     try:
-        stats = d.memory_stats()
+        stats = d.memory_stats() or {}
         total = stats.get("bytes_limit", 0)
         used = stats.get("bytes_in_use", 0)
         return (total - used, total)
-    except Exception:
+    except Exception as exc:
+        from .observability import telemetry as _telemetry
+        _telemetry.note_memory_probe_error(exc, where="gpu_memory_info")
         return (0, 0)
 
 

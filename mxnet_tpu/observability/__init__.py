@@ -33,10 +33,15 @@ path (compute vs. stage-wait vs. queue-wait, overlap efficiency, top-N
 slowest spans).
 """
 from .tracer import (SpanContext, Tracer, attach, clear, complete, counter,
-                     current, disable, enable, enabled, event_count, events,
-                     instant, now, phase_stats, reset_phase_stats, span,
-                     summary_gauge)
+                     current, disable, dropped_spans, enable, enabled,
+                     event_count, events, get_sampler, instant, now,
+                     phase_exemplars, phase_stats, reset_phase_stats,
+                     set_sampler, span, summary_gauge)
 from .export import chrome_trace_events, dump_chrome_trace, to_chrome_trace
+from .telemetry import (FlopsMeter, TailSampler, add_flops, device_memory,
+                        flops_rate, flops_total, install_tail_sampler,
+                        memory_headroom, memory_health, mfu_percent,
+                        peak_flops, serve_metrics, telemetry_gauge)
 
 # NOTE: the process-wide Tracer instance lives at ``tracer.tracer`` (the
 # submodule keeps the name; re-exporting it here would shadow the
@@ -45,5 +50,10 @@ from .export import chrome_trace_events, dump_chrome_trace, to_chrome_trace
 __all__ = ["Tracer", "SpanContext", "span", "instant", "counter",
            "complete", "attach", "current", "enable", "disable", "enabled",
            "clear", "events", "event_count", "now", "phase_stats",
-           "reset_phase_stats", "summary_gauge", "chrome_trace_events",
-           "to_chrome_trace", "dump_chrome_trace"]
+           "reset_phase_stats", "phase_exemplars", "dropped_spans",
+           "set_sampler", "get_sampler", "summary_gauge",
+           "chrome_trace_events", "to_chrome_trace", "dump_chrome_trace",
+           "FlopsMeter", "TailSampler", "add_flops", "device_memory",
+           "flops_rate", "flops_total", "install_tail_sampler",
+           "memory_headroom", "memory_health", "mfu_percent", "peak_flops",
+           "serve_metrics", "telemetry_gauge"]

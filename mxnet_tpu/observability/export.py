@@ -79,20 +79,38 @@ def chrome_trace_events(events, pid=None):
     return meta + out
 
 
-def to_chrome_trace(events, pid=None):
+def to_chrome_trace(events, pid=None, kept_trace_ids=None):
     """The full JSON-object-format document Perfetto/chrome://tracing
-    loads directly."""
-    return {"traceEvents": chrome_trace_events(events, pid=pid),
-            "displayTimeUnit": "ms"}
+    loads directly. ``kept_trace_ids`` (``{trace_id: reason}`` from the
+    tail sampler) rides as a top-level ``keptTraces`` map — extra keys
+    are legal in the object format, tracing UIs ignore them, and
+    ``tools/trace_summary.py`` uses it to flag which slow spans link to
+    a kept exemplar trace."""
+    doc = {"traceEvents": chrome_trace_events(events, pid=pid),
+           "displayTimeUnit": "ms"}
+    if kept_trace_ids:
+        doc["keptTraces"] = {"%x" % tid: reason
+                             for tid, reason in kept_trace_ids.items()}
+    return doc
 
 
-def dump_chrome_trace(path, events=None, pid=None):
+def dump_chrome_trace(path, events=None, pid=None, kept_trace_ids=None):
     """Write the trace document for ``events`` (default: the module
-    tracer's buffer) to ``path``; returns ``path``."""
+    tracer's buffer) to ``path``; returns ``path``. When the process
+    tracer has a tail sampler attached and ``kept_trace_ids`` is not
+    given, its kept set is embedded automatically."""
     if events is None:
         from .tracer import tracer
         events = tracer.events()
-    doc = to_chrome_trace(events, pid=pid)
+    if kept_trace_ids is None:
+        from .tracer import tracer
+        sampler = tracer.get_sampler()
+        if sampler is not None:
+            try:
+                kept_trace_ids = sampler.kept_trace_ids()
+            except Exception:
+                kept_trace_ids = None
+    doc = to_chrome_trace(events, pid=pid, kept_trace_ids=kept_trace_ids)
     with open(path, "w") as f:
         # allow_nan=False: fail loudly if a non-finite ever slips past
         # _json_safe rather than write a file browsers can't parse

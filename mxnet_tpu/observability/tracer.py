@@ -29,12 +29,14 @@ import itertools
 import os
 import threading
 import time
+import warnings
 from collections import deque
 
 __all__ = ["Tracer", "SpanContext", "tracer", "span", "instant", "counter",
            "complete", "attach", "current", "enable", "disable", "enabled",
            "clear", "events", "event_count", "now", "phase_stats",
-           "reset_phase_stats", "summary_gauge"]
+           "reset_phase_stats", "summary_gauge", "phase_exemplars",
+           "dropped_spans", "set_sampler", "get_sampler"]
 
 now = time.monotonic  # the one clock every trace timestamp uses
 
@@ -150,11 +152,13 @@ class _Span:
         parent = self._parent
         th = threading.current_thread()
         dur = t1 - self._t0
-        tr._buf.append(("X", self.name, self._t0, dur,
-                        threading.get_ident(), th.name, self.ctx.span_id,
-                        parent.span_id if parent is not None else 0,
-                        self.ctx.trace_id, self._attrs or None))
-        tr._phase_add(self.name, dur)
+        tr._append(("X", self.name, self._t0, dur,
+                    threading.get_ident(), th.name, self.ctx.span_id,
+                    parent.span_id if parent is not None else 0,
+                    self.ctx.trace_id, self._attrs or None))
+        kept = tr._observe(self.name, dur, self.ctx.trace_id,
+                           parent is None, self._attrs)
+        tr._phase_add(self.name, dur, trace_id=self.ctx.trace_id, kept=kept)
         return False
 
 
@@ -192,7 +196,67 @@ class Tracer:
         self._tls = threading.local()
         self._stat_lock = threading.Lock()
         self._phase = {}  # name -> [count, total_s, max_s, [bucket counts]]
+        # name -> {bucket index: (trace_id, value_ms, kept)} — one exemplar
+        # per histogram bucket, preferring traces the tail sampler KEPT so
+        # the Prometheus exposition links a bad bucket to a readable trace
+        self._exemplars = {}
+        self._dropped = 0        # spans evicted by a full ring
+        self._drop_warned = False
+        self._sampler = None     # optional TailSampler (telemetry.py)
         self.pid = os.getpid()
+
+    def _append(self, rec):
+        """Ring append that accounts for overflow: a full buffer evicts
+        the oldest record — silently losing history is fine (bounded
+        memory is the contract) but UNREPORTED loss is not, so the first
+        drop warns and every drop is counted (``dropped_spans``). The
+        check-and-append runs under ``_stat_lock``: recorders are
+        many-threaded (every HTTP handler records spans) and an unlocked
+        read-modify-write would undercount exactly the loss this counter
+        exists to report."""
+        buf = self._buf
+        warn = False
+        with self._stat_lock:
+            if len(buf) == buf.maxlen:
+                self._dropped += 1
+                if not self._drop_warned:
+                    self._drop_warned = True
+                    warn = True
+            buf.append(rec)
+        if warn:
+            warnings.warn(
+                "trace ring buffer full (capacity=%d): oldest spans are "
+                "being dropped — raise MXNET_TRACE_BUFFER or dump more "
+                "often; drops are counted in trace.dropped_spans "
+                "(warning once)" % buf.maxlen,
+                RuntimeWarning, stacklevel=3)
+
+    def _observe(self, name, dur_s, trace_id, is_root, attrs):
+        """Feed a completed span to the tail sampler (when attached);
+        returns True when the span's trace is kept — the exemplar
+        preference signal."""
+        sampler = self._sampler
+        if sampler is None:
+            return False
+        try:
+            return bool(sampler.observe(name, dur_s, trace_id, is_root,
+                                        attrs))
+        except Exception:  # a broken sampler must never break tracing
+            return False
+
+    def set_sampler(self, sampler):
+        """Attach a tail sampler (``observe(name, dur_s, trace_id,
+        is_root, attrs) -> kept``); ``None`` detaches. The sampler sees
+        every completed span while tracing is enabled."""
+        self._sampler = sampler
+        return self
+
+    def get_sampler(self):
+        return self._sampler
+
+    def dropped_spans(self):
+        """Spans evicted from the ring since the last :meth:`clear`."""
+        return self._dropped
 
     # ---- lifecycle --------------------------------------------------------
     def enabled(self):
@@ -222,7 +286,11 @@ class Tracer:
         return self
 
     def clear(self):
-        self._buf.clear()
+        with self._stat_lock:
+            self._buf.clear()
+            # fresh session restarts drop accounting (and may warn anew)
+            self._dropped = 0
+            self._drop_warned = False
 
     # ---- recording --------------------------------------------------------
     def _stack(self):
@@ -266,10 +334,11 @@ class Tracer:
             th = threading.current_thread()
             tid, tname = threading.get_ident(), th.name
         dur = max(0.0, t1 - t0)
-        self._buf.append(("X", name, t0, dur, tid, tname or "", sid,
-                          parent.span_id if parent is not None else 0,
-                          trace_id, attrs or None))
-        self._phase_add(name, dur)
+        self._append(("X", name, t0, dur, tid, tname or "", sid,
+                      parent.span_id if parent is not None else 0,
+                      trace_id, attrs or None))
+        kept = self._observe(name, dur, trace_id, parent is None, attrs)
+        self._phase_add(name, dur, trace_id=trace_id, kept=kept)
         return SpanContext(trace_id, sid)
 
     def instant(self, name, parent=None, **attrs):
@@ -280,11 +349,11 @@ class Tracer:
         parent = parent if parent is not None else self.current()
         sid = next(self._ids)
         th = threading.current_thread()
-        self._buf.append(("i", name, now(), 0.0, threading.get_ident(),
-                          th.name, sid,
-                          parent.span_id if parent is not None else 0,
-                          parent.trace_id if parent is not None else sid,
-                          attrs or None))
+        self._append(("i", name, now(), 0.0, threading.get_ident(),
+                      th.name, sid,
+                      parent.span_id if parent is not None else 0,
+                      parent.trace_id if parent is not None else sid,
+                      attrs or None))
 
     def counter(self, name, **values):
         """Record a counter sample (numeric kwargs become the tracked
@@ -292,8 +361,8 @@ class Tracer:
         if not self._enabled:
             return
         th = threading.current_thread()
-        self._buf.append(("C", name, now(), 0.0, threading.get_ident(),
-                          th.name, next(self._ids), 0, 0, values or None))
+        self._append(("C", name, now(), 0.0, threading.get_ident(),
+                      th.name, next(self._ids), 0, 0, values or None))
 
     # ---- reading ----------------------------------------------------------
     def events(self):
@@ -304,7 +373,7 @@ class Tracer:
         return len(self._buf)
 
     # ---- per-phase aggregate (the /metrics histogram surface) -------------
-    def _phase_add(self, name, dur_s):
+    def _phase_add(self, name, dur_s, trace_id=None, kept=False):
         with self._stat_lock:
             ent = self._phase.get(name)
             if ent is None:
@@ -314,7 +383,32 @@ class Tracer:
             ent[1] += dur_s
             if dur_s > ent[2]:
                 ent[2] = dur_s
-            ent[3][bisect.bisect_left(_BOUNDS_MS, dur_s * 1e3)] += 1
+            idx = bisect.bisect_left(_BOUNDS_MS, dur_s * 1e3)
+            ent[3][idx] += 1
+            if trace_id is not None:
+                # one exemplar per bucket: a KEPT trace always wins (the
+                # whole point is that the linked trace is retrievable); an
+                # unkept one only fills an empty slot
+                ex = self._exemplars.get(name)
+                if ex is None:
+                    ex = self._exemplars[name] = {}
+                if kept or idx not in ex:
+                    ex[idx] = (trace_id, dur_s * 1e3, kept)
+
+    def phase_exemplars(self):
+        """Per-phase histogram exemplars:
+        ``{name: {bucket_label: {"trace_id", "value_ms", "kept"}}}`` —
+        the trace-id handles the Prometheus exposition attaches to
+        histogram buckets (OpenMetrics exemplar syntax)."""
+        with self._stat_lock:
+            items = {k: dict(v) for k, v in self._exemplars.items()}
+        out = {}
+        for name, ex in items.items():
+            out[name] = {
+                _BUCKET_LABELS[idx]: {"trace_id": "%x" % tid,
+                                      "value_ms": val, "kept": kept}
+                for idx, (tid, val, kept) in ex.items()}
+        return out
 
     def phase_stats(self):
         """Per-span-name latency aggregates derived from the trace stream:
@@ -338,6 +432,7 @@ class Tracer:
     def reset_phase_stats(self):
         with self._stat_lock:
             self._phase.clear()
+            self._exemplars.clear()
 
 
 # ---------------------------------------------------------------------------
@@ -409,13 +504,37 @@ def reset_phase_stats():
     tracer.reset_phase_stats()
 
 
+def phase_exemplars():
+    return tracer.phase_exemplars()
+
+
+def dropped_spans():
+    return tracer.dropped_spans()
+
+
+def set_sampler(sampler):
+    return tracer.set_sampler(sampler)
+
+
+def get_sampler():
+    return tracer.get_sampler()
+
+
 def summary_gauge():
     """One JSON-able gauge for the serving ``/metrics`` endpoint: tracer
     state + the trace-derived per-phase latency histograms."""
-    return {"enabled": tracer.enabled(),
-            "buffered_events": tracer.event_count(),
-            "buffer_capacity": tracer.capacity,
-            "phases": tracer.phase_stats()}
+    out = {"enabled": tracer.enabled(),
+           "buffered_events": tracer.event_count(),
+           "buffer_capacity": tracer.capacity,
+           "dropped_spans": tracer.dropped_spans(),
+           "phases": tracer.phase_stats()}
+    sampler = tracer.get_sampler()
+    if sampler is not None:
+        try:
+            out["sampler"] = sampler.stats()
+        except Exception:
+            pass
+    return out
 
 
 def _configure_from_env():

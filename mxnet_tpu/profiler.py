@@ -396,9 +396,15 @@ class Marker:
 def _trace_phase_rows():
     """Trace-derived per-phase rows for the aggregate table (and thus the
     serving ``/metrics`` stats surface): ``trace.<span name>`` = (span
-    count, total seconds)."""
-    return {"trace." + name: (st["count"], st["total_ms"] / 1e3)
+    count, total seconds), plus the ring's overflow counter — a trace
+    that silently lost its oldest spans must say so next to the spans
+    it kept."""
+    rows = {"trace." + name: (st["count"], st["total_ms"] / 1e3)
             for name, st in _trace.tracer.phase_stats().items()}
+    dropped = _trace.tracer.dropped_spans()
+    if dropped:
+        rows["trace.dropped_spans"] = (dropped, 0.0)
+    return rows
 
 
 register_stats_provider(_trace_phase_rows,

@@ -57,7 +57,8 @@ __all__ = ["Preempted", "CollectiveTimeout", "PreemptionHandler",
            "elastic_fit", "emergency_checkpoint", "guard_collective",
            "install_preemption_handler", "current_handler",
            "preemption_pending", "membership_gauge", "health",
-           "elastic_stats", "EXIT_PREEMPTED", "EXIT_HOST_LOSS"]
+           "elastic_stats", "current_rank", "EXIT_PREEMPTED",
+           "EXIT_HOST_LOSS"]
 
 # a preempted worker's exit code after a successful emergency checkpoint
 # (EX_TEMPFAIL: "try again later" — the supervise loop treats it as an
@@ -523,6 +524,23 @@ def _gauge_snapshot(coord, ttl_s=0.5):
     _snap_cache.clear()  # one live coordinator per process; no leak
     _snap_cache[id(coord)] = (now, snap)
     return snap
+
+
+def current_rank():
+    """This process's elastic rank, or None outside a launched job: the
+    live :class:`ElasticMember`'s rank when one is registered, else the
+    launcher's ``MXTPU_PROCESS_ID`` env. The telemetry exposition stamps
+    it as a ``rank`` label so a fleet-wide scrape stays attributable
+    per worker."""
+    with _gauge_lock:
+        m = _gauge_member() if _gauge_member is not None else None
+    if m is not None:
+        return m.rank
+    raw = os.environ.get("MXTPU_PROCESS_ID", "")
+    try:
+        return int(raw)
+    except (TypeError, ValueError):
+        return None
 
 
 def membership_gauge():

@@ -908,13 +908,18 @@ class ModelRegistry:
         return out
 
     def metrics_snapshot(self):
-        """Per-model × version metrics for ``/metrics``."""
+        """Per-model × version metrics for ``/metrics`` (and the
+        Prometheus per-lane exposition): every version's serving/
+        generation counters plus the routing context an operator needs
+        to read them — canary split fraction and the last rollback."""
         out = {}
         with self._lock:
             entries = dict(self._entries)
         for name, entry in entries.items():
             with entry.lock:
                 serving, canary = entry.serving, entry.canary
+                canary_fraction = entry.canary_fraction
+                last_rollback = entry.last_rollback
                 versions = dict(entry.versions)
             vs = {}
             for vname, mv in versions.items():
@@ -927,6 +932,8 @@ class ModelRegistry:
                     d["generation"] = gm.snapshot()
                 vs[vname] = d
             out[name] = {"serving": serving, "canary": canary,
+                         "canary_fraction": canary_fraction,
+                         "last_rollback": last_rollback,
                          "versions": vs}
         return out
 

@@ -19,7 +19,49 @@ import threading
 import time
 from collections import deque
 
-__all__ = ["ServingMetrics", "GenerationMetrics"]
+__all__ = ["ServingMetrics", "GenerationMetrics", "SERVING_PROM_COUNTERS",
+           "SERVING_PROM_GAUGES", "GENERATION_PROM_COUNTERS",
+           "GENERATION_PROM_GAUGES"]
+
+# Prometheus exposition descriptors (observability/export_prom.py): the
+# snapshot() keys that become counter/gauge families, with their HELP
+# text — kept NEXT to the counters they describe so adding a counter and
+# forgetting its exposition is a one-file diff review, not a hunt.
+SERVING_PROM_COUNTERS = (
+    ("requests", "completed /predict requests (ok + errors)"),
+    ("ok", "requests that returned a model output"),
+    ("errors", "requests that failed in the model/batcher"),
+    ("rejected", "requests shed with ServerBusy backpressure"),
+    ("expired", "requests whose deadline passed while queued"),
+    ("batches", "coalesced batch executions"),
+    ("batched_rows", "rows executed across all batches"),
+    ("worker_errors", "batcher worker deaths (unexpected exceptions)"),
+)
+SERVING_PROM_GAUGES = (
+    ("qps", "completed requests/s over the sliding window"),
+    ("batch_occupancy", "mean rows/capacity per batch"),
+    ("avg_batch_size", "mean rows per coalesced batch"),
+    ("queue_depth", "requests waiting in the batcher queue"),
+)
+GENERATION_PROM_COUNTERS = (
+    ("requests", "retired generation requests (ok + errors)"),
+    ("ok", "generation requests retired cleanly"),
+    ("errors", "generation requests that failed"),
+    ("rejected", "generation requests shed with ServerBusy"),
+    ("expired", "generation requests expired in queue"),
+    ("prefills", "prompt prefill executions"),
+    ("steps", "fused decode iterations"),
+    ("step_failures", "decode iterations that faulted"),
+    ("tokens_out", "tokens emitted across all sequences"),
+    ("retired_eos", "sequences retired on EOS"),
+    ("retired_length", "sequences retired on max_new_tokens"),
+    ("retired_max_seq", "sequences retired on KV-slot capacity"),
+)
+GENERATION_PROM_GAUGES = (
+    ("decode_tokens_s", "fleet decode throughput: tokens/s over step time"),
+    ("avg_step_occupancy", "mean live slots per fused decode step"),
+    ("queue_depth", "generation requests waiting for a slot"),
+)
 
 
 def _percentiles(values, qs=(50, 95, 99), scale=1e3):

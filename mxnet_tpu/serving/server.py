@@ -34,6 +34,10 @@ Endpoints (JSON):
 - ``GET /metrics`` — ``ServingMetrics.snapshot()`` (QPS, latency
   percentiles, occupancy, queue depth, executor-cache counters, retry
   counters, breaker state).
+- ``GET /metrics.prom`` (also ``/metrics?format=prometheus``) — the same
+  sources plus the telemetry plane (device HBM, MFU, trace histograms
+  with kept-trace exemplars) in Prometheus text exposition format,
+  ``mxtpu_*``-named for a standard scrape (see docs/observability.md).
 
 Resilience: model failures feed a
 :class:`~mxnet_tpu.resilience.breaker.CircuitBreaker`; while it is open,
@@ -59,6 +63,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 import numpy as _np
 
 from .. import config as _config
+from ..observability import telemetry as _telemetry
 from ..observability import tracer as _trace
 from ..resilience import elastic as _elastic
 from ..resilience import guardrails as _guardrails
@@ -92,15 +97,36 @@ class _Handler(BaseHTTPRequestHandler):
             self.send_header(k, v)
         self.end_headers()
         self.wfile.write(body)
+        # failure replies mark the request's root span so the tail
+        # sampler keeps the whole trace: 5xx = fault, 504 = deadline —
+        # the spans a bad p99 bucket's exemplar must link to
+        span = getattr(self, "_http_span", None)
+        if span is not None and code >= 500:
+            span.set(error=code)
+
+    def _reply_text(self, code, body, content_type):
+        data = body.encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
 
     def do_GET(self):  # noqa: N802 (http.server API)
         # a keep-alive connection reuses this handler across requests: a
         # GET after a POST must not echo the POST's stale request id
         self._request_id = None
+        self._http_span = None
         srv = self.server.model_server
-        if self.path == "/healthz":
+        path, _, query = self.path.partition("?")
+        if path == "/healthz":
             self._reply(200, srv.health())
-        elif self.path == "/metrics":
+        elif path == "/metrics.prom" or (
+                path == "/metrics" and "format=prometheus" in query):
+            from ..observability import export_prom as _prom
+            self._reply_text(200, _prom.render_server(srv),
+                             _prom.CONTENT_TYPE)
+        elif path == "/metrics":
             self._reply(200, srv.metrics.snapshot())
         else:
             self._reply(404, {"error": "unknown path %s" % self.path})
@@ -111,8 +137,13 @@ class _Handler(BaseHTTPRequestHandler):
         # attached to the request's whole span chain
         rid = self.headers.get("X-Request-Id") or uuid.uuid4().hex[:16]
         self._request_id = rid
-        with _trace.span("serving.http", request_id=rid, path=self.path):
-            self._handle_post(rid)
+        with _trace.span("serving.http", request_id=rid,
+                         path=self.path) as sp:
+            self._http_span = sp
+            try:
+                self._handle_post(rid)
+            finally:
+                self._http_span = None
 
     @staticmethod
     def _split_model_path(path):
@@ -654,6 +685,9 @@ class ModelServer:
         # trace-derived per-phase latency histograms on /metrics: the
         # timeline's aggregate view without parsing the dumped JSON
         self.metrics.set_gauge_fn("trace", _trace.summary_gauge)
+        # device HBM / FLOPs / MFU: the same numbers /metrics.prom
+        # exposes, on the JSON surface
+        self.metrics.set_gauge_fn("telemetry", _telemetry.telemetry_gauge)
         # generation lane: slot-arena occupancy + scheduler state, plus
         # this server's TTFT / tokens-per-slot percentiles when a
         # generator with GenerationMetrics is attached
@@ -683,6 +717,13 @@ class ModelServer:
     def draining(self):
         return self._draining
 
+    def prometheus_text(self):
+        """The ``GET /metrics.prom`` body (Prometheus text format):
+        every stats source this process holds — serving/generation/fleet
+        lanes plus the process-wide telemetry plane."""
+        from ..observability import export_prom as _prom
+        return _prom.render_server(self)
+
     def health(self):
         """The ``/healthz`` payload: ``ok`` | ``degraded`` | ``draining``
         (+ breaker state when degraded) — the drain signal for LBs. A
@@ -698,6 +739,11 @@ class ModelServer:
         g = _guardrails.health()
         if g["status"] != "ok":
             return {"status": "degraded", "guardrails": g}
+        m = _telemetry.memory_health()
+        if m["status"] != "ok":
+            # HBM headroom below the floor: degrade BEFORE the OOM, while
+            # the LB can still drain this host instead of burying it
+            return {"status": "degraded", "memory": m}
         e = _elastic.health()
         if e["status"] != "ok":
             # a pending eviction notice or lost peers: drain THIS instance

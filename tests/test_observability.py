@@ -558,7 +558,8 @@ def test_trace_summary_on_synthetic_trace(tmp_path):
     path = str(tmp_path / "synthetic.json")
     obs_export.dump_chrome_trace(path, tr.events())
 
-    summary = ts.summarize(ts.load_trace(path), top=3)
+    events, kept = ts.load_trace(path)
+    summary = ts.summarize(events, top=3, kept=kept)
     cp = summary["critical_path"]
     assert cp["compute_ms"] == pytest.approx(220.0, rel=0.01)
     assert cp["stage_wait_ms"] == pytest.approx(10.0, rel=0.01)

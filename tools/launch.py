@@ -256,21 +256,37 @@ def _supervise(args, hosts):
     signal.signal(signal.SIGINT, _on_signal)
 
     hosts_pool = list(hosts) if hosts else None
+    agg_server = None
+    aggregator = None
+    if args.telemetry_port is not None:
+        # the job-wide telemetry plane: one merged, rank-labelled
+        # /metrics.prom for however many workers the current generation
+        # has (the loop re-points the targets at every re-form)
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        import telemetry_agg
+        aggregator = telemetry_agg.Aggregator({})
+        agg_server = telemetry_agg.AggServer(
+            aggregator, host="0.0.0.0", port=args.telemetry_port)
+        log.emit("telemetry_agg_started", url=agg_server.url,
+                 metrics_base_port=args.metrics_base_port)
     try:
         return _supervise_loop(args, log, coord_host, hosts_pool, rdzv,
                                world, min_world, max_restarts, backoff_ms,
-                               crashes, fail_start, procs, _teardown)
+                               crashes, fail_start, procs, _teardown,
+                               aggregator)
     finally:
         # any exit path — including an unexpected supervisor error — must
         # sweep the current generation: workers live in their own
         # sessions and would otherwise outlive the supervisor
         if any(p.poll() is None for p in procs.values()):
             _kill_all(procs.values(), grace_s + 5.0)
+        if agg_server is not None:
+            agg_server.close()
 
 
 def _supervise_loop(args, log, coord_host, hosts_pool, rdzv, world,
                     min_world, max_restarts, backoff_ms, crashes,
-                    fail_start, procs, _teardown):
+                    fail_start, procs, _teardown, aggregator=None):
     from mxnet_tpu import config as _config
     from mxnet_tpu.resilience.elastic import ElasticCoordinator
 
@@ -302,7 +318,26 @@ def _supervise_loop(args, log, coord_host, hosts_pool, rdzv, world,
         for rank in range(world):
             env = _rank_env(args, rank, world=world, coordinator=coordinator)
             env.update(extra)
+            if aggregator is not None:
+                # each worker's own scrape port; the worker opts in with
+                # telemetry.serve_metrics() (or any /metrics.prom server).
+                # ssh-launched workers must bind beyond loopback or the
+                # supervisor's cross-host scrape is refused
+                env["MXTPU_METRICS_PORT"] = \
+                    str(args.metrics_base_port + rank)
+                env["MXTPU_METRICS_HOST"] = \
+                    "0.0.0.0" if hosts_pool else "127.0.0.1"
             procs[rank] = _spawn_worker(args, rank, env, hosts_pool)
+        if aggregator is not None:
+            # re-point the merged endpoint at THIS generation's workers
+            # (world may have shrunk; ssh workers scrape on their host)
+            targets = {}
+            for rank in range(world):
+                host = (hosts_pool[rank % len(hosts_pool)].split("@")[-1]
+                        if hosts_pool else "127.0.0.1")
+                targets[rank] = "http://%s:%d" % (
+                    host, args.metrics_base_port + rank)
+            aggregator.set_targets(targets)
         log.emit("generation_start", gen=gen, world=world,
                  coordinator=coordinator)
         failure = None  # (reason, rank, rc)
@@ -454,6 +489,14 @@ def main():
                         "generation (supervise mode)")
     p.add_argument("--event-log", type=str, default=None,
                    help="append supervisor transitions as JSON lines")
+    p.add_argument("--telemetry-port", type=int, default=None,
+                   help="supervise mode: serve ONE merged rank-labelled "
+                        "/metrics.prom for the whole job on this port "
+                        "(scrapes every worker; see tools/telemetry_agg)")
+    p.add_argument("--metrics-base-port", type=int, default=9400,
+                   help="worker metrics ports are base+rank; each worker "
+                        "sees its own as MXTPU_METRICS_PORT (serve it "
+                        "with telemetry.serve_metrics() or a ModelServer)")
     p.add_argument("command", nargs=argparse.REMAINDER)
     args = p.parse_args()
 

@@ -1,0 +1,369 @@
+#!/usr/bin/env python
+"""Fleet-wide Prometheus scrape aggregation.
+
+An elastic multi-host job (``tools/launch.py --supervise``) is N worker
+processes, each exposing its own ``GET /metrics.prom`` (a
+``ModelServer`` or ``mxnet_tpu.observability.telemetry.serve_metrics``).
+This tool scrapes every worker and serves ONE merged, rank-labelled
+endpoint for the whole job — the single target a Prometheus server (or
+a human with curl) points at.
+
+Merging rules:
+
+- the first scraped ``# HELP``/``# TYPE`` for a family wins (every
+  worker runs the same code, so they agree);
+- every sample gains a ``rank="<n>"`` label unless it already carries
+  one (workers that self-label via their elastic rank are left alone);
+- exemplars (``# {...} value`` suffixes) ride along untouched;
+- per-target scrape health is exposed as ``mxtpu_scrape_up{rank=}`` and
+  ``mxtpu_scrape_duration_seconds{rank=}`` so a dead worker is a
+  visible 0, not a silent hole in the dashboard.
+
+Pure stdlib — no mxnet_tpu import — so it runs anywhere, including on a
+monitoring box that never installs jax.
+
+Usage::
+
+    python tools/telemetry_agg.py --port 9500 \
+        --targets 0=http://h0:9400,1=http://h1:9401
+    python tools/telemetry_agg.py --targets host:9400,host:9401 --once
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from collections import OrderedDict
+
+__all__ = ["Aggregator", "merge_expositions", "main"]
+
+
+def _family_of(name, types):
+    """Map a sample's metric name to its family: histogram/summary
+    children (``_bucket``/``_sum``/``_count``) and OpenMetrics counter
+    samples (``_total``, declared without the suffix) belong to the
+    base family their ``# TYPE`` declared."""
+    for suffix in ("_bucket", "_sum", "_count", "_total", "_created"):
+        if name.endswith(suffix) and name[:-len(suffix)] in types:
+            return name[:-len(suffix)]
+    return name
+
+
+def _sample_name(line):
+    """Metric name of a sample line (up to the first ``{`` or space)."""
+    for i, ch in enumerate(line):
+        if ch in "{ ":
+            return line[:i]
+    return line
+
+
+def _inject_label(line, key, value):
+    """Insert ``key="value"`` into a sample line's label set unless the
+    key is already present. Label values may contain escaped quotes and
+    braces, so the closing ``}`` is found by scanning quote state, not
+    by ``rfind`` (an exemplar suffix contains its own ``{...}``)."""
+    name = _sample_name(line)
+    rest = line[len(name):]
+    if not rest.startswith("{"):
+        return '%s{%s="%s"}%s' % (name, key, value, rest)
+    in_quotes = False
+    escaped = False
+    for i in range(1, len(rest)):
+        ch = rest[i]
+        if escaped:
+            escaped = False
+        elif ch == "\\":
+            escaped = True
+        elif ch == '"':
+            in_quotes = not in_quotes
+        elif ch == "}" and not in_quotes:
+            body = rest[1:i]
+            # already rank-labelled (worker self-attribution): leave it
+            if re.search(r'(^|,)%s="' % re.escape(key), body):
+                return line
+            sep = "," if body else ""
+            return "%s{%s%s%s=\"%s\"}%s" % (name, body, sep, key, value,
+                                            rest[i + 1:])
+    return line  # malformed: pass through untouched
+
+
+def merge_expositions(per_rank_texts):
+    """Merge ``{rank: exposition_text}`` into one rank-labelled text.
+    Families keep first-seen order; HELP/TYPE appear once."""
+    helps = {}
+    types = {}
+    samples = OrderedDict()  # family -> [lines]
+
+    def _bucket(family):
+        if family not in samples:
+            samples[family] = []
+        return samples[family]
+
+    for rank, text in per_rank_texts.items():
+        current = None
+        for line in (text or "").splitlines():
+            line = line.rstrip("\n")
+            if not line.strip():
+                continue
+            if line.startswith("# HELP "):
+                name = line.split(None, 3)[2]
+                helps.setdefault(name, line)
+                current = name
+                _bucket(name)
+            elif line.startswith("# TYPE "):
+                parts = line.split(None, 3)
+                name = parts[2]
+                types.setdefault(name, line)
+                current = name
+                _bucket(name)
+            elif line.startswith("#"):
+                continue
+            else:
+                name = _sample_name(line)
+                family = _family_of(name, types)
+                if family != current:
+                    current = family
+                _bucket(family).append(
+                    _inject_label(line, "rank", str(rank)))
+    out = []
+    for family, lines in samples.items():
+        if not lines:
+            continue
+        if family in helps:
+            out.append(helps[family])
+        if family in types:
+            out.append(types[family])
+        out.extend(lines)
+    return "\n".join(out) + "\n" if out else ""
+
+
+class Aggregator:
+    """Scrape a set of rank-addressed worker endpoints and merge.
+
+    ``targets`` is ``{rank: base_url}`` — each worker is scraped at
+    ``<base_url>/metrics.prom``. The set is swappable at runtime
+    (:meth:`set_targets`): the elastic supervisor re-points it at every
+    re-formed generation."""
+
+    def __init__(self, targets=None, timeout_s=2.0):
+        self._lock = threading.Lock()
+        self._targets = dict(targets or {})
+        self.timeout_s = float(timeout_s)
+
+    def set_targets(self, targets):
+        with self._lock:
+            self._targets = dict(targets)
+
+    def targets(self):
+        with self._lock:
+            return dict(self._targets)
+
+    def _fetch(self, url):
+        with urllib.request.urlopen(url + "/metrics.prom",
+                                    timeout=self.timeout_s) as r:
+            return r.read().decode("utf-8")
+
+    def _fan_out(self, fn):
+        """Run ``fn(url)`` against every target concurrently (one thread
+        each — rank counts are small) and return ``{rank: result}``.
+        Serial scraping made the merged endpoint's latency
+        O(dead_workers × timeout): an elastic job mid re-form with a few
+        unreachable hosts would push the AGGREGATOR past the scraper's
+        own deadline and black out telemetry for the healthy workers
+        too. A thread that outlives its timeout counts as down."""
+        results = {}
+        threads = []
+        for rank, url in sorted(self.targets().items()):
+            def _run(rank=rank, url=url):
+                results[rank] = fn(url)
+            t = threading.Thread(target=_run, daemon=True,
+                                 name="telemetry-agg-scrape-%s" % rank)
+            t.start()
+            threads.append(t)
+        deadline = time.monotonic() + self.timeout_s + 1.0
+        for t in threads:
+            t.join(max(0.0, deadline - time.monotonic()))
+        return results
+
+    def scrape(self):
+        """One merged exposition; scrape health rides along."""
+
+        def _one(url):
+            t0 = time.monotonic()
+            try:
+                text, up = self._fetch(url), 1
+            except Exception:
+                text, up = "", 0
+            return text, up, time.monotonic() - t0
+
+        fetched = self._fan_out(_one)
+        ranks = sorted(self.targets())
+        texts = {r: fetched[r][0] if r in fetched else "" for r in ranks}
+        health = {r: fetched[r][1:] if r in fetched
+                  else (0, self.timeout_s) for r in ranks}
+        merged = merge_expositions(texts)
+        lines = ["# HELP mxtpu_scrape_up whether the worker's "
+                 "/metrics.prom scrape succeeded",
+                 "# TYPE mxtpu_scrape_up gauge"]
+        for rank, (up, _) in sorted(health.items()):
+            lines.append('mxtpu_scrape_up{rank="%s"} %d' % (rank, up))
+        lines.append("# HELP mxtpu_scrape_duration_seconds per-worker "
+                     "scrape latency")
+        lines.append("# TYPE mxtpu_scrape_duration_seconds gauge")
+        for rank, (_, dur) in sorted(health.items()):
+            lines.append('mxtpu_scrape_duration_seconds{rank="%s"} %.6f'
+                         % (rank, dur))
+        lines.append("# EOF")
+        return merged + "\n".join(lines) + "\n"
+
+    def health(self):
+        """Per-rank reachability — a lightweight probe of each worker's
+        ``/healthz`` (parallel, and NOT a second full exposition
+        download per health check). A worker answering 503 (degraded)
+        is still ``up``: reachability and health are different facts."""
+
+        def _one(url):
+            try:
+                with urllib.request.urlopen(url + "/healthz",
+                                            timeout=self.timeout_s) as r:
+                    r.read()
+                return "up"
+            except urllib.error.HTTPError:
+                return "up"   # reachable; degraded is the worker's story
+            except Exception:
+                return "down"
+
+        probed = self._fan_out(_one)
+        return {str(rank): probed.get(rank, "down")
+                for rank in sorted(self.targets())}
+
+
+class AggServer:
+    """HTTP front: ``GET /metrics.prom`` scrapes-on-demand and serves
+    the merged text; ``/healthz`` reports per-rank reachability;
+    ``/targets`` the current target map."""
+
+    def __init__(self, aggregator, host="127.0.0.1", port=0):
+        from http.server import (BaseHTTPRequestHandler,
+                                 ThreadingHTTPServer)
+        agg = aggregator
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                pass
+
+            def _send(self, code, body, ctype):
+                data = body.encode("utf-8")
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):  # noqa: N802
+                path = self.path.split("?", 1)[0]
+                if path == "/metrics.prom":
+                    self._send(200, agg.scrape(),
+                               "application/openmetrics-text; "
+                               "version=1.0.0; charset=utf-8")
+                elif path == "/healthz":
+                    h = agg.health()
+                    ok = h and all(v == "up" for v in h.values())
+                    self._send(200 if ok else 503,
+                               json.dumps({"status": "ok" if ok
+                                           else "degraded", "workers": h}),
+                               "application/json")
+                elif path == "/targets":
+                    self._send(200, json.dumps(
+                        {str(k): v for k, v in agg.targets().items()}),
+                        "application/json")
+                else:
+                    self._send(404, json.dumps({"error": "unknown path"}),
+                               "application/json")
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True, name="telemetry-agg")
+        self._thread.start()
+
+    @property
+    def address(self):
+        return self._httpd.server_address[:2]
+
+    @property
+    def port(self):
+        return self.address[1]
+
+    @property
+    def url(self):
+        return "http://%s:%d" % self.address
+
+    def close(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(5.0)
+
+
+def _parse_targets(spec):
+    """``0=http://h:p,1=http://h:p`` (explicit ranks) or ``h:p,h:p``
+    (ranks assigned by position)."""
+    out = {}
+    for i, part in enumerate(p for p in (spec or "").split(",") if p):
+        if "=" in part:
+            rank, url = part.split("=", 1)
+            rank = int(rank)
+        else:
+            rank, url = i, part
+        if "://" not in url:
+            url = "http://" + url
+        out[rank] = url.rstrip("/")
+    return out
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="merge N workers' /metrics.prom into one "
+                    "rank-labelled endpoint")
+    ap.add_argument("--targets", required=True,
+                    help="comma-separated rank=url (or bare host:port, "
+                         "ranks by position)")
+    ap.add_argument("--port", type=int, default=9500,
+                    help="aggregator listen port (default 9500)")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--timeout-ms", type=float, default=2000.0,
+                    help="per-worker scrape timeout")
+    ap.add_argument("--once", action="store_true",
+                    help="scrape once, print the merged text, exit "
+                         "(nonzero when any worker is down)")
+    args = ap.parse_args(argv)
+    targets = _parse_targets(args.targets)
+    if not targets:
+        ap.error("no targets")
+    agg = Aggregator(targets, timeout_s=args.timeout_ms / 1e3)
+    if args.once:
+        text = agg.scrape()
+        sys.stdout.write(text)
+        return 0 if all(v == "up" for v in agg.health().values()) else 1
+    server = AggServer(agg, host=args.host, port=args.port)
+    sys.stderr.write("telemetry_agg: serving merged /metrics.prom on %s "
+                     "for %d workers\n" % (server.url, len(targets)))
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -37,20 +37,59 @@ COMPILE_NAMES = ("cachedop.compile",)
 SERVING_ROOT = "serving.http"
 
 
+class TraceLoadError(Exception):
+    """A trace file that can't be summarized — missing, empty, or not
+    Chrome Trace JSON — with a message naming which."""
+
+
 def load_trace(path):
-    """The ``traceEvents`` list from a Chrome Trace JSON file (object
-    format, or a bare event array)."""
-    with open(path) as f:
-        doc = json.load(f)
-    return doc["traceEvents"] if isinstance(doc, dict) else doc
+    """``(events, kept)`` from a Chrome Trace JSON file (object format,
+    or a bare event array): the ``traceEvents`` list and the
+    ``keptTraces`` map (``{trace_id_hex: reason}``) the tail sampler
+    embedded, empty when absent. Raises :class:`TraceLoadError` with a
+    usable message instead of tracebacking on a missing/empty/corrupt
+    file — ``profiler.dump()`` before any span is recorded writes a
+    valid-but-empty document, and a crashed run can truncate one."""
+    try:
+        with open(path) as f:
+            raw = f.read()
+    except OSError as exc:
+        raise TraceLoadError("cannot read trace file %s: %s"
+                             % (path, exc)) from exc
+    if not raw.strip():
+        raise TraceLoadError(
+            "trace file %s is empty — was the profiler session ever "
+            "started (profiler.set_state('run')) before dump()?" % path)
+    try:
+        doc = json.loads(raw)
+    except ValueError as exc:
+        raise TraceLoadError(
+            "trace file %s is not valid JSON (%s) — a crashed run can "
+            "truncate the dump; re-run profiler.dump()" % (path, exc)) \
+            from exc
+    if isinstance(doc, dict):
+        events = doc.get("traceEvents")
+        if events is None:
+            raise TraceLoadError(
+                "trace file %s has no traceEvents key — not a Chrome "
+                "Trace Event document" % path)
+        return events, dict(doc.get("keptTraces") or {})
+    if not isinstance(doc, list):
+        raise TraceLoadError("trace file %s is neither a Chrome Trace "
+                             "object nor an event array" % path)
+    return doc, {}
 
 
 def _is_span(ev):
     return ev.get("ph") == "X" and "dur" in ev
 
 
-def summarize(events, top=10):
-    """Aggregate a trace into one JSON-able summary dict."""
+def summarize(events, top=10, kept=None):
+    """Aggregate a trace into one JSON-able summary dict. ``kept`` is
+    the sampler's ``{trace_id_hex: reason}`` map — top-N spans whose
+    trace was kept are flagged, because those are the ones a histogram
+    exemplar (or a colleague's trace-id handle) can actually pull up."""
+    kept = kept or {}
     spans = [ev for ev in events if _is_span(ev)]
     instants = [ev for ev in events if ev.get("ph") == "i"]
     threads = {ev["tid"]: ev["args"].get("name", str(ev["tid"]))
@@ -93,6 +132,13 @@ def summarize(events, top=10):
         # fraction of training wall time not stalled on input staging
         overlap_efficiency = max(0.0, 1.0 - stage_wait_ms / compute_ms)
 
+    def _kept_reason(ev):
+        tid = (ev.get("args") or {}).get("trace_id")
+        if tid is None:
+            return None
+        key = "%x" % tid if isinstance(tid, int) else str(tid)
+        return kept.get(key)
+
     slowest = sorted(spans, key=lambda ev: -ev["dur"])[:top]
     top_spans = [{
         "name": ev["name"],
@@ -101,7 +147,15 @@ def summarize(events, top=10):
         "thread": threads.get(ev["tid"], str(ev["tid"])),
         "request_id": (ev.get("args") or {}).get("request_id"),
         "trace_id": (ev.get("args") or {}).get("trace_id"),
+        "kept": _kept_reason(ev),
     } for ev in slowest]
+
+    # the retrievable handles: request ids of kept traces — what you
+    # paste into a bug report next to the exemplar's trace id
+    kept_request_ids = sorted({
+        (ev.get("args") or {}).get("request_id")
+        for ev in spans
+        if _kept_reason(ev) and (ev.get("args") or {}).get("request_id")})
 
     names = {name: {"count": c, "total_ms": t / 1e3, "mean_ms": t / c / 1e3,
                     "max_ms": m / 1e3}
@@ -127,6 +181,8 @@ def summarize(events, top=10):
         "by_name": names,
         "instant_counts": dict(instant_counts),
         "top_spans": top_spans,
+        "kept_traces": len(kept),
+        "kept_request_ids": kept_request_ids,
     }
 
 
@@ -173,8 +229,15 @@ def format_summary(summary):
     for ev in summary["top_spans"]:
         rid = (" request_id=%s" % ev["request_id"]) if ev["request_id"] \
             else ""
-        lines.append("  %10.3f ms  %-28s [%s]%s"
-                     % (ev["dur_ms"], ev["name"], ev["thread"], rid))
+        kept = (" [kept:%s]" % ev["kept"]) if ev.get("kept") else ""
+        lines.append("  %10.3f ms  %-28s [%s]%s%s"
+                     % (ev["dur_ms"], ev["name"], ev["thread"], rid, kept))
+    if summary.get("kept_request_ids"):
+        lines.append("")
+        lines.append("Kept-exemplar request ids (%d kept trace(s)):"
+                     % summary.get("kept_traces", 0))
+        for rid in summary["kept_request_ids"]:
+            lines.append("  %s" % rid)
     return "\n".join(lines)
 
 
@@ -187,7 +250,12 @@ def main(argv=None):
     ap.add_argument("--json", action="store_true",
                     help="emit the summary as JSON instead of text")
     args = ap.parse_args(argv)
-    summary = summarize(load_trace(args.trace), top=args.top)
+    try:
+        events, kept = load_trace(args.trace)
+    except TraceLoadError as exc:
+        print("trace_summary: %s" % exc, file=sys.stderr)
+        return 2
+    summary = summarize(events, top=args.top, kept=kept)
     if args.json:
         print(json.dumps(summary, indent=2))
     else:
