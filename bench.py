@@ -26,6 +26,8 @@ import os
 import sys
 import time
 
+_T0 = time.time()   # cold-start clock: everything after interpreter boot
+
 import numpy as np
 
 BASELINE_IMG_S = 298.51  # reference perf.md:252 (V100, fp32, batch 32)
@@ -140,7 +142,13 @@ def main():
     l = trainer.bench_span(fused, (batch, 3, image, image), 1000,
                            dtype="bfloat16")
     lv = l.asnumpy()  # full host sync
-    log("warmup done in %.1fs, last loss=%.4f" % (time.time() - t0, lv[-1]))
+    # the cold-start trajectory, first-class (ROADMAP item 4): how long
+    # until the FIRST useful step, and how much of that was compile+warm
+    # — the number the persistent compile cache / AOT artifacts attack
+    compile_s = time.time() - t0
+    time_to_first_step_s = time.time() - _T0
+    log("warmup done in %.1fs (%.1fs from process start), last loss=%.4f"
+        % (compile_s, time_to_first_step_s, lv[-1]))
 
     t0 = time.time()
     for _ in range(repeat):
@@ -159,6 +167,8 @@ def main():
         "value": round(img_s, 2),
         "unit": "img/s",
         "vs_baseline": round(img_s / BASELINE_IMG_S, 3),
+        "time_to_first_step_s": round(time_to_first_step_s, 2),
+        "compile_s": round(compile_s, 2),
     }))
 
 

@@ -84,9 +84,13 @@ def bench_continuous(net, workload, slots):
                                 max_queue_size=len(workload))
     try:
         # warm every compile outside the measured window (ladder + decode)
+        # — but record the split: compile_s is the cold-start cost a
+        # restart pays, first-class in the artifact (ROADMAP item 4)
+        t_warm0 = time.perf_counter()
         for rung_prompt in (4, 9, 17):
             sched.submit(list(range(1, rung_prompt + 1)),
                          max_new_tokens=2).result(timeout=600)
+        compile_s = time.perf_counter() - t_warm0
         t0 = time.perf_counter()
         reqs = [sched.submit(p, max_new_tokens=m) for p, m in workload]
         ttfts, n_tokens = [], 0
@@ -97,6 +101,7 @@ def bench_continuous(net, workload, slots):
         wall = time.perf_counter() - t0
         return {
             "tokens": n_tokens,
+            "compile_s": round(compile_s, 3),
             "wall_s": round(wall, 3),
             "tokens_s": round(n_tokens / wall, 2),
             "ttft_ms": {"p50": round(_pct(ttfts, 50) * 1e3, 2),

@@ -30,12 +30,25 @@ from collections import OrderedDict
 import jax
 
 from . import _tape
+from . import aot as _aot
 from . import config as _config
+from . import pcache as _pcache
 from . import random as _random
 from .observability import telemetry as _telemetry
 from .observability import tracer as _trace
 
 __all__ = ["CachedOp", "cache_stats", "reset_cache_stats"]
+
+
+def _np_dtype(name):
+    """dtype-string (as stored in cache signatures) -> numpy dtype,
+    including the ml_dtypes extras ("bfloat16") jax registers."""
+    import numpy as _np
+    try:
+        return _np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+        return _np.dtype(getattr(ml_dtypes, str(name)))
 
 # Process-wide executor-cache counters, aggregated across every CachedOp
 # instance (the serving layer exports these through /metrics). A "miss" is
@@ -89,7 +102,8 @@ class CachedOp:
             capacity = _config.get("MXNET_CACHED_OP_CAPACITY")
         self._capacity = int(capacity)
         self._cache = OrderedDict()
-        self._stats = {"hits": 0, "misses": 0, "evictions": 0}
+        self._stats = {"hits": 0, "misses": 0, "evictions": 0,
+                       "aot_loads": 0}
         # the serving engine dispatches one CachedOp from many HTTP threads:
         # every _cache/_stats mutation happens under this lock. Compiles run
         # OUTSIDE it (an XLA compile can take seconds; serializing compiles
@@ -100,7 +114,9 @@ class CachedOp:
 
     def cache_stats(self):
         """This instance's executor-cache counters plus occupancy:
-        ``{"size", "capacity", "hits", "misses", "evictions"}``."""
+        ``{"size", "capacity", "hits", "misses", "evictions",
+        "aot_loads"}`` — ``aot_loads`` counts executables installed
+        from serialized artifacts (zero XLA compiles)."""
         with self._dispatch_lock:
             out = dict(self._stats)
             out["size"] = len(self._cache)
@@ -131,12 +147,15 @@ class CachedOp:
         return (tuple((a.shape, str(a.dtype)) for a in args),
                 _tape.is_training())
 
-    def _compile(self, args):
+    def _make_pure(self, train):
+        """The jit-able pure wrapper over ``self._fn`` at an explicit
+        train mode (dispatch passes the current mode; serialize/
+        deserialize pass the mode stored in the cache signature).
+        Returns ``(pure, n_out_box, aux_handles_box)`` — the boxes fill
+        on first trace."""
         from .ndarray.ndarray import NDArray
         fn = self._fn
-        train = _tape.is_training()
         n_out_box = []
-
         aux_handles_box = []
 
         def pure(rng_key, *vals):
@@ -160,6 +179,11 @@ class CachedOp:
             # aux writes (e.g. BatchNorm moving stats) ride as extra outputs
             return tuple(o._data for o in outs_t) + tuple(v for _, v in sink)
 
+        return pure, n_out_box, aux_handles_box
+
+    def _compile(self, args):
+        train = _tape.is_training()
+        pure, n_out_box, aux_handles_box = self._make_pure(train)
         jitted = jax.jit(pure)
         # force trace now so n_out is known before first real dispatch;
         # with FLOPs accounting on, the forcing trace is lower() instead
@@ -185,7 +209,81 @@ class CachedOp:
         else:
             jax.eval_shape(jitted, jax.random.PRNGKey(0), *specs)
         n_out, multi = n_out_box[0]
-        return jitted, n_out, multi, aux_handles_box[0], flops
+        return jitted, n_out, multi, aux_handles_box[0], flops, False
+
+    # ---- AOT export / load (cold-start: compile in CI, ship bytes) --------
+    def _specs_for(self, sig):
+        shapes, _ = sig
+        return [jax.ShapeDtypeStruct(tuple(shape), _np_dtype(dtype))
+                for shape, dtype in shapes]
+
+    def serialize(self):
+        """Capture every resident executable's *program* as
+        PJRT-serialized bytes: a list of records for
+        :func:`mxnet_tpu.aot.write_artifact`, keyed by the exact cache
+        signature (shapes, dtypes, train mode) each was compiled under.
+
+        Export re-lowers and compiles each signature through the jax AOT
+        API (the traced-dispatch path's executable isn't directly
+        extractable), so exporting costs one compile per signature —
+        that is the point: the export runs ONCE in CI, and every serving
+        restart after it compiles nothing. With the persistent compile
+        cache enabled the re-compile here is itself a disk hit."""
+        with self._dispatch_lock:
+            sigs = [(sig, entry[4]) for sig, entry in self._cache.items()]
+        records = []
+        for sig, flops in sigs:
+            train = sig[1]
+            pure, _n_out_box, _aux_box = self._make_pure(train)
+            compiled = jax.jit(pure).lower(
+                jax.random.PRNGKey(0), *self._specs_for(sig)).compile()
+            blob, in_tree, out_tree = _aot.serialize_compiled(compiled)
+            records.append({"signature": sig, "train": train,
+                            "flops": flops, "blob": blob,
+                            "in_tree": in_tree, "out_tree": out_tree})
+        return records
+
+    def deserialize(self, records):
+        """Install serialized executables (``mxnet_tpu.aot`` records)
+        into the cache WITHOUT compiling: each record's program loads as
+        machine code, and an abstract ``eval_shape`` trace (pure Python,
+        no XLA) recovers the output arity and aux-state handles the
+        dispatch path needs. Returns the number of executables
+        installed; raises :class:`~mxnet_tpu.aot.ArtifactError` on a
+        corrupt record — fingerprint gating belongs to the caller
+        (``InferenceEngine.load_artifacts``), which turns it into a
+        warn-once fallback instead of a crash."""
+        loaded = 0
+        evicted = 0
+        for rec in records:
+            sig = rec["signature"]
+            train = bool(sig[1])
+            specs = self._specs_for(sig)
+            pure, n_out_box, aux_handles_box = self._make_pure(train)
+            jitted = jax.jit(pure)
+            jax.eval_shape(jitted, jax.random.PRNGKey(0), *specs)
+            n_out, multi = n_out_box[0]
+            exe = _aot.deserialize_compiled(rec["blob"], rec["in_tree"],
+                                            rec["out_tree"])
+            entry = (exe, n_out, multi, aux_handles_box[0],
+                     float(rec.get("flops") or 0.0), True)
+            with self._dispatch_lock:
+                self._cache[sig] = entry
+                self._cache.move_to_end(sig)
+                self._stats["aot_loads"] = \
+                    self._stats.get("aot_loads", 0) + 1
+                if self._capacity > 0:
+                    while len(self._cache) > self._capacity:
+                        self._cache.popitem(last=False)
+                        evicted += 1
+                        self._stats["evictions"] += 1
+            loaded += 1
+        if evicted:
+            with _STATS_LOCK:
+                _GLOBAL_STATS["evictions"] += evicted
+        if loaded:
+            _pcache.note_aot_load(loaded)
+        return loaded
 
     def __call__(self, *args, **kwargs):
         import jax as _jax
@@ -199,9 +297,16 @@ class CachedOp:
         if any(isinstance(a._data, _jax.core.Tracer) for a in args):
             return self._fn(*args)
         sig = self._signature(args)
+        recording = _tape.is_recording()
         with self._dispatch_lock:
             entry = self._cache.get(sig)
-            if entry is not None:
+            if entry is not None and entry[5] and recording:
+                # an AOT-loaded executable is machine code — it can't be
+                # retraced for the autograd tape. Recording dispatch of
+                # an AOT entry recompiles fresh (counted as the miss it
+                # is) and replaces the entry; serving never records.
+                entry = None
+            elif entry is not None:
                 self._cache.move_to_end(sig)
                 self._stats["hits"] += 1
                 if entry[4]:
@@ -220,8 +325,9 @@ class CachedOp:
             evicted = 0
             with self._dispatch_lock:
                 entry = self._cache.get(sig)
-                if entry is None:
-                    # we won (or were alone): publish our executable
+                if entry is None or (entry[5] and recording):
+                    # we won (or were alone, or are replacing an AOT
+                    # entry with a traceable one): publish our executable
                     self._cache[sig] = entry = compiled
                 else:
                     # a racing thread published first — use theirs, drop
@@ -244,13 +350,39 @@ class CachedOp:
                 _GLOBAL_STATS["hits"] += 1
         # per-op flops already accounted inside the hit/miss critical
         # sections above — no second lock acquisition on the hot path
-        jitted, n_out, multi, aux_handles, flops = entry
+        jitted, n_out, multi, aux_handles, flops, aot = entry
         if flops:
             _telemetry.add_flops(flops)
 
         key = _random.next_key()
         vals = [a._data for a in args]
-        out_vals = jitted(key, *vals)
+        try:
+            out_vals = jitted(key, *vals)
+        except Exception as exc:  # noqa: BLE001 — AOT aval drift only
+            if not aot:
+                raise
+            # a loaded executable refused these exact arguments (aval
+            # drift the shape/dtype signature can't see, or a backend
+            # that rejected the deserialized program at dispatch):
+            # recompile fresh ONCE, replace the entry, and count the
+            # fallback — a shipped artifact must degrade to a compile,
+            # never to a serving error
+            _pcache.note_aot_fallback(
+                "%s: %s" % (type(exc).__name__, exc),
+                where="CachedOp(%s)" % self._name)
+            with _trace.span("cachedop.compile", op=self._name,
+                             bucket=(args[0].shape[0]
+                                     if args and args[0].shape else None),
+                             signature=str(sig[0])):
+                entry = self._compile(args)
+            with self._dispatch_lock:
+                self._cache[sig] = entry
+                self._cache.move_to_end(sig)
+                self._stats["misses"] += 1
+            with _STATS_LOCK:
+                _GLOBAL_STATS["misses"] += 1
+            jitted, n_out, multi, aux_handles, flops, aot = entry
+            out_vals = jitted(key, *vals)
         for h, v in zip(aux_handles, out_vals[n_out:]):
             h._data = v
         out_vals = out_vals[:n_out]

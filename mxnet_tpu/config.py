@@ -213,6 +213,23 @@ KNOBS = dict([
     _k("MXNET_ENGINE_BULK_SIZE", 15, int, "wired",
        "engine bulk-dispatch size set via the C API "
        "(MXEngineSetBulkSize parity; _c_api_impl.py)"),
+    _k("MXNET_COMPILE_CACHE_DIR", "", str, "wired",
+       "persistent XLA compilation cache directory (pcache.py, "
+       "initialized at import): recompiles of previously seen programs "
+       "become disk reads across process restarts; empty = off"),
+    _k("MXNET_COMPILE_CACHE_MIN_COMPILE_SECS", 0.0, float, "wired",
+       "only persist compiles at least this slow (0 = everything — "
+       "jax's 1.0s default would skip the small serving-ladder rungs "
+       "cold restarts stall on)"),
+    _k("MXNET_COMPILE_CACHE_MIN_ENTRY_BYTES", 0, int, "wired",
+       "size floor per persistent-cache entry in bytes (0 = none)"),
+    _k("MXNET_COMPILE_CACHE_TTL_DAYS", 0.0, float, "wired",
+       "age out persistent-cache entries older than this at init "
+       "(newest of write/last-use time; 0 = keep forever)"),
+    _k("MXNET_WARMUP_THREADS", 4, int, "wired",
+       "InferenceEngine warmup/prewarm compile concurrency: bucket "
+       "rungs compile on a thread pool this wide (<=1 = serial; "
+       "compiles already run outside CachedOp's dispatch lock)"),
     # ---- subsumed by XLA/PJRT --------------------------------------------
     _k("MXNET_EXEC_BULK_EXEC_INFERENCE", 1, int, "subsumed",
        "XLA compiles whole programs; bulking is implicit"),

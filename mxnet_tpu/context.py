@@ -147,3 +147,15 @@ def current_context() -> Context:
     if stack:
         return stack[-1]
     return Context("cpu", 0) if num_tpus() == 0 else Context("tpu", 0)
+
+
+# Persistent XLA compile cache (ROADMAP item 4): initialized ONCE at
+# import — this module is the first device-touching import every
+# ``import mxnet_tpu`` performs, so the cache directory is configured
+# before any program can compile. With ``MXNET_COMPILE_CACHE_DIR`` set,
+# a restarted process re-reads previously compiled programs off disk
+# instead of paying XLA again; unset, this only registers the (zeroed)
+# ``cachedop.pcache.*`` telemetry. Never raises (see pcache.py).
+from . import pcache as _pcache  # noqa: E402  (import-time init by design)
+
+_pcache.init_from_env()

@@ -245,6 +245,31 @@ def _render_cachedop(w):
                   "compiles)" % key, stats.get(key, 0))
 
 
+def _render_pcache(w):
+    from .. import pcache as _pcache
+    st = _pcache.stats()
+    w.gauge("mxtpu_pcache_enabled",
+            "1 while the persistent XLA compile cache is wired to a "
+            "directory (MXNET_COMPILE_CACHE_DIR)", st["enabled"])
+    for key, help_text in (
+            ("disk_hits", "compiles served from the persistent cache "
+                          "(disk read instead of an XLA run)"),
+            ("disk_misses", "persistent-cache lookups that fell through "
+                            "to a real XLA compile"),
+            ("requests", "compile requests that consulted the "
+                         "persistent cache"),
+            ("ttl_evictions", "persistent-cache entries aged out by the "
+                              "TTL sweep at init")):
+        w.counter("mxtpu_pcache_%s_total" % key, help_text, st[key])
+    w.counter("mxtpu_aot_loads_total",
+              "executables installed from serialized AOT artifacts "
+              "(zero XLA compiles each)", st["aot_loads"])
+    w.counter("mxtpu_aot_fallbacks_total",
+              "AOT artifact loads refused (fingerprint mismatch, ladder "
+              "drift, corrupt blob) that fell back to normal compiles",
+              st["aot_fallbacks"])
+
+
 def _render_trace(w):
     tr = _tracer.tracer
     w.counter("mxtpu_trace_dropped_spans_total",
@@ -414,6 +439,7 @@ def render_process(extra=None):
     _render_telemetry(w)
     _render_trace(w)
     _render_cachedop(w)
+    _render_pcache(w)
     _render_elastic(w)
     _render_aggregate_rows(w)
     if extra is not None:

@@ -1,0 +1,261 @@
+"""Persistent XLA compilation cache: recompiles become disk reads.
+
+Layer (1) of the cold-start work (ROADMAP item 4): JAX ships a
+content-addressed persistent compilation cache — every compiled module is
+keyed by a hash of its HLO + compile options + backend and written under
+a directory, so a process restart that compiles a previously seen
+program reads machine code off disk instead of running XLA for seconds.
+It is off by default; this module wires it to the ``MXNET_*`` knob
+surface and makes its effectiveness *observable*:
+
+- ``MXNET_COMPILE_CACHE_DIR``       — enable, rooted here ("" = off)
+- ``MXNET_COMPILE_CACHE_MIN_COMPILE_SECS`` — only persist compiles at
+  least this slow (0 = everything; jax's default 1.0 would skip exactly
+  the small serving-ladder rungs restarts stall on)
+- ``MXNET_COMPILE_CACHE_MIN_ENTRY_BYTES``  — size floor per entry
+- ``MXNET_COMPILE_CACHE_TTL_DAYS``  — age out entries at init (0 = keep)
+
+:func:`init` is called once at import (from ``mxnet_tpu.context``) and is
+idempotent; it also registers a ``jax.monitoring`` listener so disk hits
+and misses are counted process-wide and exported as
+``cachedop.pcache.*`` profiler rows and ``mxtpu_pcache_*`` Prometheus
+families. The AOT fallback counters (layer 2, ``cached_op.py`` /
+``serving/engine.py``) live here too so every cold-start surface reads
+from one ledger.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+import warnings
+
+__all__ = ["init", "init_from_env", "enabled", "cache_dir", "stats",
+           "reset_stats", "note_aot_load", "note_aot_fallback",
+           "sweep_ttl"]
+
+_lock = threading.Lock()
+_state = {"initialized": False, "enabled": False, "dir": None,
+          "listener_registered": False, "rows_registered": False}
+_counters = {
+    "disk_hits": 0,        # persistent-cache reads that replaced a compile
+    "disk_misses": 0,      # lookups that fell through to a real XLA run
+    "requests": 0,         # compile requests that consulted the cache
+    "ttl_evictions": 0,    # entries aged out by the TTL sweep at init
+    "aot_loads": 0,        # executables installed from AOT artifacts
+    "aot_fallbacks": 0,    # AOT loads refused (fingerprint/corrupt) ->
+                           # normal compile path taken instead
+}
+_fallback_warned = False
+
+_EVENT_MAP = {
+    "/jax/compilation_cache/cache_hits": "disk_hits",
+    "/jax/compilation_cache/cache_misses": "disk_misses",
+    "/jax/compilation_cache/compile_requests_use_cache": "requests",
+}
+
+
+def _cfg(name):
+    from . import config as _config
+    return _config.get(name)
+
+
+def _on_jax_event(event, **kwargs):
+    key = _EVENT_MAP.get(event)
+    if key is not None:
+        with _lock:
+            _counters[key] += 1
+
+
+def _register_listener():
+    if _state["listener_registered"]:
+        return
+    try:
+        from jax._src import monitoring as _monitoring
+        _monitoring.register_event_listener(_on_jax_event)
+        _state["listener_registered"] = True
+    except Exception:  # noqa: BLE001 — private API moved: counters stay 0
+        pass
+
+
+def _register_rows():
+    if _state["rows_registered"]:
+        return
+    try:
+        from . import profiler as _profiler
+        _profiler.register_stats_provider(_rows)
+        _state["rows_registered"] = True
+    except Exception:  # noqa: BLE001 — profiler unavailable at early import
+        pass
+
+
+def sweep_ttl(directory, ttl_days):
+    """Unlink persistent-cache entries older than ``ttl_days`` (by the
+    newest of the entry's ``-cache``/``-atime`` file mtimes, so a
+    recently *used* entry survives even when it was written long ago).
+    Returns the eviction count. Best-effort: a cache dir shared with a
+    concurrently starting process may race unlinks."""
+    if ttl_days <= 0:
+        return 0
+    cutoff = time.time() - ttl_days * 86400.0
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return 0
+    newest = {}
+    for n in names:
+        for suffix in ("-cache", "-atime"):
+            if n.endswith(suffix):
+                stem = n[:-len(suffix)]
+                try:
+                    mtime = os.path.getmtime(os.path.join(directory, n))
+                except OSError:
+                    continue
+                newest[stem] = max(newest.get(stem, 0.0), mtime)
+    evicted = 0
+    for stem, mtime in newest.items():
+        if mtime >= cutoff:
+            continue
+        removed = False
+        for suffix in ("-cache", "-atime"):
+            try:
+                os.unlink(os.path.join(directory, stem + suffix))
+                removed = True
+            except OSError:
+                pass
+        if removed:
+            evicted += 1
+    if evicted:
+        with _lock:
+            _counters["ttl_evictions"] += evicted
+    return evicted
+
+
+def init(cache_dir=None, min_entry_bytes=None, min_compile_secs=None,
+         ttl_days=None, force=False):
+    """Point jax's persistent compilation cache at ``cache_dir`` (default
+    ``MXNET_COMPILE_CACHE_DIR``) and hook the hit/miss telemetry.
+    Idempotent unless ``force``; a falsy directory leaves the cache off
+    but still registers the counters (rows read 0, scrapes stay shaped).
+    Returns the active cache directory or ``None``."""
+    if _state["initialized"] and not force:
+        return _state["dir"] if _state["enabled"] else None
+    _state["initialized"] = True
+    _register_listener()
+    _register_rows()
+    directory = cache_dir if cache_dir is not None \
+        else _cfg("MXNET_COMPILE_CACHE_DIR")
+    if not directory:
+        _state["enabled"] = False
+        _state["dir"] = None
+        return None
+    directory = os.path.abspath(os.path.expanduser(str(directory)))
+    os.makedirs(directory, exist_ok=True)
+    ttl = float(ttl_days if ttl_days is not None
+                else _cfg("MXNET_COMPILE_CACHE_TTL_DAYS"))
+    sweep_ttl(directory, ttl)
+    import jax
+    jax.config.update("jax_compilation_cache_dir", directory)
+    jax.config.update("jax_enable_compilation_cache", True)
+    min_secs = float(min_compile_secs if min_compile_secs is not None
+                     else _cfg("MXNET_COMPILE_CACHE_MIN_COMPILE_SECS"))
+    min_bytes = int(min_entry_bytes if min_entry_bytes is not None
+                    else _cfg("MXNET_COMPILE_CACHE_MIN_ENTRY_BYTES"))
+    # knob names moved across jax versions; set what this one has
+    for opt, value in (
+            ("jax_persistent_cache_min_compile_time_secs", min_secs),
+            ("jax_persistent_cache_min_entry_size_bytes", min_bytes)):
+        try:
+            jax.config.update(opt, value)
+        except (AttributeError, KeyError):
+            pass
+    _state["enabled"] = True
+    _state["dir"] = directory
+    return directory
+
+
+def init_from_env():
+    """Import-time entry point (``mxnet_tpu.context``): never raises — a
+    bad cache dir must not take the whole import down, it just warns and
+    leaves compiles uncached."""
+    try:
+        return init()
+    except Exception as exc:  # noqa: BLE001 — import path must survive
+        warnings.warn(
+            "persistent compile cache init failed (%s: %s) — compiles "
+            "will not be cached across restarts"
+            % (type(exc).__name__, exc), RuntimeWarning, stacklevel=2)
+        _state["enabled"] = False
+        return None
+
+
+def enabled():
+    return _state["enabled"]
+
+
+def cache_dir():
+    return _state["dir"] if _state["enabled"] else None
+
+
+# ---------------------------------------------------------------------------
+# AOT ledger (layer 2 counts here so one place owns cold-start telemetry)
+# ---------------------------------------------------------------------------
+
+def note_aot_load(n=1):
+    """Count ``n`` executables installed from an AOT artifact."""
+    with _lock:
+        _counters["aot_loads"] += int(n)
+
+
+def note_aot_fallback(reason, where="aot", warn=True):
+    """Count one refused AOT load (fingerprint mismatch, corrupt blob,
+    ladder drift) that fell back to a normal compile. Warns ONCE per
+    process — a fleet restart across N lanes must not emit N screens of
+    the same diagnosis — but every occurrence lands in the
+    ``cachedop.pcache.fallback`` row."""
+    global _fallback_warned
+    with _lock:
+        _counters["aot_fallbacks"] += 1
+        first = not _fallback_warned
+        _fallback_warned = True
+    if warn and first:
+        warnings.warn(
+            "AOT executable artifact not loadable in %s (%s) — falling "
+            "back to fresh XLA compiles; re-export artifacts on this "
+            "topology/jax version (warning once; every fallback is "
+            "counted in cachedop.pcache.fallback)" % (where, reason),
+            RuntimeWarning, stacklevel=3)
+
+
+def stats():
+    """Snapshot: ``{"enabled", "dir", "disk_hits", "disk_misses",
+    "requests", "ttl_evictions", "aot_loads", "aot_fallbacks"}``."""
+    with _lock:
+        out = dict(_counters)
+    out["enabled"] = _state["enabled"]
+    out["dir"] = _state["dir"]
+    return out
+
+
+def reset_stats():
+    """Zero the counters (tests); the enabled/dir state is untouched."""
+    global _fallback_warned
+    with _lock:
+        for k in _counters:
+            _counters[k] = 0
+        _fallback_warned = False
+
+
+def _rows():
+    """Profiler aggregate-table rows: the cold-start ledger visible in
+    ``profiler.dumps()`` and ``/metrics`` without a Prometheus scrape."""
+    with _lock:
+        c = dict(_counters)
+    return {
+        "cachedop.pcache.hits": (c["disk_hits"], 0.0),
+        "cachedop.pcache.misses": (c["disk_misses"], 0.0),
+        "cachedop.pcache.requests": (c["requests"], 0.0),
+        "cachedop.pcache.ttl_evictions": (c["ttl_evictions"], 0.0),
+        "cachedop.pcache.fallback": (c["aot_fallbacks"], 0.0),
+        "cachedop.aot.loads": (c["aot_loads"], 0.0),
+    }

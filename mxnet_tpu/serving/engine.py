@@ -23,10 +23,15 @@ through bucket padding.
 from __future__ import annotations
 
 import bisect
+import json
+import os
 import threading
 
 import numpy as _np
 
+from .. import aot as _aot
+from .. import config as _config
+from .. import pcache as _pcache
 from ..cached_op import CachedOp
 from ..ndarray import ndarray as _nd
 from ..observability import tracer as _trace
@@ -86,6 +91,14 @@ class InferenceEngine:
         self._jit = bool(jit)
         self._lock = threading.Lock()
         self._buckets_seen = set()
+        # live traffic ledger: per bucket, how often it was hit and the
+        # exact padded signature it runs under — the source of the
+        # warmup manifest a restart replays in frequency order
+        self._traffic = {}
+        self._prewarm = {"status": "idle", "completed": 0, "total": 0,
+                         "error": None}
+        self._prewarm_thread = None
+        self._prewarm_stop = False
         if jit:
             def _fn(*args):
                 out = model(*args)
@@ -142,6 +155,14 @@ class InferenceEngine:
                                      dtype=a.dtype)
                     a = _nd.concat(a, fill, dim=0)
                 padded.append(a)
+            with self._lock:
+                rec = self._traffic.get(bucket)
+                if rec is None:
+                    self._traffic[bucket] = rec = {
+                        "count": 0,
+                        "shapes": [tuple(a.shape) for a in padded],
+                        "dtypes": [str(a.dtype) for a in padded]}
+                rec["count"] += 1
             if self._op is not None:
                 out = self._op(*padded)
             else:
@@ -184,19 +205,274 @@ class InferenceEngine:
         return self.predict(*inputs)
 
     # ---- warmup & stats ---------------------------------------------------
-    def warmup(self, example, dtype=None):
+    def warmup(self, example, dtype=None, threads=None):
         """Eagerly compile every bucket at load time so first-request
         latency never pays an XLA compile. ``example`` is one input (or a
         tuple of inputs, for multi-input models) whose trailing (non-batch)
-        dims and dtypes are representative; its batch size is ignored."""
+        dims and dtypes are representative; its batch size is ignored.
+
+        Rungs compile on a thread pool ``threads`` wide (default
+        ``MXNET_WARMUP_THREADS``; <= 1 is serial) — each bucket is a
+        distinct CachedOp signature and compiles run outside the
+        dispatch lock, so N rungs genuinely compile concurrently and
+        cold warmup wall-clock drops to roughly the slowest rung on
+        multi-core hosts. With AOT artifacts already loaded
+        (:meth:`load_artifacts`) warmup compiles nothing — every rung is
+        a cache hit that just touches the device once."""
         examples = example if isinstance(example, (list, tuple)) \
             else (example,)
         arrays = [_as_ndarray(x, dtype=dtype) for x in examples]
-        for bucket in self._buckets:
-            batch = [_nd.zeros((bucket,) + tuple(a.shape[1:]),
-                               dtype=a.dtype) for a in arrays]
-            self._run_bucketed(batch)
+        batches = [[_nd.zeros((bucket,) + tuple(a.shape[1:]),
+                              dtype=a.dtype) for a in arrays]
+                   for bucket in self._buckets]
+        self._run_many(batches, threads=threads)
         return self
+
+    def _run_many(self, batches, threads=None):
+        """Dispatch ``batches`` (each a list of per-input NDArrays)
+        through the bucketed path, on a pool when ``threads`` (default
+        ``MXNET_WARMUP_THREADS``) allows. The first failure propagates
+        after the remaining dispatches finish."""
+        if threads is None:
+            threads = _config.get("MXNET_WARMUP_THREADS")
+        threads = min(int(threads), len(batches))
+        if threads <= 1 or len(batches) <= 1:
+            for batch in batches:
+                self._run_bucketed(batch)
+            return
+        from concurrent.futures import ThreadPoolExecutor
+        with ThreadPoolExecutor(max_workers=threads,
+                                thread_name_prefix=self._name + "-warmup") \
+                as pool:
+            futures = [pool.submit(self._run_bucketed, b) for b in batches]
+        for f in futures:
+            f.result()
+
+    # ---- AOT artifacts (compile in CI, ship with the checkpoint) ----------
+    def export_artifacts(self, directory, include_warmup=True):
+        """Write this engine's compiled ladder as AOT artifacts into
+        ``directory``: ``executables.mxa`` (every resident executable as
+        PJRT-serialized machine code, via :meth:`CachedOp.serialize
+        <mxnet_tpu.cached_op.CachedOp.serialize>`) plus — when traffic
+        or warmup has been observed — the ``warmup.json`` replay
+        manifest (:meth:`write_warmup_manifest`). Run :meth:`warmup`
+        (or real traffic) first so the ladder is resident; run it in CI
+        so serving restarts compile **nothing**. Returns the artifact
+        header dict."""
+        if self._op is None:
+            raise ValueError("jit=False engine has no executables to "
+                             "export")
+        records = self._op.serialize()
+        if not records:
+            raise _aot.ArtifactError(
+                "no compiled executables to export — call warmup() or "
+                "serve traffic before export_artifacts()")
+        os.makedirs(directory, exist_ok=True)
+        header = _aot.write_artifact(
+            os.path.join(directory, _aot.ARTIFACT_NAME), records,
+            extra={"name": self._name, "buckets": list(self._buckets)})
+        if include_warmup:
+            manifest = self.warmup_manifest()
+            if manifest["traffic"]:
+                self.write_warmup_manifest(
+                    os.path.join(directory, _aot.WARMUP_NAME))
+        return header
+
+    def load_artifacts(self, directory, strict=False):
+        """Install AOT executables exported by :meth:`export_artifacts`
+        into this engine's CachedOp — zero XLA compiles for every loaded
+        signature. ``directory`` may also be the artifact file itself.
+
+        The load is gated on :func:`mxnet_tpu.aot.fingerprint_matches`:
+        an artifact exported on a different jax/jaxlib version, backend
+        platform, device kind, or device count is machine code for some
+        other process — it is *skipped* with a warn-once
+        (``cachedop.pcache.fallback`` row) and the engine compiles
+        normally, never crashes. Records whose bucket is not on this
+        engine's ladder (ladder drift since export) are skipped the same
+        way. A corrupt or truncated artifact raises a typed
+        :class:`~mxnet_tpu.aot.ArtifactError` (``strict=False`` demotes
+        PJRT-level load failures — structurally valid bytes the backend
+        refuses — to the fallback path too). Returns the number of
+        executables installed."""
+        if self._op is None:
+            return 0
+        path = directory
+        if os.path.isdir(directory):
+            path = os.path.join(directory, _aot.ARTIFACT_NAME)
+        header = _aot.read_artifact_header(path)   # typed on corrupt
+        fp = header.get("fingerprint")
+        if not _aot.fingerprint_matches(fp):
+            _pcache.note_aot_fallback(
+                "fingerprint mismatch: %s"
+                % "; ".join(_aot.fingerprint_diff(fp)),
+                where="InferenceEngine(%s)" % self._name)
+            return 0
+        header, records = _aot.read_artifact(path)
+        ladder = set(self._buckets)
+        usable, skipped = [], 0
+        for rec in records:
+            shapes, _train = rec["signature"]
+            bucket = shapes[0][0][0] if shapes and shapes[0][0] else None
+            if bucket in ladder:
+                usable.append(rec)
+            else:
+                skipped += 1
+        if not usable:
+            _pcache.note_aot_fallback(
+                "bucket ladder drift: artifact covers %s, engine ladder "
+                "is %s" % (header.get("extra", {}).get("buckets"),
+                           list(self._buckets)),
+                where="InferenceEngine(%s)" % self._name)
+            return 0
+        try:
+            loaded = self._op.deserialize(usable)
+        except _aot.ArtifactError as exc:
+            if strict:
+                raise
+            _pcache.note_aot_fallback(str(exc),
+                                      where="InferenceEngine(%s)"
+                                      % self._name)
+            return 0
+        if skipped:
+            _pcache.note_aot_fallback(
+                "%d of %d artifact executables off the current ladder %s"
+                % (skipped, len(records), list(self._buckets)),
+                where="InferenceEngine(%s)" % self._name)
+        return loaded
+
+    # ---- trace-driven prewarm ---------------------------------------------
+    def warmup_manifest(self):
+        """The live traffic set as a replayable manifest: per bucket, the
+        exact padded signature it runs under and how often it was hit,
+        hottest first — what :meth:`prewarm` replays on the next restart
+        so the rungs real traffic needs most are ready first."""
+        with self._lock:
+            traffic = {b: dict(rec) for b, rec in self._traffic.items()}
+        entries = [{"bucket": int(b),
+                    "count": int(rec["count"]),
+                    "shapes": [list(s) for s in rec["shapes"]],
+                    "dtypes": list(rec["dtypes"])}
+                   for b, rec in traffic.items()]
+        entries.sort(key=lambda e: (-e["count"], e["bucket"]))
+        return {"format": 1, "name": self._name,
+                "buckets": list(self._buckets), "traffic": entries}
+
+    def write_warmup_manifest(self, path):
+        """Persist :meth:`warmup_manifest` as JSON (atomic tmp+rename —
+        the artifact-publish idiom). Returns the manifest dict."""
+        manifest = self.warmup_manifest()
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(manifest, f, indent=2, sort_keys=True)
+            f.flush()
+            os.fsync(f.fileno())
+        os.rename(tmp, path)
+        return manifest
+
+    def prewarm(self, manifest=None, directory=None, background=False,
+                threads=None):
+        """Replay a warmup manifest: dispatch one zero-filled batch per
+        recorded bucket, **in traffic-frequency order**, so the hottest
+        rungs are ready first. ``manifest`` is the dict from
+        :meth:`warmup_manifest` (or a path to its JSON); ``directory``
+        reads ``warmup.json`` from an artifact directory instead.
+
+        ``background=True`` runs the replay on a daemon thread and
+        returns immediately — the restart pattern: load AOT artifacts
+        (instant), start serving, and let prewarm touch the rungs while
+        requests already flow; a request that beats prewarm to a rung
+        simply pays that rung's compile (or AOT/pcache hit) itself.
+        Progress is visible in :meth:`prewarm_status`.
+
+        ``threads`` (default ``MXNET_WARMUP_THREADS``; <= 1 is serial)
+        replays on a pool, same as :meth:`warmup` — submission stays in
+        traffic-frequency order, so the hottest rungs still start (and
+        near-always finish) first while a cold replay's wall-clock drops
+        to roughly the slowest rung. Returns self."""
+        if manifest is None and directory is not None:
+            manifest = os.path.join(directory, _aot.WARMUP_NAME)
+        if isinstance(manifest, str):
+            with open(manifest) as f:
+                manifest = json.load(f)
+        if not isinstance(manifest, dict) or \
+                not isinstance(manifest.get("traffic"), list):
+            raise ValueError("not a warmup manifest: need a "
+                             "{'traffic': [...]} dict (engine."
+                             "warmup_manifest() / warmup.json)")
+        entries = sorted(manifest["traffic"],
+                         key=lambda e: (-int(e.get("count", 0)),
+                                        int(e.get("bucket", 0))))
+        batches = []
+        for e in entries:
+            try:
+                batches.append([
+                    _nd.zeros(tuple(int(d) for d in shape), dtype=dtype)
+                    for shape, dtype in zip(e["shapes"], e["dtypes"])])
+            except (KeyError, TypeError, ValueError) as exc:
+                raise ValueError("malformed warmup manifest entry %r: %s"
+                                 % (e, exc)) from exc
+        with self._lock:
+            if self._prewarm_thread is not None and \
+                    self._prewarm_thread.is_alive():
+                raise RuntimeError("prewarm already running")
+            self._prewarm_stop = False
+            self._prewarm = {"status": "running", "completed": 0,
+                             "total": len(batches), "error": None}
+
+        n = threads if threads is not None \
+            else _config.get("MXNET_WARMUP_THREADS")
+        n = min(int(n), len(batches))
+
+        def _one(batch):
+            # the stop flag short-circuits queued work on close(); a
+            # dispatch already in flight finishes (XLA compiles are not
+            # interruptible) but nothing new starts
+            if self._prewarm_stop:
+                return False
+            self._run_bucketed(batch)
+            with self._lock:
+                self._prewarm["completed"] += 1
+            return True
+
+        def _replay():
+            try:
+                if n <= 1 or len(batches) <= 1:
+                    finished = all(_one(b) for b in batches)
+                else:
+                    from concurrent.futures import ThreadPoolExecutor
+                    with ThreadPoolExecutor(
+                            max_workers=n,
+                            thread_name_prefix=self._name + "-prewarm") \
+                            as pool:
+                        futures = [pool.submit(_one, b) for b in batches]
+                    finished = all(f.result() for f in futures)
+                with self._lock:
+                    self._prewarm["status"] = "done" if finished \
+                        else "stopped"
+            except Exception as exc:  # noqa: BLE001 — surfaced in status
+                with self._lock:
+                    self._prewarm["status"] = "error"
+                    self._prewarm["error"] = "%s: %s" \
+                        % (type(exc).__name__, exc)
+                if not background:
+                    raise
+
+        if background:
+            t = threading.Thread(target=_replay, daemon=True,
+                                 name=self._name + "-prewarm")
+            with self._lock:
+                self._prewarm_thread = t
+            t.start()
+        else:
+            _replay()
+        return self
+
+    def prewarm_status(self):
+        """``{"status": "idle|running|done|error", "completed",
+        "total", "error"}`` — the background replay's progress."""
+        with self._lock:
+            return dict(self._prewarm)
 
     def close(self):
         """Release the executor cache: every compiled bucket program is
@@ -204,7 +480,13 @@ class InferenceEngine:
         instead of pinning them for the process lifetime. The engine
         stays callable (programs recompile on demand) — ``close()`` is a
         resource release, not a poison pill, so a drain that races one
-        last request cannot turn it into an error. Idempotent."""
+        last request cannot turn it into an error. A running background
+        prewarm is stopped first so a retiring lane doesn't recompile
+        the rungs it is releasing. Idempotent."""
+        self._prewarm_stop = True
+        t = self._prewarm_thread
+        if t is not None and t.is_alive():
+            t.join(timeout=5.0)
         if self._op is not None:
             self._op.clear()
 
@@ -214,7 +496,9 @@ class InferenceEngine:
         (``compiles`` == misses == XLA compiles issued)."""
         with self._lock:
             seen = sorted(self._buckets_seen)
-        out = {"buckets": list(self._buckets), "buckets_seen": seen}
+            prewarm = dict(self._prewarm)
+        out = {"buckets": list(self._buckets), "buckets_seen": seen,
+               "prewarm": prewarm}
         if self._op is not None:
             cs = self._op.cache_stats()
             out.update(cs)

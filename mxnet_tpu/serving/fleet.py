@@ -58,6 +58,7 @@ import uuid
 from collections import deque
 from contextlib import contextmanager
 
+from .. import aot as _aot
 from .. import config as _config
 from ..observability import tracer as _trace
 from ..resilience import chaos as _chaos
@@ -143,6 +144,25 @@ def write_manifest(version_dir, extra=None):
     if not files:
         raise ManifestError("no artifact files under %s" % version_dir)
     manifest = {"format": 1, "files": files}
+    # AOT executables ride the manifest first-class: the section records
+    # what the blob is FOR (fingerprint, ladder, entry count) so a
+    # loader — or `tools/prewarm.py --check` in CI — can decide
+    # loadability from the manifest alone, and the artifact's own sha256
+    # is repeated here so the section and the file table cannot drift
+    # apart unnoticed. Publishing a corrupt artifact fails HERE (typed
+    # ArtifactError), not on some later restart.
+    if _aot.ARTIFACT_NAME in files:
+        header = _aot.read_artifact_header(
+            os.path.join(version_dir, _aot.ARTIFACT_NAME))
+        manifest["executables"] = {
+            "artifact": _aot.ARTIFACT_NAME,
+            "sha256": files[_aot.ARTIFACT_NAME]["sha256"],
+            "fingerprint": header["fingerprint"],
+            "count": len(header["entries"]),
+            "buckets": header.get("extra", {}).get("buckets"),
+            "warmup": (_aot.WARMUP_NAME
+                       if _aot.WARMUP_NAME in files else None),
+        }
     if extra:
         manifest.update(extra)
     tmp = os.path.join(version_dir, MANIFEST_NAME + ".tmp")
@@ -187,6 +207,23 @@ def verify_manifest(version_dir):
             raise ChecksumMismatch(
                 "artifact %s sha256 %s != manifest %s (corrupt or "
                 "tampered)" % (rel, digest[:12], str(meta.get("sha256"))[:12]))
+    exe = manifest.get("executables")
+    if exe is not None:
+        # validate the AOT container NOW — a truncated or corrupt blob
+        # must fail manifest verify with a typed ArtifactError, never
+        # surface as a confusing PJRT failure on the first live request
+        rel = exe.get("artifact") or _aot.ARTIFACT_NAME
+        if rel not in files:
+            raise ManifestError(
+                "manifest declares executables %r but the file table "
+                "doesn't list it" % rel)
+        if exe.get("sha256") != files[rel].get("sha256"):
+            raise ChecksumMismatch(
+                "executables section sha256 %s != file table %s — "
+                "manifest internally inconsistent"
+                % (str(exe.get("sha256"))[:12],
+                   str(files[rel].get("sha256"))[:12]))
+        _aot.read_artifact_header(os.path.join(version_dir, rel))
     return manifest
 
 
@@ -560,10 +597,11 @@ class ModelRegistry:
     # ---- load / unload ----------------------------------------------------
     def load(self, model, version, source=None, path=None,
              input_names=("data",), artifact_prefix="model", buckets=None,
-             jit=True, warmup=None, generator=None, breaker=None,
-             verify=True, max_batch_size=32, max_latency_ms=5.0,
-             max_queue_size=128, default_timeout_ms=None,
-             retry_policy=None, metrics_window=2048):
+             jit=True, warmup=None, prewarm=None, generator=None,
+             breaker=None, verify=True, max_batch_size=32,
+             max_latency_ms=5.0, max_queue_size=128,
+             default_timeout_ms=None, retry_policy=None,
+             metrics_window=2048):
         """Load one version into a fresh bulkhead lane (state
         ``standby`` — or ``live`` when it is the model's first version).
 
@@ -576,6 +614,18 @@ class ModelRegistry:
         ``generation.<model>.<version>`` namespace when they still carry
         the default name). ``warmup`` pre-compiles every bucket NOW so
         the later pointer flip costs zero compiles.
+
+        When ``path`` carries AOT artifacts (an ``executables.mxa``
+        exported by ``InferenceEngine.export_artifacts`` / CI's
+        ``tools/prewarm.py``, verified through the manifest's
+        ``executables`` section), the lane's executables are installed
+        from the artifact — the build and any later canary promote
+        compile **nothing**; a fingerprint mismatch (different topology/
+        jax version) falls back to normal compiles with a warn-once,
+        never a load failure. ``prewarm`` replays a warmup manifest
+        (traffic-frequency order) before the lane is routable: ``None``
+        (default) auto-replays the version dir's ``warmup.json`` when
+        present, ``False`` disables, or pass a manifest dict/path.
         """
         model, version = str(model), str(version)
         for label, value in (("model", model), ("version", version)):
@@ -601,6 +651,32 @@ class ModelRegistry:
                 buckets=buckets or DEFAULT_BUCKETS, jit=jit,
                 retry_policy=False,
                 name="fleet.%s.%s" % (model, version))
+            if jit and os.path.exists(
+                    os.path.join(path, _aot.ARTIFACT_NAME)):
+                # compile-free lane build: executables come off disk
+                # (fingerprint mismatch warns once and compiles instead;
+                # a blob corrupted after verify_manifest degrades the
+                # same way — a bad artifact must never fail the deploy)
+                try:
+                    engine.load_artifacts(path)
+                except _aot.ArtifactError as exc:
+                    from .. import pcache as _pcache
+                    _pcache.note_aot_fallback(
+                        str(exc), where="ModelRegistry.%s.%s"
+                        % (model, version))
+        wpath = os.path.join(path, _aot.WARMUP_NAME) \
+            if path is not None else None
+        if prewarm is None:
+            prewarm_src = wpath if wpath and os.path.exists(wpath) else None
+        elif prewarm is True:
+            if not (wpath and os.path.exists(wpath)):
+                raise FleetError("prewarm=True but no %s under %r"
+                                 % (_aot.WARMUP_NAME, path))
+            prewarm_src = wpath
+        elif prewarm:
+            prewarm_src = prewarm   # a manifest dict or path
+        else:
+            prewarm_src = None
         metrics = ServingMetrics(window=metrics_window,
                                  name="serving.%s.%s" % (model, version))
         if engine is not None:
@@ -651,6 +727,11 @@ class ModelRegistry:
                         gm.bind_profiler()   # lane close unbinds
                 if warmup is not None and engine is not None:
                     engine.warmup(warmup)
+                if prewarm_src is not None and engine is not None:
+                    # synchronous: the lane must be hot BEFORE it becomes
+                    # routable; with AOT artifacts loaded this executes
+                    # each rung once and compiles nothing
+                    engine.prewarm(manifest=prewarm_src, background=False)
                 with self._lock:
                     if self._closed:
                         raise ServerClosed("registry is closed")

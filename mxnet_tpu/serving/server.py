@@ -617,6 +617,17 @@ class ModelServer:
     ``X-Model-Version``, and ``/healthz`` + ``/metrics`` grow per-model
     sections. The registry's lanes are drained and closed by
     :meth:`stop`.
+
+    ``artifacts_dir`` kills the restart compile storm (ROADMAP item 4):
+    AOT executables exported by ``InferenceEngine.export_artifacts`` /
+    ``tools/prewarm.py`` are installed into the engine at construction
+    (zero XLA compiles for every covered bucket; a fingerprint mismatch
+    or corrupt artifact warns once and compiles normally — a bad
+    artifact must never keep a server down), and the directory's
+    ``warmup.json`` traffic manifest is replayed on a **background**
+    thread in traffic-frequency order, so the server accepts requests
+    immediately while the hottest rungs warm first. Progress rides
+    ``/metrics`` under the ``"coldstart"`` gauge.
     """
 
     def __init__(self, model=None, host="127.0.0.1", port=8080,
@@ -624,7 +635,8 @@ class ModelServer:
                  max_latency_ms=5.0, max_queue_size=128,
                  default_timeout_ms=None, metrics=None,
                  breaker=None, retry_policy=None,
-                 bind_profiler=True, generator=None, registry=None):
+                 bind_profiler=True, generator=None, registry=None,
+                 artifacts_dir=None):
         self.metrics = metrics or ServingMetrics()
         self.generator = generator
         self.registry = registry
@@ -700,8 +712,22 @@ class ModelServer:
                 gen_metrics.bind_profiler()
         else:
             self.metrics.set_gauge_fn("generation", _generation.gauge)
+        # cold-start ledger: persistent-cache hits, AOT loads/fallbacks,
+        # and the live prewarm replay's progress — restart health at a
+        # glance without a Prometheus scrape
+        from .. import pcache as _pcache
+        engine_ref = self.engine
+        self.metrics.set_gauge_fn(
+            "coldstart",
+            lambda: {"pcache": _pcache.stats(),
+                     "prewarm": (engine_ref.prewarm_status()
+                                 if engine_ref is not None else None)})
         if bind_profiler:
             self.metrics.bind_profiler()
+        if artifacts_dir is not None:
+            if self.engine is None:
+                raise ValueError("artifacts_dir= needs a /predict engine")
+            self._load_artifacts(artifacts_dir)
         self._draining = False
         self.batcher = None if self.engine is None else DynamicBatcher(
             self.engine, max_batch_size=max_batch_size,
@@ -712,6 +738,33 @@ class ModelServer:
         self._httpd.daemon_threads = True
         self._httpd.model_server = self
         self._thread = None
+
+    def _load_artifacts(self, artifacts_dir):
+        """Install AOT executables and kick off the background prewarm
+        replay. Every failure mode short of a programming error degrades
+        to normal compiles with a warn-once — a stale or corrupt
+        artifact must never keep a restarted server from coming up."""
+        import os
+
+        from .. import aot as _aot
+        from .. import pcache as _pcache
+        artifact = os.path.join(artifacts_dir, _aot.ARTIFACT_NAME)
+        if os.path.exists(artifact):
+            try:
+                self.engine.load_artifacts(artifacts_dir)
+            except _aot.ArtifactError as exc:
+                _pcache.note_aot_fallback(str(exc), where="ModelServer")
+        else:
+            _pcache.note_aot_fallback("no %s under %s"
+                                      % (_aot.ARTIFACT_NAME, artifacts_dir),
+                                      where="ModelServer")
+        warmup = os.path.join(artifacts_dir, _aot.WARMUP_NAME)
+        if os.path.exists(warmup):
+            try:
+                self.engine.prewarm(manifest=warmup, background=True)
+            except (ValueError, OSError) as exc:
+                _pcache.note_aot_fallback("warmup manifest unusable: %s"
+                                          % exc, where="ModelServer")
 
     @property
     def draining(self):
@@ -821,6 +874,11 @@ class ModelServer:
             gen_engine = getattr(self.generator, "engine", None)
             if gen_engine is not None and hasattr(gen_engine, "close"):
                 gen_engine.close()
+        if self.engine is not None:
+            # stop the background prewarm replay (artifacts_dir= started
+            # it) and release the ladder's executables — a stopped server
+            # must neither keep compiling rungs nor pin its XLA programs
+            self.engine.close()
         self.metrics.unbind_profiler()
 
     def __enter__(self):
