@@ -230,6 +230,39 @@ KNOBS = dict([
        "InferenceEngine warmup/prewarm compile concurrency: bucket "
        "rungs compile on a thread pool this wide (<=1 = serial; "
        "compiles already run outside CachedOp's dispatch lock)"),
+    _k("MXNET_GATEWAY_SCRAPE_MS", 250.0, float, "wired",
+       "gateway load/health scrape interval: how often serving/gateway.py "
+       "fans out to every replica's /healthz + /metrics for the "
+       "least-loaded routing signal (queue depth, breaker state, "
+       "degraded health, HBM headroom)"),
+    _k("MXNET_GATEWAY_CONNECT_TIMEOUT_MS", 1000.0, float, "wired",
+       "gateway -> replica connect/read timeout for scrapes and the "
+       "pre-response window of forwarded requests; a replica that "
+       "cannot be reached inside it is a failover, not a client error"),
+    _k("MXNET_GATEWAY_EJECT_FAILURES", 3, int, "wired",
+       "consecutive forward failures before a replica's gateway-side "
+       "circuit breaker ejects it from routing (<=0 disables ejection)"),
+    _k("MXNET_GATEWAY_EJECT_RECOVERY_MS", 2000.0, float, "wired",
+       "how long an ejected replica sits out before the breaker's "
+       "half-open probe offers it one request to earn readmission"),
+    _k("MXNET_GATEWAY_DRAIN_TIMEOUT_MS", 10000.0, float, "wired",
+       "bound on waiting for a draining replica's in-flight requests "
+       "and pinned streams to clear during rolling restart / scale-down"),
+    _k("MXNET_GATEWAY_SLO_P99_MS", 500.0, float, "wired",
+       "autoscaler latency SLO: sustained gateway-observed p99 above "
+       "this burns the SLO budget and grows the replica set (0 "
+       "disables the latency signal; queue depth still scales)"),
+    _k("MXNET_GATEWAY_QUEUE_HIGH", 8, int, "wired",
+       "autoscaler queue signal: mean scraped batcher queue depth per "
+       "routable replica above this counts as a burn tick"),
+    _k("MXNET_GATEWAY_MIN_REPLICAS", 1, int, "wired",
+       "autoscaler floor: scale-down never drains below this many "
+       "routable replicas"),
+    _k("MXNET_GATEWAY_MAX_REPLICAS", 8, int, "wired",
+       "autoscaler ceiling: scale-up stops here no matter the burn"),
+    _k("MXNET_SERVING_ADMIN_TOKEN", "", str, "wired",
+       "when set, admin endpoints (ModelServer GET /drain) require a "
+       "matching X-Admin-Token header; empty = unguarded (dev/tests)"),
     # ---- subsumed by XLA/PJRT --------------------------------------------
     _k("MXNET_EXEC_BULK_EXEC_INFERENCE", 1, int, "subsumed",
        "XLA compiles whole programs; bulking is implicit"),

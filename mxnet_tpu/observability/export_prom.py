@@ -38,7 +38,8 @@ from . import tracer as _tracer
 from .tracer import _BOUNDS_MS, _BUCKET_LABELS
 
 __all__ = ["PromWriter", "CONTENT_TYPE", "render_process", "render_server",
-           "render_serving_section", "render_generation_section"]
+           "render_serving_section", "render_generation_section",
+           "render_gateway_section", "render_gateway"]
 
 # Exemplars are only legal in the OpenMetrics exposition (the classic
 # 0.0.4 text parser reads anything after the value as a timestamp and
@@ -417,6 +418,51 @@ def _render_fleet(w, registry):
                 render_generation_section(w, gen, labels=labels)
 
 
+def render_gateway_section(w, snap):
+    """A ``GatewayMetrics.snapshot()`` dict: the ``mxtpu_gateway_*``
+    families — routed-request counters, failover/ejection/scale ledger,
+    latency percentiles, and the per-replica routing table."""
+    from ..serving.gateway import (GATEWAY_PROM_COUNTERS,
+                                   GATEWAY_PROM_GAUGES)
+    for key, help_text in GATEWAY_PROM_COUNTERS:
+        if key in snap:
+            w.counter("mxtpu_gateway_%s_total" % key, help_text,
+                      snap[key])
+    for key, help_text in GATEWAY_PROM_GAUGES:
+        if snap.get(key) is not None:
+            w.gauge("mxtpu_gateway_%s" % key, help_text, snap[key])
+    _quantile_family(w, "mxtpu_gateway_latency_ms",
+                     "gateway-observed routed-request latency "
+                     "percentiles over the sliding window",
+                     snap.get("latency_ms"))
+    table = snap.get("replica_table") or {}
+    for name, help_text, key in (
+            ("mxtpu_gateway_replica_up",
+             "1 when the replica is routable (up + healthy + breaker "
+             "not open)", None),
+            ("mxtpu_gateway_replica_queue_depth",
+             "replica batcher queue depth from the last load scrape",
+             "queue_depth"),
+            ("mxtpu_gateway_replica_inflight",
+             "gateway-tracked in-flight requests on the replica",
+             "inflight"),
+            ("mxtpu_gateway_replica_pins",
+             "streams pinned to the replica", "pins"),
+            ("mxtpu_gateway_replica_routed_total",
+             "requests the gateway has routed to the replica",
+             "routed")):
+        mtype = "counter" if name.endswith("_total") else "gauge"
+        w.family(name, mtype, help_text)
+        for rid, rep in table.items():
+            if key is None:
+                val = int(rep.get("state") == "up"
+                          and rep.get("health") == "ok"
+                          and rep.get("breaker") != "open")
+            else:
+                val = rep.get(key)
+            w.sample(name, val, labels={"replica": rid})
+
+
 def _const_labels():
     """Labels stamped on every sample this process exposes: its elastic
     rank when it has one (launcher env or live ElasticMember), so a
@@ -463,3 +509,11 @@ def render_server(server):
             render_generation_section(w, gen.snapshot())
 
     return render_process(extra=_extra)
+
+
+def render_gateway(gateway):
+    """Everything ``render_process`` exposes plus the gateway's routing
+    section — the gateway's ``GET /metrics.prom`` body."""
+    return render_process(
+        extra=lambda w: render_gateway_section(
+            w, gateway.metrics.snapshot()))

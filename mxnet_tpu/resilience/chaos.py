@@ -20,6 +20,13 @@ Injection points wired in this codebase:
                           canary fail/stall deterministically so
                           detection -> automatic rollback is testable
                           end-to-end
+``gateway.forward``       gateway routing attempt (``serving/gateway.py``,
+                          per failover attempt): transient faults here
+                          exercise re-route/backoff without touching a
+                          replica; the replica-loss drill itself arms
+                          ``serving.execute:host_loss`` in ONE replica's
+                          ``MXNET_CHAOS_SPEC`` so that process dies
+                          mid-request under load
 ``trainer.step``          ShardedTrainer.step / step_many entry
 ``trainer.grads``         training-step input staging (``nan`` kind poisons
                           the batch so loss/grads go non-finite)

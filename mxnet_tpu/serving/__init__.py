@@ -30,9 +30,12 @@ from .fleet import (CanaryController, ChecksumMismatch,
                     CompileBudgetExceeded, FleetError, ManifestError,
                     ModelNotFound, ModelRegistry, ModelVersion,
                     VersionNotFound, verify_manifest, write_manifest)
+from .gateway import (Autoscaler, Gateway, GatewayMetrics,
+                      NoRoutableReplica, Replica, ReplicaUnavailable)
 from .metrics import GenerationMetrics, ServingMetrics
 from .server import ModelServer
 from . import fleet
+from . import gateway
 from . import generation
 
 __all__ = ["InferenceEngine", "DynamicBatcher", "ModelServer",
@@ -42,4 +45,6 @@ __all__ = ["InferenceEngine", "DynamicBatcher", "ModelServer",
            "ModelVersion", "CanaryController", "FleetError",
            "ModelNotFound", "VersionNotFound", "ManifestError",
            "ChecksumMismatch", "CompileBudgetExceeded",
-           "write_manifest", "verify_manifest"]
+           "write_manifest", "verify_manifest", "gateway", "Gateway",
+           "Autoscaler", "GatewayMetrics", "Replica",
+           "ReplicaUnavailable", "NoRoutableReplica"]

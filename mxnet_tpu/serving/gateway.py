@@ -1,0 +1,1274 @@
+"""Horizontal serving: a fault-tolerant, load-aware gateway over N replicas.
+
+Role parity: the front-end/replica split every production serving system
+lands on — TF-Serving behind its router, Clipper's query frontend over
+model containers (both already cited in ``serving/engine.py``). Every
+layer below this one (engine, generation, fleet, AOT restart) scales one
+process; this module makes replica loss a reroute instead of an outage:
+
+- **Least-loaded routing** — a background scraper fans out (in parallel,
+  ``tools/telemetry_agg.py``-style) to every replica's ``/healthz`` +
+  ``/metrics`` and keeps a live load view: batcher queue depth (the
+  ``serving.queue_depth`` gauge), breaker state, degraded health, HBM
+  headroom. Requests go to the lowest-scoring routable replica, with the
+  gateway's own in-flight count as the between-scrapes signal.
+- **Failover** — connect failures and 5xx replies re-route to the
+  next-best replica under the existing
+  :class:`~mxnet_tpu.resilience.retry.RetryPolicy`
+  (:class:`ReplicaUnavailable` is a ``TransientFault``, so the stock
+  policy absorbs it); ``/predict`` is idempotent, so a replica that dies
+  mid-request costs a retry, not a client-visible error.
+- **Ejection** — every replica gets a gateway-side
+  :class:`~mxnet_tpu.resilience.breaker.CircuitBreaker`; a flapping
+  backend is ejected from routing and earns readmission through the
+  breaker's half-open probe.
+- **Sticky streams** — a ``/generate`` stream pins its replica for the
+  whole response (continuous batching holds the KV slot there); replica
+  death mid-stream surfaces the protocol's existing in-band ``error``
+  line and frees the pin.
+- **Drain-aware rolling restart** — :meth:`Gateway.rolling_restart`
+  cycles the fleet one replica at a time: stop routing → ``GET /drain``
+  on the replica → wait for in-flight + pins to clear → backend restart
+  (onto the AOT zero-compile path when artifacts are published) →
+  health-gated readmission. Zero dropped requests.
+- **SLO-driven autoscale** — :class:`Autoscaler` grows the replica set on
+  sustained queue-depth / p99-SLO burn and shrinks it through the same
+  drain machinery, never below the floor.
+
+Topology: clients → ``Gateway`` (this module, stdlib HTTP) → N
+``ModelServer`` replicas (separate processes in production —
+``tools/serve_fleet.py`` spawns and supervises them — or in-process
+servers in tests). ``X-Request-Id`` is honored/minted at the gateway and
+forwarded, so one id names the request across gateway spans
+(``gateway.route`` / ``gateway.failover``) and the replica's own
+``serving.http`` span chain; ``X-Model-Version`` from fleet replicas is
+echoed back unchanged.
+"""
+from __future__ import annotations
+
+import http.client
+import json
+import threading
+import time
+import urllib.parse
+import urllib.request
+import uuid
+from collections import deque
+from http.server import BaseHTTPRequestHandler
+
+from .. import config as _config
+from ..observability import tracer as _trace
+from ..resilience import chaos as _chaos
+from ..resilience import retry as _retry
+from ..resilience.breaker import CircuitBreaker
+from .metrics import _percentiles
+
+__all__ = ["Gateway", "Autoscaler", "Replica", "GatewayMetrics",
+           "ReplicaUnavailable", "NoRoutableReplica",
+           "GATEWAY_PROM_COUNTERS", "GATEWAY_PROM_GAUGES"]
+
+# replica lifecycle (breaker-open "ejected" is derived, not a state:
+# the breaker owns its own recovery clock)
+JOINING, UP, DRAINING = "joining", "up", "draining"
+
+
+class ReplicaUnavailable(_chaos.TransientFault):
+    """One forward attempt failed for replica-side reasons (connect
+    error, mid-read death, 5xx). Subclasses ``TransientFault`` so the
+    stock env-configured :class:`RetryPolicy` re-routes it — failover IS
+    a retry, with the next attempt picking the next-best replica."""
+
+
+class NoRoutableReplica(RuntimeError):
+    """Every replica is down/draining/ejected (mapped to HTTP 503)."""
+
+
+# Prometheus exposition descriptors (rendered by
+# observability/export_prom.py) — kept next to the counters they
+# describe, like serving/metrics.py does.
+GATEWAY_PROM_COUNTERS = (
+    ("requests", "routed /predict requests (ok + errors)"),
+    ("ok", "routed requests that returned a replica's 2xx/4xx reply"),
+    ("errors", "client-visible gateway failures (all replicas exhausted)"),
+    ("failovers", "re-routes to another replica after a forward failure"),
+    ("no_replica", "requests that found zero routable replicas"),
+    ("streams", "routed /generate streams"),
+    ("stream_errors", "streams that lost their replica mid-flight"),
+    ("ejections", "replica breaker trips (backend ejected from routing)"),
+    ("readmissions", "replicas readmitted via half-open probe success"),
+    ("drains", "replica drains started (restart/scale-down)"),
+    ("rolling_restarts", "full-fleet rolling restarts completed"),
+    ("scale_ups", "autoscaler replica additions"),
+    ("scale_downs", "autoscaler replica removals"),
+)
+GATEWAY_PROM_GAUGES = (
+    ("qps", "routed requests/s over the sliding window"),
+    ("replicas", "replicas known to the gateway"),
+    ("ready_replicas", "replicas currently routable"),
+    ("draining_replicas", "replicas draining for restart/removal"),
+)
+
+
+class Replica:
+    """One backend in the gateway's routing table. Load fields are
+    written by the scraper thread and the request path under the
+    gateway's lock; ``meta`` is the backend handle (a process record for
+    ``tools/serve_fleet.py``, a server object in tests)."""
+
+    __slots__ = ("id", "url", "state", "health", "breaker", "queue_depth",
+                 "headroom", "inflight", "pins", "routed", "failures",
+                 "scrape_failures", "generation", "meta")
+
+    def __init__(self, rid, url, breaker, meta=None):
+        self.id = rid
+        self.url = url.rstrip("/")
+        self.state = JOINING
+        self.health = "unknown"   # ok | degraded | draining | down
+        self.breaker = breaker
+        self.queue_depth = 0
+        self.headroom = None
+        self.inflight = 0
+        self.pins = 0
+        self.routed = 0
+        self.failures = 0
+        self.scrape_failures = 0
+        self.generation = 0       # bumped per restart
+        self.meta = meta
+
+    def describe(self):
+        return {
+            "id": self.id, "url": self.url, "state": self.state,
+            "health": self.health, "queue_depth": self.queue_depth,
+            "headroom": self.headroom, "inflight": self.inflight,
+            "pins": self.pins, "routed": self.routed,
+            "failures": self.failures, "generation": self.generation,
+            "breaker": self.breaker.snapshot()["state"],
+        }
+
+
+class GatewayMetrics:
+    """Gateway-side counters + latency window, exported like
+    :class:`~.metrics.ServingMetrics`: :meth:`snapshot` (``/metrics``),
+    ``gateway.*`` profiler rows, and the ``mxtpu_gateway_*`` OpenMetrics
+    families."""
+
+    def __init__(self, window=2048, name="gateway"):
+        self.name = name
+        self._lock = threading.Lock()
+        self._window = deque(maxlen=window)  # (done_t, latency_s)
+        self._c = {k: 0 for k, _ in GATEWAY_PROM_COUNTERS}
+        self._latency_total = 0.0
+        self._t0 = time.time()
+        self._replica_table_fn = None
+        self._bound_provider = None
+
+    def count(self, key, n=1):
+        with self._lock:
+            self._c[key] += n
+
+    def record_request(self, latency_s, ok=True):
+        with self._lock:
+            self._c["requests"] += 1
+            self._c["ok" if ok else "errors"] += 1
+            self._latency_total += latency_s
+            self._window.append((time.time(), latency_s))
+
+    def p99_ms(self):
+        """Gateway-observed p99 over the sliding window — the
+        autoscaler's latency-SLO signal."""
+        with self._lock:
+            lats = [l for _, l in self._window]
+        return _percentiles(lats, qs=(99,))["p99"]
+
+    def set_replica_table_fn(self, fn):
+        self._replica_table_fn = fn
+
+    def snapshot(self):
+        with self._lock:
+            c = dict(self._c)
+            window = list(self._window)
+            latency_total = self._latency_total
+        if len(window) >= 2:
+            span = max(window[-1][0] - window[0][0], 1e-9)
+            qps = (len(window) - 1) / span
+        elif c["requests"]:
+            qps = c["requests"] / max(time.time() - self._t0, 1e-9)
+        else:
+            qps = 0.0
+        lat = _percentiles([l for _, l in window])
+        lat["mean"] = (latency_total / c["requests"] * 1e3
+                       if c["requests"] else 0.0)
+        out = {"name": self.name, "qps": qps, "latency_ms": lat,
+               "uptime_s": time.time() - self._t0}
+        out.update(c)
+        if self._replica_table_fn is not None:
+            try:
+                table = self._replica_table_fn()
+            except Exception:
+                table = {}
+            out["replica_table"] = table
+            states = [r["state"] for r in table.values()]
+            healths = [(r["state"], r["health"], r["breaker"])
+                       for r in table.values()]
+            out["replicas"] = len(table)
+            out["ready_replicas"] = sum(
+                1 for s, h, b in healths
+                if s == UP and h == "ok" and b != "open")
+            out["draining_replicas"] = states.count(DRAINING)
+        return out
+
+    def profiler_rows(self):
+        with self._lock:
+            c = dict(self._c)
+            latency_total = self._latency_total
+        rows = {"gateway.requests": (c["requests"], latency_total)}
+        for key in ("failovers", "no_replica", "ejections", "readmissions",
+                    "streams", "stream_errors", "drains", "scale_ups",
+                    "scale_downs", "rolling_restarts"):
+            rows["gateway." + key] = (c[key], 0.0)
+        return rows
+
+    def bind_profiler(self):
+        from .. import profiler as _profiler
+        if self._bound_provider is None:
+            self._bound_provider = self.profiler_rows
+            _profiler.register_stats_provider(self._bound_provider)
+        return self
+
+    def unbind_profiler(self):
+        from .. import profiler as _profiler
+        if self._bound_provider is not None:
+            _profiler.unregister_stats_provider(self._bound_provider)
+            self._bound_provider = None
+
+
+# ---------------------------------------------------------------------------
+# HTTP front
+# ---------------------------------------------------------------------------
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "mxnet_tpu_gateway/0.1"
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):
+        pass
+
+    def _reply(self, code, payload, headers=None):
+        body = json.dumps(payload).encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        rid = getattr(self, "_request_id", None)
+        if rid is not None:
+            self.send_header("X-Request-Id", rid)
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _reply_text(self, code, body, content_type):
+        data = body.encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _reply_raw(self, code, body, headers):
+        """Relay a replica's buffered reply verbatim (status + body +
+        the attribution headers that must survive the hop)."""
+        self.send_response(code)
+        for k, v in headers.items():
+            self.send_header(k, v)
+        if "Content-Type" not in headers:
+            self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        rid = getattr(self, "_request_id", None)
+        if rid is not None and "X-Request-Id" not in headers:
+            self.send_header("X-Request-Id", rid)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):  # noqa: N802
+        self._request_id = None
+        gw = self.server.gateway
+        path, _, query = self.path.partition("?")
+        if path == "/healthz":
+            self._reply(200, gw.health())
+        elif path == "/metrics.prom" or (
+                path == "/metrics" and "format=prometheus" in query):
+            from ..observability import export_prom as _prom
+            self._reply_text(200, _prom.render_gateway(gw),
+                             _prom.CONTENT_TYPE)
+        elif path == "/metrics":
+            self._reply(200, gw.metrics.snapshot())
+        elif path == "/replicas":
+            self._reply(200, {"replicas": gw.replica_table(),
+                              "events": gw.events()})
+        else:
+            self._reply(404, {"error": "unknown path %s" % self.path})
+
+    def _read_body(self):
+        from .server import read_post_body
+        return read_post_body(self)
+
+    def do_POST(self):  # noqa: N802
+        gw = self.server.gateway
+        rid = self.headers.get("X-Request-Id") or uuid.uuid4().hex[:16]
+        self._request_id = rid
+        body = self._read_body()
+        if body is None:
+            return
+        path = self.path.split("?", 1)[0]
+        if path == "/predict" or path.startswith("/predict/"):
+            self._route_predict(gw, path, body, rid)
+        elif path == "/generate" or path.startswith("/generate/"):
+            self._route_generate(gw, path, body, rid)
+        else:
+            self._reply(404, {"error": "unknown path %s" % self.path})
+
+    def _route_predict(self, gw, path, body, rid):
+        t0 = time.monotonic()
+        try:
+            status, headers, data = gw.forward_predict(path, body, rid)
+        except NoRoutableReplica as e:
+            gw.metrics.record_request(time.monotonic() - t0, ok=False)
+            self._reply(503, {"error": str(e)},
+                        headers={"Retry-After": "1"})
+            return
+        except _retry.RetryExhausted as e:
+            gw.metrics.record_request(time.monotonic() - t0, ok=False)
+            self._reply(503, {"error": "all replicas failed: %s" % e},
+                        headers={"Retry-After": "1"})
+            return
+        except _chaos.TransientFault as e:
+            # retry_policy=False (single attempt): ReplicaUnavailable /
+            # an armed gateway.forward fault has no RetryPolicy to wrap
+            # it — still a typed 503, never a dropped connection
+            gw.metrics.record_request(time.monotonic() - t0, ok=False)
+            self._reply(503, {"error": str(e)},
+                        headers={"Retry-After": "1"})
+            return
+        gw.metrics.record_request(time.monotonic() - t0, ok=True)
+        self._reply_raw(status, data, headers)
+
+    def _route_generate(self, gw, path, body, rid):
+        gw.stream_generate(self, path, body, rid)
+
+
+# ---------------------------------------------------------------------------
+# the gateway
+# ---------------------------------------------------------------------------
+
+class Gateway:
+    """Load-aware HTTP router over N ``ModelServer`` replicas.
+
+    Parameters
+    ----------
+    replicas : iterable of str, optional
+        Initial replica base URLs (``http://host:port``). Each starts
+        ``joining`` and is promoted to ``up`` by its first healthy
+        scrape (health-gated admission — a replica still compiling its
+        ladder takes no traffic until ``/healthz`` says ``ok``).
+    backend : object, optional
+        Replica lifecycle provider for rolling restarts and autoscaling.
+        Duck-typed: ``spawn() -> (url, meta)``, ``restart(replica) ->
+        new_url | None``, ``stop(replica)``. ``tools/serve_fleet.py``
+        ships the subprocess implementation; tests wrap in-process
+        servers.
+    scrape_ms : float, optional
+        Load-scrape interval (default ``MXNET_GATEWAY_SCRAPE_MS``);
+        ``0`` disables the background scraper (tests drive
+        :meth:`scrape_once` by hand).
+    forward_timeout_s : float
+        Socket timeout for forwarded requests (covers the replica's own
+        queue deadline; scrapes use the much shorter
+        ``MXNET_GATEWAY_CONNECT_TIMEOUT_MS``).
+    retry_policy : RetryPolicy, optional
+        Failover policy. Default builds the env-configured
+        ``retry.gateway`` named policy (``MXNET_RETRY_*``); each retry
+        attempt re-picks the next-best untried replica. ``False``
+        disables failover (single attempt).
+    admin_token : str, optional
+        Sent as ``X-Admin-Token`` on replica ``/drain`` calls (default
+        ``MXNET_SERVING_ADMIN_TOKEN``).
+    event_log : str or callable, optional
+        Path for JSON-lines lifecycle transitions (replica up/drain/
+        restart/eject/scale), or a callable receiving each event dict.
+        The last 256 events are always kept in memory (:meth:`events`).
+    """
+
+    def __init__(self, replicas=(), backend=None, host="127.0.0.1",
+                 port=0, scrape_ms=None, forward_timeout_s=30.0,
+                 retry_policy=None, metrics=None, admin_token=None,
+                 event_log=None, eject_failures=None,
+                 eject_recovery_ms=None, bind_profiler=True,
+                 clock=time.monotonic):
+        self.metrics = metrics or GatewayMetrics()
+        self.metrics.set_replica_table_fn(self.replica_table)
+        if bind_profiler:
+            self.metrics.bind_profiler()
+        self._clock = clock
+        self._lock = threading.RLock()
+        self._replicas = {}
+        self._next_id = 0
+        self._backend = backend
+        self._forward_timeout_s = float(forward_timeout_s)
+        self._connect_timeout_s = \
+            _config.get("MXNET_GATEWAY_CONNECT_TIMEOUT_MS") / 1e3
+        self._scrape_s = (_config.get("MXNET_GATEWAY_SCRAPE_MS")
+                          if scrape_ms is None else float(scrape_ms)) / 1e3
+        self._eject_failures = (
+            _config.get("MXNET_GATEWAY_EJECT_FAILURES")
+            if eject_failures is None else int(eject_failures))
+        self._eject_recovery_ms = (
+            _config.get("MXNET_GATEWAY_EJECT_RECOVERY_MS")
+            if eject_recovery_ms is None else float(eject_recovery_ms))
+        if retry_policy is None:
+            retry_policy = _retry.named_policy("retry.gateway")
+        self._retry = retry_policy or None
+        self._admin_token = (_config.get("MXNET_SERVING_ADMIN_TOKEN")
+                             if admin_token is None else admin_token)
+        self._events = deque(maxlen=256)
+        self._event_sink = None
+        self._event_path = None
+        if callable(event_log):
+            self._event_sink = event_log
+        elif event_log:
+            self._event_path = event_log
+        self._event_lock = threading.Lock()
+        self._closing = False
+        self._scrape_thread = None
+        self._scrape_wake = threading.Event()
+        for url in replicas:
+            self.add_replica(url)
+        from .server import _QuietThreadingHTTPServer
+        self._httpd = _QuietThreadingHTTPServer((host, port), _Handler)
+        self._httpd.daemon_threads = True
+        self._httpd.gateway = self
+        self._thread = None
+
+    # ---- replica set ------------------------------------------------------
+    def _mk_breaker(self, rid):
+        # <=0 disables ejection (per the knob contract): the breaker
+        # still exists so the outcome plumbing is uniform, but its
+        # threshold is unreachably high and it never opens
+        threshold = (self._eject_failures if self._eject_failures > 0
+                     else (1 << 30))
+        return CircuitBreaker(
+            failure_threshold=threshold,
+            recovery_ms=self._eject_recovery_ms,
+            half_open_probes=1, clock=self._clock,
+            name="gateway.replica.%d" % rid,
+            register=self._eject_failures > 0)
+
+    def add_replica(self, url, meta=None, state=JOINING):
+        """Register a replica (health-gated: it takes traffic once a
+        scrape sees ``/healthz`` ok). Returns the :class:`Replica`."""
+        with self._lock:
+            rid = self._next_id
+            self._next_id += 1
+            rep = Replica(rid, url, self._mk_breaker(rid), meta=meta)
+            rep.state = state
+            self._replicas[rid] = rep
+        self._event("replica_added", replica=rid, url=rep.url)
+        return rep
+
+    def remove_replica(self, rid):
+        with self._lock:
+            rep = self._replicas.pop(rid, None)
+        if rep is not None:
+            rep.breaker.deregister()
+            self._event("replica_removed", replica=rid, url=rep.url)
+        return rep
+
+    def replica(self, rid):
+        with self._lock:
+            return self._replicas.get(rid)
+
+    def replicas(self):
+        with self._lock:
+            return list(self._replicas.values())
+
+    def replica_table(self):
+        with self._lock:
+            return {str(r.id): r.describe()
+                    for r in self._replicas.values()}
+
+    def ready_replicas(self):
+        """Replicas currently eligible for new requests."""
+        with self._lock:
+            return [r for r in self._replicas.values()
+                    if r.state == UP and r.health == "ok"
+                    and r.breaker.state != "open"]
+
+    def events(self):
+        with self._event_lock:
+            return list(self._events)
+
+    def log_event(self, kind, **kw):
+        """Public event hook: supervisors (``tools/serve_fleet.py``)
+        record their own lifecycle transitions (spawn, crash, respawn)
+        into the same JSON event stream the gateway writes."""
+        self._event(kind, **kw)
+
+    def _event(self, kind, **kw):
+        evt = {"t": time.time(), "event": kind}
+        evt.update(kw)
+        with self._event_lock:
+            self._events.append(evt)
+            if self._event_path is not None:
+                try:
+                    with open(self._event_path, "a") as f:
+                        f.write(json.dumps(evt) + "\n")
+                except OSError:
+                    pass
+        if self._event_sink is not None:
+            try:
+                self._event_sink(evt)
+            except Exception:
+                pass
+        _trace.instant("gateway.event", kind=kind,
+                       replica=kw.get("replica"))
+
+    # ---- load / health scraping -------------------------------------------
+    def _fan_out(self, items, fn):
+        """Run ``fn(item)`` concurrently, one thread per item, bounded by
+        the scrape timeout — the ``tools/telemetry_agg.py`` pattern: a
+        dead replica costs ONE timeout, not one per replica, so losing
+        hosts can't make the load signal go stale for the healthy ones."""
+        results = {}
+        threads = []
+        for key, item in items:
+            def _run(key=key, item=item):
+                results[key] = fn(item)
+            t = threading.Thread(target=_run, daemon=True,
+                                 name="gateway-scrape-%s" % key)
+            t.start()
+            threads.append(t)
+        # a scrape is TWO sequential requests (/healthz then /metrics),
+        # each bounded by the connect timeout — the join deadline must
+        # cover both or a slow-but-alive replica gets marked down
+        deadline = time.monotonic() + 2.0 * self._connect_timeout_s + 1.0
+        for t in threads:
+            t.join(max(0.0, deadline - time.monotonic()))
+        return results
+
+    def _scrape_replica(self, url):
+        """One replica's (health_status, queue_depth, headroom) or None
+        when unreachable."""
+        try:
+            with urllib.request.urlopen(
+                    url + "/healthz",
+                    timeout=self._connect_timeout_s) as r:
+                health = json.loads(r.read()).get("status", "ok")
+        except Exception:
+            return None
+        queue_depth, headroom = 0, None
+        try:
+            with urllib.request.urlopen(
+                    url + "/metrics",
+                    timeout=self._connect_timeout_s) as r:
+                snap = json.loads(r.read())
+            qd = snap.get("queue_depth")
+            if qd is None:  # generation-only server: its lane's backlog
+                qd = (snap.get("generation") or {}).get("queue_depth")
+            queue_depth = int(qd or 0)
+            mem = ((snap.get("telemetry") or {}).get("memory") or {})
+            if isinstance(mem, dict) and "min_headroom" in mem:
+                headroom = mem["min_headroom"]
+        except Exception:
+            pass  # health answered; load detail is best-effort
+        return health, queue_depth, headroom
+
+    def scrape_once(self):
+        """One parallel load/health sweep over every replica; applies
+        state transitions (joining → up on first healthy scrape,
+        unreachable → ``down``). Called by the background scraper every
+        ``MXNET_GATEWAY_SCRAPE_MS``; tests call it directly."""
+        with self._lock:
+            targets = [(r.id, r.url) for r in self._replicas.values()]
+        scraped = self._fan_out(targets, self._scrape_replica)
+        with self._lock:
+            for rid, _url in targets:
+                rep = self._replicas.get(rid)
+                if rep is None:
+                    continue
+                out = scraped.get(rid)
+                if out is None:
+                    rep.scrape_failures += 1
+                    if rep.health != "down":
+                        rep.health = "down"
+                        self._event("replica_down", replica=rid,
+                                    url=rep.url)
+                    continue
+                health, queue_depth, headroom = out
+                rep.scrape_failures = 0
+                came_up = (rep.health != "ok" and health == "ok")
+                rep.health = health
+                rep.queue_depth = queue_depth
+                rep.headroom = headroom
+                if rep.state == JOINING and health == "ok":
+                    rep.state = UP
+                    self._event("replica_up", replica=rid, url=rep.url)
+                elif came_up and rep.state == UP:
+                    self._event("replica_healthy", replica=rid)
+        return self.replica_table()
+
+    def _scrape_loop(self):
+        while not self._closing:
+            try:
+                self.scrape_once()
+            except Exception:
+                pass  # the scraper must outlive any one bad sweep
+            self._scrape_wake.wait(self._scrape_s)
+            self._scrape_wake.clear()
+
+    # ---- routing ----------------------------------------------------------
+    def _score(self, rep):
+        # queue depth is the replica's own backlog; inflight/pins are the
+        # gateway's live view between scrapes; degraded costs extra so a
+        # breaker-open/low-HBM replica only takes traffic when everyone
+        # else is worse; low routed count breaks ties (spread when idle)
+        score = rep.queue_depth + rep.inflight + 2 * rep.pins
+        if rep.health == "degraded":
+            score += 4
+        if rep.headroom is not None and rep.headroom < 0.1:
+            score += 4
+        return score
+
+    def _pick(self, exclude):
+        """Least-loaded routable replica not in ``exclude``, with its
+        breaker admission ticket. Returns (replica, admission) or
+        (None, None)."""
+        with self._lock:
+            candidates = [r for r in self._replicas.values()
+                          if r.id not in exclude and r.state == UP
+                          and r.health not in ("down", "draining")]
+            candidates.sort(key=lambda r: (self._score(r), r.routed, r.id))
+            for rep in candidates:
+                admission = rep.breaker.allow()
+                if not admission:
+                    continue  # ejected (open) — skip without counting
+                rep.inflight += 1
+                rep.routed += 1
+                return rep, admission
+        return None, None
+
+    def _release(self, rep):
+        with self._lock:
+            rep.inflight = max(0, rep.inflight - 1)
+
+    def _note_outcome(self, rep, admission, ok, fault=True):
+        """Feed the replica's breaker and translate its state changes
+        into ejection/readmission events."""
+        before = rep.breaker.state
+        if ok:
+            rep.breaker.record_success(admission)
+        elif fault:
+            with self._lock:
+                rep.failures += 1
+            rep.breaker.record_failure(admission)
+        else:
+            rep.breaker.release(admission)
+        after = rep.breaker.state
+        if before != "open" and after == "open":
+            self.metrics.count("ejections")
+            self._event("replica_ejected", replica=rep.id,
+                        failures=rep.failures)
+        elif before == "half_open" and after == "closed":
+            self.metrics.count("readmissions")
+            self._event("replica_readmitted", replica=rep.id)
+
+    def _forward_once(self, rep, path, body, rid):
+        """One buffered POST to one replica. Returns (status, headers,
+        body_bytes); raises ``OSError``-family on transport failure."""
+        u = urllib.parse.urlsplit(rep.url)
+        conn = http.client.HTTPConnection(
+            u.hostname, u.port, timeout=self._forward_timeout_s)
+        try:
+            conn.request("POST", path, body=body, headers={
+                "Content-Type": "application/json",
+                "X-Request-Id": rid,
+                "Content-Length": str(len(body)),
+            })
+            resp = conn.getresponse()
+            data = resp.read()
+            headers = {}
+            for k in ("X-Model-Version", "Retry-After", "X-Request-Id",
+                      "Content-Type"):
+                v = resp.headers.get(k)
+                if v is not None:
+                    headers[k] = v
+            return resp.status, headers, data
+        finally:
+            conn.close()
+
+    def forward_predict(self, path, body, rid):
+        """Route one idempotent ``/predict`` with failover: pick the
+        least-loaded replica, forward, and on connect/5xx failure
+        re-route to the next-best under the retry policy. Returns
+        (status, headers, body). Raises :class:`NoRoutableReplica` /
+        :class:`~mxnet_tpu.resilience.retry.RetryExhausted` for the
+        handler to map to 503."""
+        tried = set()
+        state = {"attempt": 0}
+
+        def attempt():
+            state["attempt"] += 1
+            _chaos.point("gateway.forward")
+            rep, admission = self._pick(tried)
+            if rep is None:
+                if not tried:
+                    self.metrics.count("no_replica")
+                    raise NoRoutableReplica(
+                        "no routable replica (%d known)"
+                        % len(self._replicas))
+                # everyone was tried this round: let the policy's backoff
+                # buy recovery time, then try the whole set again
+                tried.clear()
+                raise ReplicaUnavailable("all replicas tried; retrying")
+            tried.add(rep.id)
+            failing_over = state["attempt"] > 1
+            if failing_over:
+                self.metrics.count("failovers")
+                _trace.instant("gateway.failover", request_id=rid,
+                               replica=rep.id, attempt=state["attempt"])
+            span = ("gateway.failover" if failing_over
+                    else "gateway.forward")
+            try:
+                with _trace.span(span, request_id=rid, replica=rep.id):
+                    status, headers, data = self._forward_once(
+                        rep, path, body, rid)
+            except OSError as e:
+                self._note_outcome(rep, admission, ok=False)
+                raise ReplicaUnavailable(
+                    "replica %d (%s) unreachable: %s: %s"
+                    % (rep.id, rep.url, type(e).__name__, e)) from e
+            finally:
+                self._release(rep)
+            if status >= 500:
+                # 503 is backpressure/drain (not a model fault — don't
+                # burn the breaker), everything else 5xx is; both
+                # re-route: /predict is idempotent
+                self._note_outcome(rep, admission, ok=False,
+                                   fault=status not in (503,))
+                raise ReplicaUnavailable(
+                    "replica %d replied %d" % (rep.id, status))
+            self._note_outcome(rep, admission, ok=True)
+            return status, headers, data
+
+        with _trace.span("gateway.route", request_id=rid, path=path):
+            if self._retry is not None:
+                return self._retry.call(attempt)
+            return attempt()
+
+    # ---- streamed /generate (sticky) --------------------------------------
+    def _pin(self, rep):
+        with self._lock:
+            rep.pins += 1
+
+    def _unpin(self, rep):
+        with self._lock:
+            rep.pins = max(0, rep.pins - 1)
+
+    def stream_generate(self, handler, path, body, rid):
+        """Route one ``/generate``: sticky — the stream pins its replica
+        end-to-end (the KV slot lives there). Pre-response failures fail
+        over to the next-best replica (nothing streamed yet, the prompt
+        is resubmittable); once streaming, replica death surfaces the
+        protocol's in-band ``{"error": ...}`` line and frees the pin."""
+        tried = set()
+        self.metrics.count("streams")
+        with _trace.span("gateway.route", request_id=rid, path=path,
+                         stream=True):
+            for attempt_n in range(max(1, len(self._replicas) + 1)):
+                rep, admission = self._pick(tried)
+                if rep is None:
+                    handler._reply(503, {"error": "no routable replica"},
+                                   headers={"Retry-After": "1"})
+                    self.metrics.count("no_replica")
+                    return
+                tried.add(rep.id)
+                if attempt_n > 0:
+                    self.metrics.count("failovers")
+                    _trace.instant("gateway.failover", request_id=rid,
+                                   replica=rep.id, attempt=attempt_n + 1)
+                self._pin(rep)
+                try:
+                    done = self._stream_from(handler, rep, admission,
+                                             path, body, rid)
+                finally:
+                    self._unpin(rep)
+                    self._release(rep)
+                if done:
+                    return
+            handler._reply(503, {"error": "all replicas failed"},
+                           headers={"Retry-After": "1"})
+
+    def _stream_from(self, handler, rep, admission, path, body, rid):
+        """Attempt the stream on one pinned replica. Returns True when a
+        reply (success or relayed typed failure) reached the client —
+        False means nothing was sent and the caller may fail over."""
+        u = urllib.parse.urlsplit(rep.url)
+        conn = http.client.HTTPConnection(
+            u.hostname, u.port, timeout=self._forward_timeout_s)
+        t0 = time.monotonic()
+        try:
+            try:
+                conn.request("POST", path, body=body, headers={
+                    "Content-Type": "application/json",
+                    "X-Request-Id": rid,
+                    "Content-Length": str(len(body)),
+                })
+                resp = conn.getresponse()
+            except OSError as e:
+                self._note_outcome(rep, admission, ok=False)
+                _trace.instant("gateway.stream_connect_failed",
+                               request_id=rid, replica=rep.id,
+                               error=type(e).__name__)
+                return False  # nothing sent: caller fails over
+            if resp.status != 200:
+                data = resp.read()
+                if resp.status >= 500 and resp.status != 504:
+                    # 5xx pre-stream: prompt never started decoding —
+                    # safe to fail over (503 = busy/drain, not a fault)
+                    self._note_outcome(rep, admission, ok=False,
+                                       fault=resp.status != 503)
+                    return False
+                # typed client-facing failure (400/404/504): relay as-is
+                self._note_outcome(rep, admission, ok=True)
+                headers = {k: v for k, v in (
+                    ("X-Model-Version",
+                     resp.headers.get("X-Model-Version")),
+                    ("Retry-After", resp.headers.get("Retry-After")),
+                ) if v is not None}
+                handler._reply_raw(resp.status, data, headers)
+                return True
+            # 200: commit to chunked NDJSON relay
+            handler.send_response(200)
+            handler.send_header("Content-Type", "application/x-ndjson")
+            handler.send_header("X-Request-Id", rid)
+            mv = resp.headers.get("X-Model-Version")
+            if mv is not None:
+                handler.send_header("X-Model-Version", mv)
+            handler.send_header("Transfer-Encoding", "chunked")
+            handler.end_headers()
+            finished = False
+            client_gone = False
+            try:
+                while True:
+                    line = resp.readline()
+                    if not line:
+                        break
+                    try:
+                        handler.wfile.write(b"%x\r\n" % len(line))
+                        handler.wfile.write(line)
+                        handler.wfile.write(b"\r\n")
+                        handler.wfile.flush()
+                    except OSError:
+                        client_gone = True
+                        break
+                    try:
+                        obj = json.loads(line)
+                    except ValueError:
+                        obj = {}
+                    if obj.get("done") or obj.get("error"):
+                        finished = True
+                        break
+            except (OSError, http.client.HTTPException) as e:
+                # replica died mid-stream: in-band error (the status line
+                # is long gone), pin released by the caller, breaker fed
+                self.metrics.count("stream_errors")
+                self._note_outcome(rep, admission, ok=False)
+                self._event("stream_replica_lost", replica=rep.id,
+                            request_id=rid, error=type(e).__name__)
+                try:
+                    msg = json.dumps(
+                        {"error": "replica %d lost mid-stream: %s"
+                                  % (rep.id, type(e).__name__)}) + "\n"
+                    data = msg.encode("utf-8")
+                    handler.wfile.write(b"%x\r\n" % len(data))
+                    handler.wfile.write(data)
+                    handler.wfile.write(b"\r\n0\r\n\r\n")
+                except OSError:
+                    pass
+                handler.close_connection = True
+                self.metrics.record_request(time.monotonic() - t0,
+                                            ok=False)
+                return True
+            if client_gone:
+                # the consumer went away: close toward the replica too so
+                # its cancel sweep frees the KV slot; not a replica fault
+                self._note_outcome(rep, admission, ok=True)
+                handler.close_connection = True
+                return True
+            if not finished:
+                # EOF without a done/error line = replica vanished
+                # between chunks — same in-band contract
+                self.metrics.count("stream_errors")
+                self._note_outcome(rep, admission, ok=False)
+                self._event("stream_replica_lost", replica=rep.id,
+                            request_id=rid, error="eof")
+                try:
+                    msg = json.dumps(
+                        {"error": "replica %d lost mid-stream: eof"
+                                  % rep.id}) + "\n"
+                    data = msg.encode("utf-8")
+                    handler.wfile.write(b"%x\r\n" % len(data))
+                    handler.wfile.write(data)
+                    handler.wfile.write(b"\r\n0\r\n\r\n")
+                except OSError:
+                    pass
+                handler.close_connection = True
+                self.metrics.record_request(time.monotonic() - t0,
+                                            ok=False)
+                return True
+            try:
+                handler.wfile.write(b"0\r\n\r\n")
+            except OSError:
+                pass
+            self._note_outcome(rep, admission, ok=True)
+            self.metrics.record_request(time.monotonic() - t0, ok=True)
+            return True
+        finally:
+            conn.close()
+
+    # ---- drain / rolling restart ------------------------------------------
+    def mark_draining(self, rid, call_drain=True):
+        """Stop routing to replica ``rid`` (its in-flight requests and
+        pinned streams keep completing), and — with ``call_drain`` — tell
+        the replica itself via ``GET /drain`` so its own ``/healthz``
+        flips before any supervisor signal lands."""
+        rep = self.replica(rid)
+        if rep is None:
+            return None
+        with self._lock:
+            rep.state = DRAINING
+        self.metrics.count("drains")
+        self._event("replica_draining", replica=rid)
+        if call_drain:
+            try:
+                req = urllib.request.Request(rep.url + "/drain")
+                if self._admin_token:
+                    req.add_header("X-Admin-Token", self._admin_token)
+                with urllib.request.urlopen(
+                        req, timeout=self._connect_timeout_s) as r:
+                    r.read()
+            except Exception:
+                pass  # unreachable replica is already as drained as it gets
+        return rep
+
+    def wait_drained(self, rid, timeout_s=None, poll_s=0.02):
+        """Block until replica ``rid`` has zero gateway-tracked in-flight
+        requests and pinned streams (bounded). True on clean drain."""
+        if timeout_s is None:
+            timeout_s = _config.get("MXNET_GATEWAY_DRAIN_TIMEOUT_MS") / 1e3
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            rep = self.replica(rid)
+            if rep is None:
+                return True
+            with self._lock:
+                clear = rep.inflight == 0 and rep.pins == 0
+            if clear:
+                return True
+            time.sleep(poll_s)
+        return False
+
+    def readmit(self, rid, ready_timeout_s=60.0, poll_s=0.05):
+        """Health-gated readmission: poll the replica's ``/healthz``
+        until ``ok``, then route to it again (fresh breaker — the old
+        process's failure history doesn't taint the new one)."""
+        rep = self.replica(rid)
+        if rep is None:
+            return False
+        deadline = time.monotonic() + ready_timeout_s
+        while time.monotonic() < deadline:
+            out = self._scrape_replica(rep.url)
+            if out is not None and out[0] == "ok":
+                with self._lock:
+                    old = rep.breaker
+                    rep.breaker = self._mk_breaker(rep.id)
+                    rep.state = UP
+                    rep.health = "ok"
+                    rep.queue_depth = out[1]
+                    rep.failures = 0
+                    rep.generation += 1
+                old.deregister()
+                self._event("replica_readmitted", replica=rid,
+                            generation=rep.generation)
+                return True
+            time.sleep(poll_s)
+        self._event("readmit_timeout", replica=rid)
+        return False
+
+    def rolling_restart(self, backend=None, drain_timeout_s=None,
+                        ready_timeout_s=60.0):
+        """Drain-aware rolling restart of the whole fleet, one replica at
+        a time: mark draining (routing stops) → replica ``/drain`` →
+        wait for in-flight + pins to clear → ``backend.restart`` (lands
+        on the AOT zero-compile path when artifacts are published) →
+        health-gated readmission. Returns a per-replica report; zero
+        requests are dropped because traffic always has somewhere else
+        to go before the replica loses its listener."""
+        backend = backend or self._backend
+        if backend is None:
+            raise ValueError("rolling_restart needs a backend "
+                             "(spawn/restart/stop provider)")
+        report = []
+        for rid in sorted(r.id for r in self.replicas()):
+            rep = self.replica(rid)
+            if rep is None:
+                continue
+            t0 = time.monotonic()
+            self.mark_draining(rid)
+            drained = self.wait_drained(rid, timeout_s=drain_timeout_s)
+            self._event("replica_restarting", replica=rid,
+                        drained=drained)
+            try:
+                new_url = backend.restart(rep)
+            except Exception as e:
+                # the old process is already gone — don't leave the
+                # replica parked in DRAINING (which both routing AND the
+                # supervisor's crash watch skip forever): back to
+                # JOINING, so a supervisor respawns the dead process and
+                # the scrape loop health-gates any comeback to UP
+                with self._lock:
+                    rep.state = JOINING
+                self._event("restart_failed", replica=rid,
+                            error="%s: %s" % (type(e).__name__, e))
+                report.append({"replica": rid, "ok": False,
+                               "error": str(e)})
+                continue
+            if new_url:
+                with self._lock:
+                    rep.url = new_url.rstrip("/")
+            ok = self.readmit(rid, ready_timeout_s=ready_timeout_s)
+            report.append({"replica": rid, "ok": ok,
+                           "drained": drained,
+                           "seconds": time.monotonic() - t0})
+        self.metrics.count("rolling_restarts")
+        self._event("rolling_restart_done",
+                    ok=all(r["ok"] for r in report))
+        return report
+
+    # ---- surface ----------------------------------------------------------
+    def health(self):
+        """Gateway ``/healthz``: ``ok`` while at least one replica is
+        routable, ``degraded`` otherwise — the signal an outer LB (or a
+        human) keys off; per-replica detail rides along."""
+        table = self.replica_table()
+        ready = sum(1 for r in table.values()
+                    if r["state"] == UP and r["health"] == "ok"
+                    and r["breaker"] != "open")
+        return {"status": "ok" if ready > 0 else "degraded",
+                "ready_replicas": ready, "replicas": table}
+
+    @property
+    def address(self):
+        return self._httpd.server_address[:2]
+
+    @property
+    def url(self):
+        host, port = self.address
+        return "http://%s:%d" % (host, port)
+
+    def start(self):
+        """Serve in a background thread (plus the load scraper, unless
+        ``scrape_ms=0``); one synchronous scrape runs first so initial
+        replicas can come up before the first request arrives."""
+        if self._thread is None:
+            if self._replicas:
+                try:
+                    self.scrape_once()
+                except Exception:
+                    pass
+            if self._scrape_s > 0 and self._scrape_thread is None:
+                self._scrape_thread = threading.Thread(
+                    target=self._scrape_loop, daemon=True,
+                    name="gateway-scraper")
+                self._scrape_thread.start()
+            self._thread = threading.Thread(
+                target=self._httpd.serve_forever, daemon=True,
+                name="gateway")
+            self._thread.start()
+        return self
+
+    def close(self):
+        self._closing = True
+        self._scrape_wake.set()
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(5.0)
+            self._thread = None
+        if self._scrape_thread is not None:
+            self._scrape_thread.join(5.0)
+            self._scrape_thread = None
+        with self._lock:
+            reps = list(self._replicas.values())
+        for rep in reps:
+            rep.breaker.deregister()
+        self.metrics.unbind_profiler()
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+# ---------------------------------------------------------------------------
+# autoscaler
+# ---------------------------------------------------------------------------
+
+class Autoscaler:
+    """Grow/shrink the replica set on queue-depth / p99-SLO burn.
+
+    Signals (evaluated per :meth:`tick` — production runs ticks on a
+    background thread every ``interval_s``; tests call :meth:`tick`
+    directly, so schedules are asserted without sleeping):
+
+    - **burn**: gateway-observed p99 over the sliding window above
+      ``slo_p99_ms`` (``MXNET_GATEWAY_SLO_P99_MS``; 0 disables), OR mean
+      scraped queue depth per ready replica above ``queue_high``
+      (``MXNET_GATEWAY_QUEUE_HIGH``). ``burn_ticks`` consecutive burn
+      ticks → spawn one replica through the backend (it joins
+      health-gated, like any other replica).
+    - **idle**: p99 under half the SLO and queue depth ≤ 1 for
+      ``idle_ticks`` consecutive ticks → drain one replica through the
+      same drain machinery rolling restarts use, then ``backend.stop``.
+
+    Hysteresis: every action resets both streaks (one decision per
+    sustained signal, not one per tick), and the set never leaves
+    ``[min_replicas, max_replicas]``.
+    """
+
+    def __init__(self, gateway, backend=None, min_replicas=None,
+                 max_replicas=None, slo_p99_ms=None, queue_high=None,
+                 burn_ticks=3, idle_ticks=6, interval_s=1.0):
+        self.gateway = gateway
+        self.backend = backend or gateway._backend
+        if self.backend is None:
+            raise ValueError("Autoscaler needs a backend (spawn/stop)")
+        self.min_replicas = (_config.get("MXNET_GATEWAY_MIN_REPLICAS")
+                             if min_replicas is None else int(min_replicas))
+        self.max_replicas = (_config.get("MXNET_GATEWAY_MAX_REPLICAS")
+                             if max_replicas is None else int(max_replicas))
+        self.slo_p99_ms = (_config.get("MXNET_GATEWAY_SLO_P99_MS")
+                           if slo_p99_ms is None else float(slo_p99_ms))
+        self.queue_high = (_config.get("MXNET_GATEWAY_QUEUE_HIGH")
+                           if queue_high is None else int(queue_high))
+        self.burn_ticks = int(burn_ticks)
+        self.idle_ticks = int(idle_ticks)
+        self.interval_s = float(interval_s)
+        self._burn = 0
+        self._idle = 0
+        self._thread = None
+        self._stop = threading.Event()
+
+    # ---- signals ----------------------------------------------------------
+    def evaluate(self):
+        """Current signal values (no side effects): the decision a
+        :meth:`tick` would act on — exposed for tests and the event
+        log."""
+        gw = self.gateway
+        ready = gw.ready_replicas()
+        n = len(ready)
+        p99 = gw.metrics.p99_ms()
+        mean_q = (sum(r.queue_depth for r in ready) / n) if n else 0.0
+        slo_burn = self.slo_p99_ms > 0 and p99 > self.slo_p99_ms
+        queue_burn = n > 0 and mean_q > self.queue_high
+        idle = (mean_q <= 1.0
+                and (self.slo_p99_ms <= 0 or p99 < self.slo_p99_ms / 2))
+        return {"ready": n, "total": len(gw.replicas()), "p99_ms": p99,
+                "mean_queue_depth": mean_q, "slo_burn": slo_burn,
+                "queue_burn": queue_burn, "idle": idle}
+
+    def tick(self):
+        """One evaluation step; applies at most one scale action.
+        Returns ("up"|"down"|None, signals)."""
+        sig = self.evaluate()
+        action = None
+        if sig["slo_burn"] or sig["queue_burn"]:
+            self._burn += 1
+            self._idle = 0
+            if self._burn >= self.burn_ticks \
+                    and sig["total"] < self.max_replicas:
+                action = "up"
+        elif sig["idle"] and sig["ready"] > 0:
+            self._idle += 1
+            self._burn = 0
+            if self._idle >= self.idle_ticks \
+                    and sig["ready"] > self.min_replicas:
+                action = "down"
+        else:
+            self._burn = 0
+            self._idle = 0
+        if action == "up":
+            self.scale_up(reason=sig)
+        elif action == "down":
+            self.scale_down(reason=sig)
+        return action, sig
+
+    # ---- actions ----------------------------------------------------------
+    def scale_up(self, reason=None):
+        """Spawn one replica through the backend; it joins health-gated
+        (no traffic until its ``/healthz`` turns ok)."""
+        spawned = self.backend.spawn()
+        url, meta = spawned if isinstance(spawned, tuple) else (spawned,
+                                                                None)
+        rep = self.gateway.add_replica(url, meta=meta)
+        self.gateway.metrics.count("scale_ups")
+        self.gateway._event("scale_up", replica=rep.id, url=rep.url,
+                            signals=reason)
+        self._burn = 0
+        self._idle = 0
+        return rep
+
+    def scale_down(self, reason=None):
+        """Drain the least-loaded ready replica (same machinery as the
+        rolling restart) and stop it through the backend."""
+        gw = self.gateway
+        ready = gw.ready_replicas()
+        if len(ready) <= self.min_replicas:
+            return None
+        # least-loaded loses: its in-flight set is the cheapest to drain
+        victim = sorted(ready, key=lambda r: (gw._score(r), -r.id))[0]
+        gw.mark_draining(victim.id)
+        gw.wait_drained(victim.id)
+        try:
+            self.backend.stop(victim)
+        except Exception as e:
+            gw._event("scale_down_failed", replica=victim.id,
+                      error="%s: %s" % (type(e).__name__, e))
+        gw.remove_replica(victim.id)
+        gw.metrics.count("scale_downs")
+        gw._event("scale_down", replica=victim.id, signals=reason)
+        self._burn = 0
+        self._idle = 0
+        return victim
+
+    # ---- background loop --------------------------------------------------
+    def start(self):
+        if self._thread is None:
+            self._stop.clear()
+            self._thread = threading.Thread(target=self._loop,
+                                            daemon=True,
+                                            name="gateway-autoscaler")
+            self._thread.start()
+        return self
+
+    def _loop(self):
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.tick()
+            except Exception:
+                pass  # one bad tick must not kill the control loop
+
+    def close(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(5.0)
+            self._thread = None

@@ -220,6 +220,15 @@ class ServingMetrics:
             prefix + ".expired": (c["expired"], 0.0),
             prefix + ".worker_errors": (c["worker_errors"], 0.0),
         }
+        if self._queue_depth_fn is not None:
+            # live predict-lane backlog (generation lanes already export
+            # theirs): the gateway's primary least-loaded routing signal,
+            # visible in the aggregate table and scraped off /metrics
+            try:
+                rows[prefix + ".queue_depth"] = \
+                    (int(self._queue_depth_fn()), 0.0)
+            except Exception:
+                pass
         if self._cache_stats_fn is not None:
             try:
                 cs = self._cache_stats_fn() or {}

@@ -78,6 +78,43 @@ from .metrics import ServingMetrics
 __all__ = ["ModelServer"]
 
 
+def read_post_body(handler):
+    """Read a POST body off ``handler`` (any handler with ``_reply``)
+    with HTTP/1.1 keep-alive discipline — shared by ``ModelServer`` and
+    the gateway so the body rules can't drift apart:
+
+    - consume the body FIRST: an early reply with the body still unread
+      desyncs keep-alive (the next request on the connection would be
+      parsed starting at the leftover body bytes);
+    - the client-declared Content-Length is untrusted: never buffer more
+      than ``MXNET_HTTP_MAX_BODY`` — still CONSUME an oversized body (in
+      bounded chunks) before the 413 so the connection stays in sync.
+
+    Returns the body bytes, or None after having replied on failure."""
+    try:
+        length = int(handler.headers.get("Content-Length", 0))
+        if length < 0:  # read(-1) would block until client EOF
+            raise ValueError("negative Content-Length")
+    except (ValueError, TypeError):
+        handler.close_connection = True  # unknown length: can't resync
+        handler._reply(400, {"error": "bad Content-Length"})
+        return None
+    max_body = _config.get("MXNET_HTTP_MAX_BODY")
+    if max_body > 0 and length > max_body:
+        remaining = length
+        while remaining > 0:
+            chunk = handler.rfile.read(min(remaining, 1 << 16))
+            if not chunk:  # client gave up mid-body: can't resync
+                handler.close_connection = True
+                break
+            remaining -= len(chunk)
+        handler._reply(413, {"error": "request body %d bytes exceeds "
+                                      "MXNET_HTTP_MAX_BODY=%d"
+                                      % (length, max_body)})
+        return None
+    return handler.rfile.read(length)
+
+
 class _Handler(BaseHTTPRequestHandler):
     server_version = "mxnet_tpu_serving/0.1"
     protocol_version = "HTTP/1.1"
@@ -121,6 +158,16 @@ class _Handler(BaseHTTPRequestHandler):
         path, _, query = self.path.partition("?")
         if path == "/healthz":
             self._reply(200, srv.health())
+        elif path == "/drain":
+            # admin-only: flips /healthz to "draining" so a fronting
+            # gateway stops routing here BEFORE the supervisor sends
+            # SIGTERM — the first half of a zero-drop rolling restart
+            if not self._admin_ok():
+                self._reply(403, {"error": "admin endpoint: missing or "
+                                           "bad X-Admin-Token"})
+                return
+            srv.begin_drain()
+            self._reply(202, {"status": "draining"})
         elif path == "/metrics.prom" or (
                 path == "/metrics" and "format=prometheus" in query):
             from ..observability import export_prom as _prom
@@ -130,6 +177,16 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply(200, srv.metrics.snapshot())
         else:
             self._reply(404, {"error": "unknown path %s" % self.path})
+
+    def _admin_ok(self):
+        """Admin-endpoint guard: when ``MXNET_SERVING_ADMIN_TOKEN`` is
+        set, the request must carry it in ``X-Admin-Token``; empty token
+        leaves the endpoint open (dev/test topologies where the gateway
+        and replicas share a trust boundary)."""
+        token = _config.get("MXNET_SERVING_ADMIN_TOKEN")
+        if not token:
+            return True
+        return self.headers.get("X-Admin-Token") == token
 
     def do_POST(self):  # noqa: N802
         # the request id propagates: honored from the client's header
@@ -159,35 +216,9 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _handle_post(self, rid):
         srv = self.server.model_server
-        # consume the body FIRST: an early reply with the body still unread
-        # desyncs HTTP/1.1 keep-alive (the next request on the connection
-        # would be parsed starting at the leftover body bytes)
-        try:
-            length = int(self.headers.get("Content-Length", 0))
-            if length < 0:  # read(-1) would block until client EOF
-                raise ValueError("negative Content-Length")
-        except (ValueError, TypeError):
-            self.close_connection = True  # unknown length: can't resync
-            self._reply(400, {"error": "bad Content-Length"})
+        body = read_post_body(self)
+        if body is None:
             return
-        max_body = _config.get("MXNET_HTTP_MAX_BODY")
-        if max_body > 0 and length > max_body:
-            # client-declared Content-Length is untrusted input: never
-            # buffer an arbitrarily large body. Still CONSUME it (in
-            # bounded chunks) before the 413 so the keep-alive connection
-            # stays in sync for the next request.
-            remaining = length
-            while remaining > 0:
-                chunk = self.rfile.read(min(remaining, 1 << 16))
-                if not chunk:  # client gave up mid-body: can't resync
-                    self.close_connection = True
-                    break
-                remaining -= len(chunk)
-            self._reply(413, {"error": "request body %d bytes exceeds "
-                                       "MXNET_HTTP_MAX_BODY=%d"
-                                       % (length, max_body)})
-            return
-        body = self.rfile.read(length)
         path, model_name = self._split_model_path(self.path)
         if path == "/generate":
             self._handle_generate(rid, srv, body, model_name)
@@ -591,6 +622,19 @@ class _Handler(BaseHTTPRequestHandler):
             self.close_connection = True
 
 
+class _QuietThreadingHTTPServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer that doesn't traceback-spam stderr when a
+    client disconnects mid-reply (timed-out health probe, closed
+    browser) — routine under load balancers, not a server fault."""
+
+    def handle_error(self, request, client_address):
+        import sys
+        exc = sys.exc_info()[1]
+        if isinstance(exc, (BrokenPipeError, ConnectionResetError)):
+            return
+        super().handle_error(request, client_address)
+
+
 class ModelServer:
     """Wire engine + batcher + metrics + breaker behind one HTTP listener.
 
@@ -729,12 +773,13 @@ class ModelServer:
                 raise ValueError("artifacts_dir= needs a /predict engine")
             self._load_artifacts(artifacts_dir)
         self._draining = False
+        self._stop_started = False
         self.batcher = None if self.engine is None else DynamicBatcher(
             self.engine, max_batch_size=max_batch_size,
             max_latency_ms=max_latency_ms, max_queue_size=max_queue_size,
             default_timeout_ms=default_timeout_ms, metrics=self.metrics,
             retry_policy=retry_policy)
-        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd = _QuietThreadingHTTPServer((host, port), _Handler)
         self._httpd.daemon_threads = True
         self._httpd.model_server = self
         self._thread = None
@@ -769,6 +814,67 @@ class ModelServer:
     @property
     def draining(self):
         return self._draining
+
+    def begin_drain(self):
+        """Flip this server to ``draining``: ``/healthz`` reports it (so
+        gateways/LBs stop routing here), new POSTs are shed with 503,
+        and in-flight work keeps completing. The listener stays up —
+        pair with :meth:`stop` (or the SIGTERM handler) to actually shut
+        down once traffic has moved away."""
+        self._draining = True
+
+    def install_drain_handler(self, signals=None, grace_ms=None,
+                              on_stopped=None):
+        """Wire the bounded-drain :meth:`stop` to process signals so a
+        supervised replica killed by its supervisor (rolling restart,
+        autoscale shrink, preemption) always drains instead of dropping
+        in-flight requests.
+
+        Same grace-window semantics as
+        :class:`~mxnet_tpu.resilience.elastic.PreemptionHandler`:
+        ``grace_ms`` (default ``MXNET_ELASTIC_GRACE_MS``) bounds how long
+        the drain may take — the supervisor's SIGKILL follow-up must
+        never land while waiters are still blocked. The handler flips
+        :attr:`draining` immediately (``/healthz`` degrades before any
+        slow teardown), then runs ``stop(drain=True)`` on a background
+        thread and finally calls ``on_stopped()`` (e.g. ``sys.exit``).
+
+        Signal dispositions are process-global: install from the main
+        thread only, one server per process. Returns self. Idempotent
+        per server; repeated signals don't restart the drain."""
+        import signal as _signal
+        if grace_ms is None:
+            grace_ms = _config.get("MXNET_ELASTIC_GRACE_MS")
+        self._drain_grace_s = float(grace_ms) / 1e3
+        self._drain_on_stopped = on_stopped
+        for s in (signals if signals is not None else (_signal.SIGTERM,)):
+            _signal.signal(s, self._on_drain_signal)
+        return self
+
+    def _on_drain_signal(self, signum, frame):
+        # async-signal path: flag writes + one thread spawn only.
+        # Keyed on _stop_started, NOT on draining: a replica that was
+        # told to /drain first (the rolling-restart order) must still
+        # honor the SIGTERM that follows
+        if getattr(self, "_stop_started", False):
+            return  # stop already under way; don't restart it
+        self._stop_started = True
+        self._draining = True
+        t = threading.Thread(target=self._drain_and_stop,
+                             name="model-server-drain", daemon=True)
+        t.start()
+
+    def _drain_and_stop(self):
+        # leave a margin inside the grace window: the drain must finish
+        # (and stragglers be failed with typed ServerClosed) before the
+        # supervisor's SIGKILL follow-up can land
+        timeout = max(0.1, getattr(self, "_drain_grace_s", 10.0) * 0.8)
+        try:
+            self.stop(drain=True, timeout=timeout)
+        finally:
+            cb = getattr(self, "_drain_on_stopped", None)
+            if cb is not None:
+                cb()
 
     def prometheus_text(self):
         """The ``GET /metrics.prom`` body (Prometheus text format):
@@ -850,6 +956,7 @@ class ModelServer:
         out over the still-open listener — and only then stop the
         listener. ``drain=False`` fails queued work immediately with
         ``ServerClosed``."""
+        self._stop_started = True
         self._draining = True
         if self.generator is not None:
             # in-flight sequences finish streaming over the still-open

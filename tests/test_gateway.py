@@ -1,0 +1,1101 @@
+"""Horizontal-serving gateway tests (ISSUE 11).
+
+Coverage: least-loaded routing under skewed load, health-gated
+admission, request-id/model-version propagation across failover, sticky
+``/generate`` streams (pin + mid-stream replica loss → in-band error),
+per-replica breaker ejection/readmission, abrupt replica loss under
+``/predict`` load with ZERO client-visible errors, drain-aware rolling
+restart with zero drops, the SLO-burn autoscaler on fake ticks, the
+``/drain`` + SIGTERM satellites, and the queue-depth gauge satellite.
+
+Two replica flavors:
+
+- **stub replicas** — a pure-stdlib fake of ``ModelServer``'s HTTP
+  surface with deterministic health/load/latency and scriptable death
+  (the gateway only ever sees HTTP, so routing/failover/stream logic is
+  fully exercisable without XLA);
+- **real replicas** — in-process :class:`ModelServer` instances for the
+  end-to-end paths (correctness of proxied predictions, drain
+  semantics, rolling restart).
+"""
+import http.client
+import json
+import signal
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from mxnet_tpu import nd
+from mxnet_tpu.resilience import chaos
+from mxnet_tpu.resilience.retry import RetryPolicy
+from mxnet_tpu.serving import (Autoscaler, Gateway, GatewayMetrics,
+                               ModelServer, ServingMetrics)
+
+D_IN, D_OUT = 8, 3
+_W = np.linspace(-1, 1, D_IN * D_OUT).reshape(D_IN, D_OUT).astype("float32")
+
+
+def _linear(x):
+    return nd.dot(x, nd.array(_W))
+
+
+def _ref(x):
+    return np.asarray(x, "float32") @ _W
+
+
+@pytest.fixture(autouse=True)
+def _disarm_chaos():
+    chaos.clear()
+    yield
+    chaos.clear()
+
+
+# ---------------------------------------------------------------------------
+# stub replica: ModelServer's HTTP surface, scripted
+# ---------------------------------------------------------------------------
+
+class StubReplica:
+    """Controllable fake backend. Mutate the public attributes at any
+    time; every handler reads them live."""
+
+    def __init__(self, name="stub", health="ok", queue_depth=0,
+                 predict_status=200, predict_close=False, delay_s=0.0,
+                 model_version=None, gen_tokens=3, gen_delay_s=0.0,
+                 gen_die_after=None, gen_status=200):
+        self.name = name
+        self.health = health
+        self.queue_depth = queue_depth
+        self.predict_status = predict_status
+        self.predict_close = predict_close   # abrupt socket close
+        self.delay_s = delay_s
+        self.model_version = model_version
+        self.gen_tokens = gen_tokens
+        self.gen_delay_s = gen_delay_s
+        self.gen_die_after = gen_die_after   # close mid-stream after N
+        self.gen_status = gen_status
+        self.predict_calls = 0
+        self.generate_calls = 0
+        self.seen_request_ids = []
+        self.drained = False
+        stub = self
+
+        class H(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                pass
+
+            def _send(self, code, payload, headers=None):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                path = self.path.split("?", 1)[0]
+                if path == "/healthz":
+                    self._send(200, {"status": stub.health})
+                elif path == "/metrics":
+                    self._send(200, {"queue_depth": stub.queue_depth})
+                elif path == "/drain":
+                    stub.drained = True
+                    stub.health = "draining"
+                    self._send(202, {"status": "draining"})
+                else:
+                    self._send(404, {"error": "nope"})
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", 0))
+                self.rfile.read(length)
+                rid = self.headers.get("X-Request-Id")
+                stub.seen_request_ids.append(rid)
+                if self.path.startswith("/generate"):
+                    self._generate(rid)
+                    return
+                stub.predict_calls += 1
+                if stub.delay_s:
+                    time.sleep(stub.delay_s)
+                if stub.predict_close:
+                    # replica vanishes mid-request: reset, no reply
+                    self.connection.close()
+                    self.close_connection = True
+                    return
+                headers = {}
+                if rid:
+                    headers["X-Request-Id"] = rid
+                if stub.model_version:
+                    headers["X-Model-Version"] = stub.model_version
+                code = stub.predict_status
+                if code != 200:
+                    self._send(code, {"error": "scripted %d" % code},
+                               headers=headers)
+                else:
+                    self._send(200, {"output": [1.0], "replica": stub.name},
+                               headers=headers)
+
+            def _chunk(self, obj):
+                data = (json.dumps(obj) + "\n").encode()
+                self.wfile.write(b"%x\r\n" % len(data))
+                self.wfile.write(data)
+                self.wfile.write(b"\r\n")
+                self.wfile.flush()
+
+            def _generate(self, rid):
+                stub.generate_calls += 1
+                if stub.gen_status != 200:
+                    self._send(stub.gen_status,
+                               {"error": "scripted %d" % stub.gen_status})
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", "application/x-ndjson")
+                if rid:
+                    self.send_header("X-Request-Id", rid)
+                if stub.model_version:
+                    self.send_header("X-Model-Version",
+                                     stub.model_version)
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+                for i in range(stub.gen_tokens):
+                    if stub.gen_die_after is not None \
+                            and i >= stub.gen_die_after:
+                        # replica dies mid-stream: abrupt close, no
+                        # terminal chunk
+                        self.connection.close()
+                        self.close_connection = True
+                        return
+                    if stub.gen_delay_s:
+                        time.sleep(stub.gen_delay_s)
+                    self._chunk({"token": 100 + i, "index": i,
+                                 "replica": stub.name})
+                self._chunk({"done": True, "n_tokens": stub.gen_tokens,
+                             "reason": "length"})
+                self.wfile.write(b"0\r\n\r\n")
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="stub-replica-%s" % name)
+        self._thread.start()
+
+    @property
+    def url(self):
+        return "http://%s:%d" % self._httpd.server_address[:2]
+
+    def kill(self):
+        """Abrupt full death: listener gone, no more replies."""
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(5.0)
+
+    close = kill
+
+
+def _fast_retry(**kw):
+    """A no-sleep failover policy so tests never wait on backoff."""
+    kw.setdefault("max_attempts", 4)
+    kw.setdefault("base_delay_ms", 0.0)
+    kw.setdefault("jitter", 0.0)
+    return RetryPolicy(name="retry.gateway.test", register=False,
+                       sleep=lambda s: None, **kw)
+
+
+def _mk_gateway(stubs, **kw):
+    kw.setdefault("scrape_ms", 0)  # tests drive scrape_once() by hand
+    kw.setdefault("retry_policy", _fast_retry())
+    gw = Gateway(replicas=[s.url for s in stubs], **kw)
+    gw.start()
+    return gw
+
+
+def _post(url, payload, rid=None, timeout=10):
+    data = json.dumps(payload).encode()
+    headers = {"Content-Type": "application/json"}
+    if rid:
+        headers["X-Request-Id"] = rid
+    req = urllib.request.Request(url, data=data, headers=headers)
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.status, dict(resp.headers), json.loads(resp.read())
+
+
+def _get(url, timeout=5, headers=None):
+    req = urllib.request.Request(url, headers=headers or {})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def _stream(url, payload, rid=None, timeout=10):
+    """POST /generate and collect the NDJSON lines."""
+    import urllib.parse
+    u = urllib.parse.urlsplit(url)
+    conn = http.client.HTTPConnection(u.hostname, u.port, timeout=timeout)
+    body = json.dumps(payload).encode()
+    headers = {"Content-Type": "application/json",
+               "Content-Length": str(len(body))}
+    if rid:
+        headers["X-Request-Id"] = rid
+    conn.request("POST", "/generate", body=body, headers=headers)
+    resp = conn.getresponse()
+    lines = []
+    if resp.status == 200:
+        while True:
+            line = resp.readline()
+            if not line:
+                break
+            lines.append(json.loads(line))
+            if lines[-1].get("done") or lines[-1].get("error"):
+                break
+    else:
+        lines.append(json.loads(resp.read()))
+    status, hdrs = resp.status, dict(resp.headers)
+    conn.close()
+    return status, hdrs, lines
+
+
+def _wait_unpinned(gw, timeout_s=5.0):
+    """The client sees the done/error line strictly before the gateway
+    thread can run its unpin, so pin release is an asynchronous
+    postcondition — wait for it (bounded) instead of sampling the race."""
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        if all(r.pins == 0 for r in gw.replicas()):
+            return True
+        time.sleep(0.005)
+    return False
+
+
+# ---------------------------------------------------------------------------
+# admission, scraping, routing
+# ---------------------------------------------------------------------------
+
+def test_health_gated_admission_and_scrape():
+    a = StubReplica("a")
+    b = StubReplica("b", health="degraded", queue_depth=5)
+    gw = _mk_gateway([a, b])
+    try:
+        table = gw.replica_table()
+        # a: joining -> up on its first healthy scrape (start() scraped);
+        # b: degraded never promotes out of joining
+        sa = [r for r in table.values() if r["url"] == a.url][0]
+        sb = [r for r in table.values() if r["url"] == b.url][0]
+        assert sa["state"] == "up" and sa["health"] == "ok"
+        assert sb["state"] == "joining" and sb["health"] == "degraded"
+        assert sb["queue_depth"] == 5
+        b.health = "ok"
+        gw.scrape_once()
+        sb = [r for r in gw.replica_table().values()
+              if r["url"] == b.url][0]
+        assert sb["state"] == "up"
+        # full death is visible as health=down after a scrape
+        b.kill()
+        gw.scrape_once()
+        sb = [r for r in gw.replica_table().values()
+              if r["url"] == b.url][0]
+        assert sb["health"] == "down"
+        assert any(e["event"] == "replica_down" for e in gw.events())
+    finally:
+        gw.close()
+        a.kill()
+
+
+def test_least_loaded_routing_skews_away_from_backlog():
+    a = StubReplica("a", queue_depth=10)
+    b = StubReplica("b", queue_depth=0)
+    gw = _mk_gateway([a, b])
+    try:
+        for _ in range(8):
+            status, _, body = _post(gw.url + "/predict", {"data": [1.0]})
+            assert status == 200 and body["replica"] == "b"
+        assert b.predict_calls == 8 and a.predict_calls == 0
+        # load flips: the routing follows the scraped signal
+        a.queue_depth, b.queue_depth = 0, 10
+        gw.scrape_once()
+        for _ in range(4):
+            _, _, body = _post(gw.url + "/predict", {"data": [1.0]})
+            assert body["replica"] == "a"
+    finally:
+        gw.close()
+        a.kill()
+        b.kill()
+
+
+def test_equal_load_spreads_over_replicas():
+    a = StubReplica("a")
+    b = StubReplica("b")
+    gw = _mk_gateway([a, b])
+    try:
+        for _ in range(10):
+            _post(gw.url + "/predict", {"data": [1.0]})
+        # routed-count tiebreak alternates instead of hammering one host
+        assert a.predict_calls == 5 and b.predict_calls == 5
+    finally:
+        gw.close()
+        a.kill()
+        b.kill()
+
+
+def test_draining_replica_takes_no_new_requests():
+    a = StubReplica("a")
+    b = StubReplica("b")
+    gw = _mk_gateway([a, b])
+    try:
+        rid_a = [r for r in gw.replicas() if r.url == a.url][0].id
+        gw.mark_draining(rid_a)
+        assert a.drained  # gateway told the replica itself via /drain
+        for _ in range(6):
+            _, _, body = _post(gw.url + "/predict", {"data": [1.0]})
+            assert body["replica"] == "b"
+        assert a.predict_calls == 0
+    finally:
+        gw.close()
+        a.kill()
+        b.kill()
+
+
+# ---------------------------------------------------------------------------
+# failover + propagation
+# ---------------------------------------------------------------------------
+
+def test_request_id_survives_failover_retry():
+    """Satellite regression: a client-supplied X-Request-Id rides the
+    failover retry — the replica that finally serves it and the reply
+    both carry the original id (trace stitching key)."""
+    a = StubReplica("a", predict_close=True)   # dies on every request
+    b = StubReplica("b")
+    gw = _mk_gateway([a, b])
+    try:
+        # force a to be tried first (lower load)
+        b.queue_depth = 3
+        gw.scrape_once()
+        status, headers, body = _post(gw.url + "/predict",
+                                      {"data": [1.0]}, rid="rid-e2e-42")
+        assert status == 200 and body["replica"] == "b"
+        assert headers["X-Request-Id"] == "rid-e2e-42"
+        assert "rid-e2e-42" in a.seen_request_ids   # first attempt
+        assert "rid-e2e-42" in b.seen_request_ids   # failover attempt
+        assert gw.metrics.snapshot()["failovers"] >= 1
+    finally:
+        gw.close()
+        a.kill()
+        b.kill()
+
+
+def test_model_version_header_echoed_end_to_end():
+    a = StubReplica("a", model_version="bert=v7")
+    gw = _mk_gateway([a])
+    try:
+        _, headers, _ = _post(gw.url + "/predict", {"data": [1.0]})
+        assert headers["X-Model-Version"] == "bert=v7"
+    finally:
+        gw.close()
+        a.kill()
+
+
+def test_4xx_passes_through_without_failover():
+    a = StubReplica("a", predict_status=400)
+    b = StubReplica("b")
+    gw = _mk_gateway([a, b])
+    try:
+        b.queue_depth = 3
+        gw.scrape_once()
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(gw.url + "/predict", {"data": [1.0]})
+        assert ei.value.code == 400
+        snap = gw.metrics.snapshot()
+        assert snap["failovers"] == 0
+        assert b.predict_calls == 0  # client errors are not replica faults
+    finally:
+        gw.close()
+        a.kill()
+        b.kill()
+
+
+def test_5xx_fails_over_and_ejects_flapping_replica():
+    a = StubReplica("a", predict_status=500)
+    b = StubReplica("b")
+    gw = _mk_gateway([a, b], eject_failures=3)
+    try:
+        b.queue_depth = 3
+        gw.scrape_once()
+        for _ in range(6):
+            status, _, body = _post(gw.url + "/predict", {"data": [1.0]})
+            assert status == 200 and body["replica"] == "b"
+        # a burned its 3 breaker failures, then stopped being offered
+        assert a.predict_calls == 3
+        snap = gw.metrics.snapshot()
+        assert snap["ejections"] == 1 and snap["failovers"] >= 3
+        assert any(e["event"] == "replica_ejected" for e in gw.events())
+        table = gw.replica_table()
+        assert [r for r in table.values()
+                if r["url"] == a.url][0]["breaker"] == "open"
+    finally:
+        gw.close()
+        a.kill()
+        b.kill()
+
+
+def test_ejected_replica_readmitted_via_half_open_probe():
+    t = [1000.0]
+    a = StubReplica("a", predict_status=500)
+    b = StubReplica("b")
+    gw = _mk_gateway([a, b], eject_failures=2, eject_recovery_ms=5000.0,
+                     clock=lambda: t[0])
+    try:
+        b.queue_depth = 3
+        gw.scrape_once()
+        for _ in range(3):
+            _post(gw.url + "/predict", {"data": [1.0]})
+        assert a.predict_calls == 2  # ejected after 2 failures
+        a.predict_status = 200       # replica healed
+        for _ in range(3):           # still inside recovery window
+            _, _, body = _post(gw.url + "/predict", {"data": [1.0]})
+            assert body["replica"] == "b"
+        assert a.predict_calls == 2
+        t[0] += 6.0                  # recovery elapses -> half-open probe
+        _post(gw.url + "/predict", {"data": [1.0]})
+        assert a.predict_calls == 3  # the probe went to a
+        snap = gw.metrics.snapshot()
+        assert snap["readmissions"] == 1
+        assert any(e["event"] == "replica_readmitted"
+                   for e in gw.events())
+        table = gw.replica_table()
+        assert [r for r in table.values()
+                if r["url"] == a.url][0]["breaker"] == "closed"
+    finally:
+        gw.close()
+        a.kill()
+        b.kill()
+
+
+def test_eject_failures_zero_disables_ejection():
+    """Knob contract: MXNET_GATEWAY_EJECT_FAILURES<=0 disables ejection
+    — a flapping replica keeps being offered (and failed over), its
+    breaker never opens."""
+    a = StubReplica("a", predict_status=500)
+    b = StubReplica("b")
+    gw = _mk_gateway([a, b], eject_failures=0)
+    try:
+        b.queue_depth = 3            # a is preferred every time
+        gw.scrape_once()
+        for _ in range(8):
+            status, _, body = _post(gw.url + "/predict", {"data": [1.0]})
+            assert status == 200 and body["replica"] == "b"
+        assert a.predict_calls == 8  # never ejected, always retried
+        snap = gw.metrics.snapshot()
+        assert snap["ejections"] == 0
+        table = gw.replica_table()
+        assert [r for r in table.values()
+                if r["url"] == a.url][0]["breaker"] == "closed"
+    finally:
+        gw.close()
+        a.kill()
+        b.kill()
+
+
+def test_retry_policy_false_single_attempt_typed_503():
+    """retry_policy=False (failover disabled): a replica fault still
+    surfaces as a typed 503, never a dropped connection."""
+    a = StubReplica("a", predict_status=500)
+    gw = _mk_gateway([a], retry_policy=False)
+    try:
+        gw.scrape_once()
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(gw.url + "/predict", {"data": [1.0]})
+        assert ei.value.code == 503
+        assert ei.value.headers.get("Retry-After") is not None
+        assert a.predict_calls == 1  # single attempt, no retry
+    finally:
+        gw.close()
+        a.kill()
+
+
+def test_no_routable_replica_returns_503():
+    a = StubReplica("a", health="degraded")  # never admitted
+    gw = _mk_gateway([a])
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(gw.url + "/predict", {"data": [1.0]})
+        assert ei.value.code == 503
+        assert ei.value.headers.get("Retry-After") is not None
+        assert gw.metrics.snapshot()["no_replica"] >= 1
+    finally:
+        gw.close()
+        a.kill()
+
+
+@pytest.mark.chaos
+def test_gateway_forward_chaos_point_absorbed_by_retry():
+    a = StubReplica("a")
+    gw = _mk_gateway([a])
+    try:
+        chaos.arm("gateway.forward", "transient", first=1)
+        status, _, body = _post(gw.url + "/predict", {"data": [1.0]})
+        assert status == 200 and body["replica"] == "a"
+        assert chaos.stats()["gateway.forward"]["fires"] == 1
+    finally:
+        gw.close()
+        a.kill()
+
+
+# ---------------------------------------------------------------------------
+# replica loss under load: the zero-client-errors contract
+# ---------------------------------------------------------------------------
+
+def _hard_kill(srv):
+    """Kill a real in-process ModelServer the way a lost host dies: the
+    listener vanishes and queued work is dropped, no drain, no 503s
+    sent on purpose."""
+    srv._httpd.shutdown()
+    srv._httpd.server_close()
+    srv.batcher.close(drain=False)
+
+
+def test_replica_loss_under_predict_load_zero_client_errors():
+    """ISSUE acceptance: losing a replica while the gateway serves
+    concurrent /predict traffic costs ZERO client-visible errors —
+    every request either lands on the dead replica and is rerouted, or
+    never sees it."""
+    r1 = ModelServer(_linear, port=0, buckets=(1, 2, 4),
+                     max_latency_ms=1.0).start()
+    r2 = ModelServer(_linear, port=0, buckets=(1, 2, 4),
+                     max_latency_ms=1.0).start()
+    gw = _mk_gateway([], retry_policy=_fast_retry(max_attempts=6))
+    gw.add_replica(r1.url)
+    gw.add_replica(r2.url)
+    gw.scrape_once()
+    errors, oks = [], [0]
+    stop = threading.Event()
+    x = np.random.randn(D_IN).astype("float32")
+    expected = _ref(x[None])[0]
+
+    def client():
+        while not stop.is_set():
+            try:
+                status, _, body = _post(gw.url + "/predict",
+                                        {"data": x.tolist()})
+                assert status == 200
+                np.testing.assert_allclose(body["output"], expected,
+                                           rtol=1e-4, atol=1e-5)
+                oks[0] += 1
+            except Exception as e:  # noqa: BLE001 — counted, not raised
+                errors.append(repr(e))
+
+    threads = [threading.Thread(target=client) for _ in range(4)]
+    for t in threads:
+        t.start()
+    try:
+        time.sleep(0.3)
+        _hard_kill(r1)            # replica loss under load
+        time.sleep(0.7)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(10.0)
+    assert not errors, errors[:5]
+    assert oks[0] > 10   # CPU oracle: enough traffic to span the loss
+    assert gw.metrics.snapshot()["failovers"] >= 1
+    gw.close()
+    r2.stop()
+
+
+# ---------------------------------------------------------------------------
+# sticky /generate streams
+# ---------------------------------------------------------------------------
+
+def test_generate_stream_sticky_and_relayed():
+    a = StubReplica("a", gen_tokens=4, gen_delay_s=0.02)
+    b = StubReplica("b", gen_tokens=4, gen_delay_s=0.02)
+    gw = _mk_gateway([a, b])
+    try:
+        results = {}
+
+        def run(key):
+            results[key] = _stream(gw.url, {"prompt": [1, 2]},
+                                   rid="st-%s" % key)
+
+        t1 = threading.Thread(target=run, args=("one",))
+        t2 = threading.Thread(target=run, args=("two",))
+        t1.start()
+        # wait until stream one's pin is visible, so stream two's pick
+        # deterministically sees the pin-loaded replica
+        deadline = time.time() + 5.0
+        while time.time() < deadline \
+                and not any(r.pins for r in gw.replicas()):
+            time.sleep(0.005)
+        t2.start()
+        t1.join(10.0)
+        t2.join(10.0)
+        for key in ("one", "two"):
+            status, _, lines = results[key]
+            assert status == 200
+            assert lines[-1].get("done") is True
+            # sticky: every token line of one stream names ONE replica
+            replicas = {l["replica"] for l in lines if "token" in l}
+            assert len(replicas) == 1
+        # pin-aware load spread: concurrent streams took different
+        # replicas (stream two saw stream one's pin)
+        assert a.generate_calls == 1 and b.generate_calls == 1
+        # pins released after completion
+        assert _wait_unpinned(gw)
+        assert gw.metrics.snapshot()["streams"] == 2
+    finally:
+        gw.close()
+        a.kill()
+        b.kill()
+
+
+def test_generate_replica_death_mid_stream_in_band_error():
+    a = StubReplica("a", gen_tokens=6, gen_die_after=2,
+                    gen_delay_s=0.01)
+    gw = _mk_gateway([a])
+    try:
+        status, _, lines = _stream(gw.url, {"prompt": [1]})
+        assert status == 200  # stream had committed to 200 already
+        tokens = [l for l in lines if "token" in l]
+        assert len(tokens) == 2
+        assert "error" in lines[-1]
+        assert "lost mid-stream" in lines[-1]["error"]
+        snap = gw.metrics.snapshot()
+        assert snap["stream_errors"] == 1
+        assert _wait_unpinned(gw)  # pin released
+        assert any(e["event"] == "stream_replica_lost"
+                   for e in gw.events())
+    finally:
+        gw.close()
+        a.kill()
+
+
+def test_generate_pre_stream_failure_fails_over():
+    a = StubReplica("a", gen_status=500)
+    b = StubReplica("b", gen_tokens=3)
+    gw = _mk_gateway([a, b])
+    try:
+        b.queue_depth = 3
+        gw.scrape_once()
+        status, headers, lines = _stream(gw.url, {"prompt": [1]},
+                                         rid="gen-rid-1")
+        assert status == 200
+        assert headers["X-Request-Id"] == "gen-rid-1"
+        assert lines[-1].get("done") is True
+        assert {l["replica"] for l in lines if "token" in l} == {"b"}
+        assert gw.metrics.snapshot()["failovers"] >= 1
+    finally:
+        gw.close()
+        a.kill()
+        b.kill()
+
+
+# ---------------------------------------------------------------------------
+# drain-aware rolling restart
+# ---------------------------------------------------------------------------
+
+class ThreadBackend:
+    """In-process backend: replicas are ModelServer instances. restart()
+    gracefully stops the old server and brings up a fresh one (new
+    ephemeral port, like a respawned process would get)."""
+
+    def __init__(self, model=_linear, **server_kw):
+        self.model = model
+        self.server_kw = dict(buckets=(1, 2, 4), max_latency_ms=1.0)
+        self.server_kw.update(server_kw)
+        self.servers = {}
+        self.spawned = 0
+        self.stopped = 0
+
+    def spawn(self):
+        srv = ModelServer(self.model, port=0, **self.server_kw).start()
+        self.spawned += 1
+        self.servers[srv.url] = srv
+        return srv.url, {"server": srv}
+
+    def restart(self, replica):
+        old = (replica.meta or {}).get("server")
+        if old is not None:
+            old.stop(drain=True, timeout=5.0)
+            self.servers.pop(old.url, None)
+        url, meta = self.spawn()
+        replica.meta = meta
+        return url
+
+    def stop(self, replica):
+        srv = (replica.meta or {}).get("server")
+        if srv is not None:
+            srv.stop(drain=True, timeout=5.0)
+            self.servers.pop(srv.url, None)
+            self.stopped += 1
+
+    def close(self):
+        for srv in list(self.servers.values()):
+            srv.stop(drain=False)
+        self.servers.clear()
+
+
+def test_rolling_restart_zero_dropped_requests():
+    """ISSUE acceptance: a full rolling restart of every replica under
+    concurrent load completes with zero dropped requests."""
+    backend = ThreadBackend()
+    gw = _mk_gateway([], backend=backend,
+                     retry_policy=_fast_retry(max_attempts=6))
+    for _ in range(2):
+        url, meta = backend.spawn()
+        gw.add_replica(url, meta=meta)
+    gw.scrape_once()
+    errors, oks = [], [0]
+    stop = threading.Event()
+    x = np.random.randn(D_IN).astype("float32")
+
+    def client():
+        while not stop.is_set():
+            try:
+                status, _, _ = _post(gw.url + "/predict",
+                                     {"data": x.tolist()})
+                assert status == 200
+                oks[0] += 1
+            except Exception as e:  # noqa: BLE001
+                errors.append(repr(e))
+
+    threads = [threading.Thread(target=client) for _ in range(4)]
+    for t in threads:
+        t.start()
+    try:
+        time.sleep(0.2)
+        report = gw.rolling_restart(backend, ready_timeout_s=30.0)
+        time.sleep(0.2)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(10.0)
+    try:
+        assert not errors, errors[:5]
+        assert oks[0] > 10   # CPU oracle: enough traffic to span the restart
+        assert len(report) == 2 and all(r["ok"] for r in report)
+        assert all(r["drained"] for r in report)
+        # both replicas really were replaced and readmitted
+        assert backend.spawned == 4
+        table = gw.replica_table()
+        assert all(r["state"] == "up" and r["health"] == "ok"
+                   and r["generation"] == 1 for r in table.values())
+        kinds = [e["event"] for e in gw.events()]
+        assert kinds.count("replica_draining") == 2
+        assert kinds.count("replica_readmitted") == 2
+        assert "rolling_restart_done" in kinds
+        assert gw.metrics.snapshot()["rolling_restarts"] == 1
+    finally:
+        gw.close()
+        backend.close()
+
+
+def test_rolling_restart_waits_for_inflight_drain():
+    """The drain step holds the restart until in-flight work on the
+    draining replica finishes — a slow request outlives its replica's
+    restart trigger without being dropped."""
+    import mxnet_tpu.serving.gateway as gwmod
+
+    def slow(x):
+        time.sleep(0.25)
+        return _linear(x)
+
+    backend = ThreadBackend(model=slow)
+    gw = _mk_gateway([], backend=backend)
+    url, meta = backend.spawn()
+    rep = gw.add_replica(url, meta=meta)
+    gw.scrape_once()
+    assert rep.state == gwmod.UP
+    result = {}
+
+    def one_request():
+        x = np.random.randn(D_IN).astype("float32")
+        result["resp"] = _post(gw.url + "/predict", {"data": x.tolist()})
+
+    t = threading.Thread(target=one_request)
+    t.start()
+    time.sleep(0.08)          # request is in flight on the replica
+    report = gw.rolling_restart(backend, ready_timeout_s=30.0)
+    t.join(10.0)
+    try:
+        assert report[0]["ok"] and report[0]["drained"]
+        assert result["resp"][0] == 200   # in-flight request completed
+    finally:
+        gw.close()
+        backend.close()
+
+
+# ---------------------------------------------------------------------------
+# autoscaler (fake ticks: no sleeping, no background thread)
+# ---------------------------------------------------------------------------
+
+def test_rolling_restart_failed_respawn_not_stuck_draining():
+    """A backend.restart() failure must not park the replica in
+    DRAINING (which routing AND a supervisor's crash watch skip
+    forever): it goes back to JOINING so a respawn/recovery can
+    health-gate it up again."""
+    class FailingBackend(ThreadBackend):
+        def restart(self, replica):
+            self.stop(replica)          # old process already gone...
+            raise OSError("spawn refused")  # ...and the respawn fails
+
+    backend = FailingBackend()
+    gw = _mk_gateway([], backend=backend)
+    try:
+        url, meta = backend.spawn()
+        gw.add_replica(url, meta=meta)
+        gw.scrape_once()
+        report = gw.rolling_restart(backend, ready_timeout_s=5.0)
+        assert len(report) == 1 and report[0]["ok"] is False
+        table = gw.replica_table()
+        assert all(r["state"] == "joining" for r in table.values())
+        assert any(e["event"] == "restart_failed" for e in gw.events())
+    finally:
+        gw.close()
+        backend.close()
+
+
+class StubBackend:
+    """Autoscaler backend over stub replicas."""
+
+    def __init__(self):
+        self.stubs = []
+        self.stopped = []
+
+    def spawn(self):
+        stub = StubReplica("as-%d" % len(self.stubs))
+        self.stubs.append(stub)
+        return stub.url, {"stub": stub}
+
+    def restart(self, replica):
+        raise NotImplementedError
+
+    def stop(self, replica):
+        self.stopped.append(replica.id)
+
+    def close(self):
+        for s in self.stubs:
+            s.kill()
+
+
+def test_autoscaler_grows_on_sustained_slo_burn():
+    a = StubReplica("a")
+    backend = StubBackend()
+    gw = _mk_gateway([a], backend=backend)
+    scaler = Autoscaler(gw, backend=backend, min_replicas=1,
+                        max_replicas=3, slo_p99_ms=100.0, queue_high=50,
+                        burn_ticks=2, idle_ticks=4)
+    try:
+        # synthetic SLO burn: gateway-observed latencies over the SLO
+        for _ in range(20):
+            gw.metrics.record_request(0.5)   # 500 ms >> 100 ms SLO
+        action, sig = scaler.tick()
+        assert action is None and sig["slo_burn"]   # hysteresis tick 1
+        action, _ = scaler.tick()
+        assert action == "up"                       # sustained burn
+        assert len(gw.replicas()) == 2
+        gw.scrape_once()                            # health-gated join
+        assert len(gw.ready_replicas()) == 2
+        snap = gw.metrics.snapshot()
+        assert snap["scale_ups"] == 1
+        assert any(e["event"] == "scale_up" for e in gw.events())
+        # burn streak reset after the action: next tick doesn't re-spawn
+        action, _ = scaler.tick()
+        assert action is None and len(gw.replicas()) == 2
+    finally:
+        gw.close()
+        a.kill()
+        backend.close()
+
+
+def test_autoscaler_queue_depth_burn_signal():
+    a = StubReplica("a", queue_depth=20)
+    backend = StubBackend()
+    gw = _mk_gateway([a], backend=backend)
+    scaler = Autoscaler(gw, backend=backend, min_replicas=1,
+                        max_replicas=2, slo_p99_ms=0.0, queue_high=8,
+                        burn_ticks=1)
+    try:
+        sig = scaler.evaluate()
+        assert sig["queue_burn"] and not sig["slo_burn"]
+        action, _ = scaler.tick()
+        assert action == "up"
+        # at the ceiling: more burn ticks change nothing
+        for _ in range(3):
+            action, _ = scaler.tick()
+            assert action is None
+        assert len(gw.replicas()) == 2
+    finally:
+        gw.close()
+        a.kill()
+        backend.close()
+
+
+def test_autoscaler_shrinks_when_idle_not_below_floor():
+    a = StubReplica("a")
+    backend = StubBackend()
+    gw = _mk_gateway([a], backend=backend)
+    scaler = Autoscaler(gw, backend=backend, min_replicas=1,
+                        max_replicas=3, slo_p99_ms=100.0, queue_high=8,
+                        burn_ticks=1, idle_ticks=2)
+    try:
+        rep2 = scaler.scale_up()
+        gw.scrape_once()
+        assert len(gw.ready_replicas()) == 2
+        # idle: no traffic, zero queues
+        action, sig = scaler.tick()
+        assert action is None and sig["idle"]
+        action, _ = scaler.tick()
+        assert action == "down"
+        assert len(gw.replicas()) == 1
+        assert backend.stopped == [rep2.id]  # newest/least-loaded drained
+        snap = gw.metrics.snapshot()
+        assert snap["scale_downs"] == 1 and snap["drains"] == 1
+        # at the floor: never drains the last replica
+        for _ in range(5):
+            action, _ = scaler.tick()
+            assert action is None
+        assert len(gw.replicas()) == 1
+    finally:
+        gw.close()
+        a.kill()
+        backend.close()
+
+
+# ---------------------------------------------------------------------------
+# satellites: /drain endpoint, SIGTERM drain, queue-depth gauge, prom
+# ---------------------------------------------------------------------------
+
+def test_drain_endpoint_flips_health_and_sheds_new_work():
+    with ModelServer(_linear, port=0, buckets=(1, 2),
+                     max_latency_ms=1.0) as srv:
+        code, body = _get(srv.url + "/healthz")
+        assert body["status"] == "ok"
+        code, body = _get(srv.url + "/drain")
+        assert code == 202 and body["status"] == "draining"
+        code, body = _get(srv.url + "/healthz")
+        assert body["status"] == "draining"
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(srv.url + "/predict", {"data": [0.0] * D_IN})
+        assert ei.value.code == 503
+
+
+def test_drain_endpoint_admin_token_guard(monkeypatch):
+    monkeypatch.setenv("MXNET_SERVING_ADMIN_TOKEN", "s3cret")
+    with ModelServer(_linear, port=0, buckets=(1, 2),
+                     max_latency_ms=1.0) as srv:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(srv.url + "/drain")
+        assert ei.value.code == 403
+        code, body = _get(srv.url + "/healthz")
+        assert body["status"] == "ok"   # guard refused: still serving
+        code, body = _get(srv.url + "/drain",
+                          headers={"X-Admin-Token": "s3cret"})
+        assert code == 202
+        assert _get(srv.url + "/healthz")[1]["status"] == "draining"
+
+
+def test_sigterm_handler_drains_in_flight_before_stop():
+    """Satellite: the SIGTERM handler runs the bounded drain — a request
+    in flight when the signal lands completes instead of dropping."""
+    release = threading.Event()
+
+    def gated(x):
+        release.wait(5.0)
+        return _linear(x)
+
+    stopped = threading.Event()
+    srv = ModelServer(gated, port=0, buckets=(1, 2),
+                      max_latency_ms=1.0).start()
+    # signals=() wires the handler without touching process-global
+    # dispositions; the test delivers the "signal" directly
+    srv.install_drain_handler(signals=(), grace_ms=8000.0,
+                              on_stopped=stopped.set)
+    result = {}
+
+    def one_request():
+        x = np.random.randn(D_IN).astype("float32")
+        try:
+            result["resp"] = _post(srv.url + "/predict",
+                                   {"data": x.tolist()}, timeout=10)
+        except Exception as e:  # noqa: BLE001
+            result["error"] = repr(e)
+
+    t = threading.Thread(target=one_request)
+    t.start()
+    time.sleep(0.15)                      # request is gated in the model
+    srv._on_drain_signal(signal.SIGTERM, None)
+    assert srv.draining                   # flipped before the drain ends
+    time.sleep(0.05)
+    release.set()                         # model finishes
+    t.join(10.0)
+    assert stopped.wait(10.0)             # bounded drain ran to the end
+    assert "error" not in result, result
+    assert result["resp"][0] == 200
+    # repeated signal after stop started: no second drain thread
+    srv._on_drain_signal(signal.SIGTERM, None)
+
+
+def test_serving_queue_depth_profiler_row_and_prom_gauge():
+    """Satellite: predict lanes export live serving.queue_depth like
+    generation lanes already do."""
+    m = ServingMetrics(name="serving")
+    m.set_queue_depth_fn(lambda: 7)
+    assert m.profiler_rows()["serving.queue_depth"] == (7, 0.0)
+    with ModelServer(_linear, port=0, buckets=(1, 2),
+                     max_latency_ms=1.0) as srv:
+        text = srv.prometheus_text()
+    assert "mxtpu_serving_queue_depth" in text
+
+
+def test_gateway_prometheus_exposition():
+    a = StubReplica("a")
+    gw = _mk_gateway([a])
+    try:
+        _post(gw.url + "/predict", {"data": [1.0]})
+        with urllib.request.urlopen(gw.url + "/metrics.prom",
+                                    timeout=5) as resp:
+            assert "openmetrics" in resp.headers["Content-Type"]
+            text = resp.read().decode()
+        assert text.endswith("# EOF\n")
+        for family in ("mxtpu_gateway_requests_total",
+                       "mxtpu_gateway_failovers_total",
+                       "mxtpu_gateway_ready_replicas",
+                       "mxtpu_gateway_replica_up",
+                       "mxtpu_gateway_replica_queue_depth",
+                       "mxtpu_gateway_latency_ms"):
+            assert family in text, family
+        # per-replica sample carries the replica label
+        assert 'mxtpu_gateway_replica_up{replica="0"} 1' in text
+        # gateway.* rows reached the profiler aggregate table
+        from mxnet_tpu import profiler
+        rows = profiler.get_aggregate_stats()
+        assert rows["gateway.requests"]["calls"] >= 1
+    finally:
+        gw.close()
+        a.kill()
+
+
+def test_gateway_event_log_jsonl(tmp_path):
+    path = str(tmp_path / "events.jsonl")
+    a = StubReplica("a")
+    gw = Gateway(replicas=[a.url], scrape_ms=0, event_log=path,
+                 retry_policy=_fast_retry())
+    gw.start()
+    try:
+        rid = gw.replicas()[0].id
+        gw.mark_draining(rid)
+        with open(path) as f:
+            events = [json.loads(line) for line in f]
+        kinds = [e["event"] for e in events]
+        assert "replica_added" in kinds
+        assert "replica_up" in kinds
+        assert "replica_draining" in kinds
+        assert all("t" in e for e in events)
+    finally:
+        gw.close()
+        a.kill()
