@@ -1,0 +1,4 @@
+"""Benchmark suite: each ``*_bench.py`` writes a provenance-stamped
+JSON artifact next to itself (see ``_artifact.stamp``); the schema-audit
+test in ``tests/test_attribution.py`` enforces the artifact contract.
+Compare artifacts across runs with ``tools/bench_diff.py --gate``."""

@@ -1,0 +1,32 @@
+"""Shared provenance stamping for benchmark artifacts.
+
+Every ``benchmark/*.json`` must record which backend produced it, and a
+``cpu_caveat`` whenever that backend is the CPU oracle — previously a
+convention several artifacts silently dropped, which is how CPU-oracle
+numbers end up quoted as chip numbers. The schema-audit test
+(``tests/test_attribution.py``) enforces it on the committed artifacts;
+this helper makes compliance one call in every writer.
+"""
+from __future__ import annotations
+
+CPU_CAVEAT = ("CPU oracle numbers: absolute throughput/latency are not "
+              "comparable to TPU rounds; ratios, counters, and "
+              "pass/fail assertions are the portable signal")
+
+
+def stamp(artifact, platform=None, device_kind=None, caveat=None):
+    """Stamp ``platform`` (+ ``device_kind`` when known) onto a dict
+    artifact, adding ``cpu_caveat`` when the platform is ``cpu``.
+    ``platform=None`` probes jax. Returns the artifact (mutated)."""
+    if platform is None:
+        import jax
+        devs = jax.devices()
+        platform = devs[0].platform
+        device_kind = device_kind or (
+            getattr(devs[0], "device_kind", "") or "")
+    artifact.setdefault("platform", platform)
+    if device_kind:
+        artifact.setdefault("device_kind", device_kind)
+    if str(artifact.get("platform", "")).lower() == "cpu":
+        artifact.setdefault("cpu_caveat", caveat or CPU_CAVEAT)
+    return artifact

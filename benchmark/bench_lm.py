@@ -261,9 +261,13 @@ def main():
     log("devices:", jax.devices())
     runners = dict(bert=bench_bert, translm=bench_translm, lstm=bench_lstm)
     names = list(runners) if which == "all" else [which]
+    from benchmark._artifact import stamp
     results = []
     for name in names:
         res = runners[name](steps, repeat, batch)
+        # provenance per record: this artifact is a LIST accumulated
+        # across runs, so each entry must carry its own backend
+        stamp(res)
         print(json.dumps(res), flush=True)
         results.append(res)
     # persist machine-readable results (VERDICT r3: LM numbers must be an

@@ -231,14 +231,15 @@ def main():
         min_samples=10 if args.quick else 20)
     iso = bench_isolation(n_per_model=50 if args.quick else 200)
 
-    artifact = {
+    from benchmark._artifact import stamp
+    artifact = stamp({
         "bench": "fleet",
         "platform": platform,
         "quick": args.quick,
         "version_swap": swap,
         "canary_rollback": canary,
         "isolation": iso,
-    }
+    }, platform=platform)
     with open(args.out, "w") as f:
         json.dump(artifact, f, indent=2)
     print(json.dumps(artifact, indent=2))

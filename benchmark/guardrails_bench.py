@@ -155,6 +155,8 @@ def main():
             "converged": tail < head,
         },
     }
+    from benchmark._artifact import stamp
+    artifact = stamp(artifact)
     with open(args.out, "w") as f:
         json.dump(artifact, f, indent=2)
         f.write("\n")

@@ -191,11 +191,38 @@ def run(quick=False):
                    "platform; the asserted contract is "
                    "disabled_overhead_pct only")
 
+    # ---- attribution fast path (roofline accounting, PR 12) ---------------
+    # the per-dispatch cost of record_dispatch() — one lock + four float
+    # adds into the roofline registry plus one flight-ring append —
+    # modeled against the measured per-request time, same methodology as
+    # the disabled-tracer budget above. The serving path makes ~1
+    # CachedOp dispatch per request (batching amortizes below that), so
+    # cost-per-record IS the per-request attribution overhead bound.
+    from mxnet_tpu.observability import attribution as attr
+    attr.configure()
+    assert attr.attribution_enabled(), \
+        "attribution must be on (default) for the overhead measurement"
+    attr_iters = 50000 if quick else 200000
+    t0 = time.perf_counter()
+    for _ in range(attr_iters):
+        attr.record_dispatch("obs_bench_attr", "sig|train=False", 4,
+                             1e6, 5e5, 1e-6)
+    attr_ns = (time.perf_counter() - t0) / attr_iters * 1e9
+    attr.roofline.reset()   # drop the synthetic row
+    attr_pct = attr_ns * 1e-9 / per_req_off * 100.0
+    out["attribution"] = {
+        "record_ns_per_dispatch": attr_ns,
+        "dispatch_overhead_pct": attr_pct,
+    }
+    assert attr_pct < 1.0, (
+        "attribution fast path costs %.3f%% of a serving request — "
+        "over the 1%% dispatch-overhead budget" % attr_pct)
+
     worst = max(out["serving"]["disabled_overhead_pct"],
                 out["step_stream"]["disabled_overhead_pct"])
     out["disabled_overhead_worst_pct"] = worst
-    out["pass"] = worst < 2.0
-    assert out["pass"], (
+    out["pass"] = worst < 2.0 and attr_pct < 1.0
+    assert worst < 2.0, (
         "disabled tracer overhead %.3f%% exceeds the 2%% budget" % worst)
     return out
 
@@ -207,6 +234,8 @@ def main(argv=None):
         os.path.dirname(os.path.abspath(__file__)), "OBSERVABILITY.json"))
     args = ap.parse_args(argv)
     out = run(quick=args.quick)
+    from benchmark._artifact import stamp
+    out = stamp(out, platform=out.get("platform"))
     with open(args.out, "w") as f:
         json.dump(out, f, indent=2)
     print(json.dumps(out, indent=2))

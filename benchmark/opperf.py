@@ -224,6 +224,8 @@ def sweep_registry(n=128, reps=5, out_path=None):
                 "platform": _platform_name(),
                 "total_ops": len(names), "timed_ops": n_ok,
                 "rows": rows}
+    from benchmark._artifact import stamp
+    artifact = stamp(artifact, platform=artifact["platform"])
     if out_path:
         with open(out_path, "w") as f:
             json.dump(artifact, f, indent=1)

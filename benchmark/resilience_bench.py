@@ -171,6 +171,8 @@ def main():
         "faulted": faulted,
         "p99_penalty_ms": round(faulted["p99_ms"] - baseline["p99_ms"], 3),
     }
+    from benchmark._artifact import stamp
+    artifact = stamp(artifact, platform=platform)
     with open(args.out, "w") as f:
         json.dump(artifact, f, indent=2)
         f.write("\n")

@@ -195,6 +195,8 @@ def main():
         "engine_stats": eng.stats(),
         "rows": rows,
     }
+    from benchmark._artifact import stamp
+    artifact = stamp(artifact, platform=platform)
     with open(args.out, "w") as f:
         json.dump(artifact, f, indent=2)
         f.write("\n")

@@ -229,6 +229,8 @@ def main(argv=None):
     assert modeled_pct < 1.0, \
         "telemetry per-request overhead %.3f%% >= 1%%" % modeled_pct
 
+    from benchmark._artifact import stamp
+    out = stamp(out, platform=out.get("platform"))
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "TELEMETRY.json")
     with open(path, "w") as f:

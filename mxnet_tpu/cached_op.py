@@ -25,6 +25,7 @@ instead).
 from __future__ import annotations
 
 import threading
+import time
 from collections import OrderedDict
 
 import jax
@@ -34,6 +35,7 @@ from . import aot as _aot
 from . import config as _config
 from . import pcache as _pcache
 from . import random as _random
+from .observability import attribution as _attr
 from .observability import telemetry as _telemetry
 from .observability import tracer as _trace
 
@@ -135,6 +137,16 @@ class CachedOp:
             return {"%s|train=%s" % (sig[0], sig[1]): entry[4]
                     for sig, entry in self._cache.items()}
 
+    def bytes_per_call(self):
+        """Analytic bytes accessed per execution of each resident
+        executable (XLA cost model, same keying as
+        :meth:`flops_per_call`) — the denominator of the roofline
+        arithmetic intensity. 0.0 = cost model unavailable (the
+        executable classifies as ``unknown``, never a guess)."""
+        with self._dispatch_lock:
+            return {"%s|train=%s" % (sig[0], sig[1]): entry[6]
+                    for sig, entry in self._cache.items()}
+
     def clear(self):
         """Drop every compiled executable (the LRU empties; counters
         keep their history). Unloading a served model must free its XLA
@@ -194,7 +206,11 @@ class CachedOp:
         # SAME trace rather than paying a second one
         specs = [jax.ShapeDtypeStruct(a.shape, a._data.dtype)
                  for a in args]
+        # cost analysis is gated on MXNET_TELEMETRY_FLOPS alone: with it
+        # off, attribution still measures dispatch wall but reports its
+        # rows as `unknown` (no analytic numbers, no guessed ones)
         flops = 0.0
+        nbytes = 0.0
         if int(_config.get("MXNET_TELEMETRY_FLOPS") or 0):
             try:
                 lowered = jitted.lower(jax.random.PRNGKey(0), *specs)
@@ -204,12 +220,18 @@ class CachedOp:
                 try:
                     cost = lowered.cost_analysis()
                     flops = float((cost or {}).get("flops", 0.0) or 0.0)
+                    # "bytes accessed" (HBM traffic per execution) rides
+                    # the same analysis: the roofline denominator
+                    nbytes = float((cost or {}).get("bytes accessed",
+                                                    0.0) or 0.0)
                 except Exception:  # cost model unavailable on this backend
                     flops = 0.0
+                    nbytes = 0.0
         else:
             jax.eval_shape(jitted, jax.random.PRNGKey(0), *specs)
         n_out, multi = n_out_box[0]
-        return jitted, n_out, multi, aux_handles_box[0], flops, False
+        return (jitted, n_out, multi, aux_handles_box[0], flops, False,
+                nbytes)
 
     # ---- AOT export / load (cold-start: compile in CI, ship bytes) --------
     def _specs_for(self, sig):
@@ -230,16 +252,18 @@ class CachedOp:
         restart after it compiles nothing. With the persistent compile
         cache enabled the re-compile here is itself a disk hit."""
         with self._dispatch_lock:
-            sigs = [(sig, entry[4]) for sig, entry in self._cache.items()]
+            sigs = [(sig, entry[4], entry[6])
+                    for sig, entry in self._cache.items()]
         records = []
-        for sig, flops in sigs:
+        for sig, flops, nbytes in sigs:
             train = sig[1]
             pure, _n_out_box, _aux_box = self._make_pure(train)
             compiled = jax.jit(pure).lower(
                 jax.random.PRNGKey(0), *self._specs_for(sig)).compile()
             blob, in_tree, out_tree = _aot.serialize_compiled(compiled)
             records.append({"signature": sig, "train": train,
-                            "flops": flops, "blob": blob,
+                            "flops": flops, "bytes": nbytes,
+                            "blob": blob,
                             "in_tree": in_tree, "out_tree": out_tree})
         return records
 
@@ -266,7 +290,8 @@ class CachedOp:
             exe = _aot.deserialize_compiled(rec["blob"], rec["in_tree"],
                                             rec["out_tree"])
             entry = (exe, n_out, multi, aux_handles_box[0],
-                     float(rec.get("flops") or 0.0), True)
+                     float(rec.get("flops") or 0.0), True,
+                     float(rec.get("bytes") or 0.0))
             with self._dispatch_lock:
                 self._cache[sig] = entry
                 self._cache.move_to_end(sig)
@@ -312,16 +337,19 @@ class CachedOp:
                 if entry[4]:
                     self._stats["flops"] = \
                         self._stats.get("flops", 0.0) + entry[4]
+        bucket = args[0].shape[0] if args and args[0].shape else None
+        compiled_now = entry is None
         if entry is None:
             # compile outside the lock (see __init__); the span makes XLA
             # compiles first-class timeline citizens, labeled with the
             # shape bucket (leading dim of the first input) that triggered
             # them — the classic "why was THIS request 2s?" answer
+            t_c0 = time.perf_counter()
             with _trace.span("cachedop.compile", op=self._name,
-                             bucket=(args[0].shape[0]
-                                     if args and args[0].shape else None),
-                             signature=str(sig[0])):
+                             bucket=bucket, signature=str(sig[0])):
                 compiled = self._compile(args)
+            _attr.flight_note("compile", op=self._name, bucket=bucket,
+                              wall_ms=(time.perf_counter() - t_c0) * 1e3)
             evicted = 0
             with self._dispatch_lock:
                 entry = self._cache.get(sig)
@@ -350,12 +378,18 @@ class CachedOp:
                 _GLOBAL_STATS["hits"] += 1
         # per-op flops already accounted inside the hit/miss critical
         # sections above — no second lock acquisition on the hot path
-        jitted, n_out, multi, aux_handles, flops, aot = entry
+        jitted, n_out, multi, aux_handles, flops, aot, nbytes = entry
         if flops:
             _telemetry.add_flops(flops)
 
         key = _random.next_key()
         vals = [a._data for a in args]
+        # dispatch wall pair for the roofline attribution: on a
+        # synchronous backend this is execution time; under async
+        # dispatch it can understate execution (enqueue-only), making
+        # the derived achieved-FLOP/s an overstatement — see the
+        # attribution.py module docstring for the reading guidance
+        t_d0 = time.perf_counter()
         try:
             out_vals = jitted(key, *vals)
         except Exception as exc:  # noqa: BLE001 — AOT aval drift only
@@ -371,9 +405,7 @@ class CachedOp:
                 "%s: %s" % (type(exc).__name__, exc),
                 where="CachedOp(%s)" % self._name)
             with _trace.span("cachedop.compile", op=self._name,
-                             bucket=(args[0].shape[0]
-                                     if args and args[0].shape else None),
-                             signature=str(sig[0])):
+                             bucket=bucket, signature=str(sig[0])):
                 entry = self._compile(args)
             with self._dispatch_lock:
                 self._cache[sig] = entry
@@ -381,8 +413,21 @@ class CachedOp:
                 self._stats["misses"] += 1
             with _STATS_LOCK:
                 _GLOBAL_STATS["misses"] += 1
-            jitted, n_out, multi, aux_handles, flops, aot = entry
+            jitted, n_out, multi, aux_handles, flops, aot, nbytes = entry
+            compiled_now = True
+            t_d0 = time.perf_counter()
             out_vals = jitted(key, *vals)
+        # the FIRST dispatch after a miss pays the jit wrapper's retrace
+        # + backend compile (the forcing trace in _compile lower()s but
+        # never .compile()s) — its wall is compile, not dispatch, and
+        # would rank compile cost in the roofline table; it registers
+        # the executable (calls/FLOPs/AI) with wall_s=None, and only
+        # warm dispatches contribute measured time
+        _attr.record_dispatch(self._name,
+                              "%s|train=%s" % (sig[0], sig[1]),
+                              bucket, flops, nbytes,
+                              None if compiled_now
+                              else time.perf_counter() - t_d0)
         for h, v in zip(aux_handles, out_vals[n_out:]):
             h._data = v
         out_vals = out_vals[:n_out]

@@ -261,8 +261,41 @@ KNOBS = dict([
     _k("MXNET_GATEWAY_MAX_REPLICAS", 8, int, "wired",
        "autoscaler ceiling: scale-up stops here no matter the burn"),
     _k("MXNET_SERVING_ADMIN_TOKEN", "", str, "wired",
-       "when set, admin endpoints (ModelServer GET /drain) require a "
-       "matching X-Admin-Token header; empty = unguarded (dev/tests)"),
+       "when set, admin endpoints (ModelServer GET /drain, POST "
+       "/debug/profile) require a matching X-Admin-Token header; "
+       "empty = unguarded (dev/tests)"),
+    _k("MXNET_PROF_ATTRIBUTION", 1, int, "wired",
+       "per-executable roofline accounting: capture bytes-accessed from "
+       "XLA cost analysis at compile time and measure per-dispatch wall "
+       "time, aggregated per (op, signature) — the mxtpu_roofline_* / "
+       "tools/roofline_report.py source (observability/attribution.py)"),
+    _k("MXNET_PROF_HBM_GBPS", 0.0, float, "wired",
+       "per-device HBM bandwidth in GB/s for the roofline ridge point; "
+       "0 = use the built-in device-kind table (unknown kinds fall back "
+       "to MXNET_PROF_RIDGE classification)"),
+    _k("MXNET_PROF_RIDGE", 0.0, float, "wired",
+       "arithmetic-intensity ridge point (FLOP/byte) separating "
+       "hbm_bound from compute_bound when device peak/bandwidth are "
+       "unknown (CPU oracle); 0 = the built-in v5e-like default"),
+    _k("MXNET_PROF_OVERHEAD_FRACTION", 0.05, float, "wired",
+       "roofline classification: executables achieving less than this "
+       "fraction of their roofline ceiling are overhead_bound — "
+       "dispatch/padding overhead, not the hardware, is the limiter"),
+    _k("MXNET_PROF_CAPTURE_MAX_S", 60.0, float, "wired",
+       "upper bound on POST /debug/profile?seconds=N capture length — "
+       "an admin typo must not pin a serving thread for an hour"),
+    _k("MXNET_PROF_DIR", "/tmp/mxnet_tpu_profiles", str, "wired",
+       "base directory for on-demand profile capture artifacts "
+       "(observability/attribution.py capture_profile)"),
+    _k("MXNET_FLIGHT_RECORDER", 1, int, "wired",
+       "always-on flight recorder: bounded ring of the last K step/"
+       "request/dispatch/compile/guard-skip timing records, dumped as "
+       "JSON on SIGUSR2, AnomalyFault/CollectiveTimeout, and watchdog "
+       "stall (observability/attribution.py; 0 disables)"),
+    _k("MXNET_FLIGHT_RECORDS", 256, int, "wired",
+       "flight-recorder ring capacity in records (drop-oldest)"),
+    _k("MXNET_FLIGHT_DIR", "/tmp/mxnet_tpu_flight", str, "wired",
+       "directory flight-recorder dumps are written to"),
     # ---- subsumed by XLA/PJRT --------------------------------------------
     _k("MXNET_EXEC_BULK_EXEC_INFERENCE", 1, int, "subsumed",
        "XLA compiles whole programs; bulking is implicit"),

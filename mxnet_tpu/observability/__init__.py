@@ -14,6 +14,10 @@ failures. This package is that layer for the TPU stack, host side:
   (https://ui.perfetto.dev) or chrome://tracing; ``profiler.dump()`` routes
   through it, restoring reference ``MXDumpProfile`` parity on CPU-only
   runs (the optional jax.profiler XPlane trace rides alongside).
+- :mod:`.attribution` — the performance attribution plane: per-executable
+  roofline accounting (``mxtpu_roofline_*``, ``tools/roofline_report.py``),
+  on-demand production profile capture (``POST /debug/profile``), and the
+  always-on flight recorder (SIGUSR2 / fault-path JSON dumps).
 
 Instrumented call chains (see ``docs/observability.md``):
 
@@ -42,6 +46,10 @@ from .telemetry import (FlopsMeter, TailSampler, add_flops, device_memory,
                         flops_rate, flops_total, install_tail_sampler,
                         memory_headroom, memory_health, mfu_percent,
                         peak_flops, serve_metrics, telemetry_gauge)
+from .attribution import (CaptureBusy, FlightRecorder, RooflineRegistry,
+                          capture_profile, flight, flight_dump,
+                          flight_note, install_flight_signal_handler,
+                          roofline, roofline_gauge)
 
 # NOTE: the process-wide Tracer instance lives at ``tracer.tracer`` (the
 # submodule keeps the name; re-exporting it here would shadow the
@@ -56,4 +64,7 @@ __all__ = ["Tracer", "SpanContext", "span", "instant", "counter",
            "FlopsMeter", "TailSampler", "add_flops", "device_memory",
            "flops_rate", "flops_total", "install_tail_sampler",
            "memory_headroom", "memory_health", "mfu_percent", "peak_flops",
-           "serve_metrics", "telemetry_gauge"]
+           "serve_metrics", "telemetry_gauge",
+           "RooflineRegistry", "FlightRecorder", "CaptureBusy",
+           "capture_profile", "roofline", "roofline_gauge", "flight",
+           "flight_note", "flight_dump", "install_flight_signal_handler"]

@@ -33,6 +33,7 @@ from __future__ import annotations
 
 import re
 
+from . import attribution as _attribution
 from . import telemetry as _telemetry
 from . import tracer as _tracer
 from .tracer import _BOUNDS_MS, _BUCKET_LABELS
@@ -369,6 +370,82 @@ def _render_telemetry(w):
                 _telemetry.mfu_percent())
 
 
+def _render_roofline(w):
+    """Per-executable roofline attribution, aggregated per (op, bucket)
+    — the bounded-cardinality scrape view (per-signature detail lives
+    on ``tools/roofline_report.py`` / the ``/metrics`` JSON gauge).
+    ``mxtpu_roofline_bound`` is a one-hot state gauge with a ``bound=``
+    label, the fleet-wide "which programs are HBM-bound" query."""
+    rows = _attribution.roofline.by_op_bucket()
+    if rows:
+        w.family("mxtpu_roofline_dispatch_total", "counter",
+                 "executable dispatches attributed per (op, bucket)")
+        w.family("mxtpu_roofline_seconds_total", "counter",
+                 "measured dispatch wall time per (op, bucket) — "
+                 "execution time on sync backends; can understate "
+                 "execution under async dispatch")
+        w.family("mxtpu_roofline_flops_per_call", "gauge",
+                 "analytic FLOPs per execution (XLA cost model, "
+                 "call-weighted over signatures)")
+        w.family("mxtpu_roofline_bytes_per_call", "gauge",
+                 "analytic bytes accessed per execution (XLA cost "
+                 "model, call-weighted over signatures)")
+        w.family("mxtpu_roofline_arithmetic_intensity", "gauge",
+                 "FLOPs per byte accessed — position on the roofline's "
+                 "x axis")
+        w.family("mxtpu_roofline_achieved_flops", "gauge",
+                 "analytic FLOPs / measured wall per call (can "
+                 "overstate under async dispatch — see "
+                 "docs/observability.md)")
+        w.family("mxtpu_roofline_ceiling_flops", "gauge",
+                 "roofline ceiling min(peak, AI x HBM bandwidth); "
+                 "absent when device peak/bandwidth are unknown")
+        w.family("mxtpu_roofline_bound", "gauge",
+                 "1 for the executable's roofline classification "
+                 "(bound= label: compute_bound | hbm_bound | "
+                 "overhead_bound | unknown)")
+        for (op, bucket) in sorted(rows, key=lambda k: (str(k[0]),
+                                                        str(k[1]))):
+            ent = rows[(op, bucket)]
+            labels = {"op": op, "bucket": bucket}
+            w.sample("mxtpu_roofline_dispatch_total", ent["calls"],
+                     labels=labels)
+            w.sample("mxtpu_roofline_seconds_total", ent["total_s"],
+                     labels=labels)
+            w.sample("mxtpu_roofline_flops_per_call",
+                     ent["flops_per_call"], labels=labels)
+            w.sample("mxtpu_roofline_bytes_per_call",
+                     ent["bytes_per_call"], labels=labels)
+            w.sample("mxtpu_roofline_arithmetic_intensity", ent["ai"],
+                     labels=labels)
+            w.sample("mxtpu_roofline_achieved_flops",
+                     ent["achieved_flops_s"], labels=labels)
+            w.sample("mxtpu_roofline_ceiling_flops",
+                     ent["ceiling_flops_s"], labels=labels)
+            w.sample("mxtpu_roofline_bound", 1,
+                     labels={**labels, "bound": ent["bound"]})
+    ridge = _attribution.ridge_point()
+    w.gauge("mxtpu_roofline_ridge_flop_per_byte",
+            "arithmetic-intensity ridge the bound classification used "
+            "(peak/bandwidth, MXNET_PROF_RIDGE, or the built-in "
+            "default)", ridge)
+    bw = _attribution.peak_bytes_per_s()
+    if bw:
+        w.gauge("mxtpu_peak_hbm_bytes_per_second",
+                "aggregate device peak HBM bytes/s (table or "
+                "MXNET_PROF_HBM_GBPS)", bw)
+    st = _attribution.flight.stats()
+    w.gauge("mxtpu_flight_records",
+            "flight-recorder ring occupancy (last-K timing records)",
+            st["records"])
+    w.counter("mxtpu_flight_recorded_total",
+              "timing records the flight recorder has observed",
+              st["total_recorded"])
+    w.counter("mxtpu_flight_dumps_total",
+              "flight-recorder JSON dumps written (SIGUSR2, faults, "
+              "watchdog stalls, profile captures)", st["dumps"])
+
+
 def _render_elastic(w):
     from ..resilience import elastic as _elastic
     gauge = _elastic.membership_gauge()
@@ -483,6 +560,7 @@ def render_process(extra=None):
     memory/MFU, elastic membership. ``extra(writer)`` appends more."""
     w = PromWriter(const_labels=_const_labels())
     _render_telemetry(w)
+    _render_roofline(w)
     _render_trace(w)
     _render_cachedop(w)
     _render_pcache(w)

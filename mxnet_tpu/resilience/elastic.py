@@ -756,6 +756,10 @@ class CollectiveWatchdog:
             _count("collective_timeouts")
             _trace.instant("elastic.collective_timeout", op=op,
                            deadline_ms=self.deadline_ms)
+            from ..observability import attribution as _attr
+            _attr.flight_note("collective_timeout", op=op,
+                              deadline_ms=self.deadline_ms)
+            _attr.flight_dump("collective_timeout")
             if self._on_abort is not None:
                 self._on_abort(op, self.deadline_ms)
             raise CollectiveTimeout(

@@ -49,6 +49,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ..observability import attribution as _attr
 from ..observability import tracer as _trace
 from . import chaos as _chaos
 from ._stats import Registry, export_rows
@@ -275,6 +276,12 @@ class StepWatchdog:
         if elapsed * 1e3 > self.deadline_ms and not stalled[0]:
             stalled[0] = True
             self.stalls += 1
+            # a wedged device is exactly when no one had a trace running:
+            # dump the flight ring NOW, while the process can still write
+            _attr.flight_note("watchdog_stall", watchdog=self.name,
+                              step=step, elapsed_s=elapsed,
+                              deadline_ms=self.deadline_ms)
+            _attr.flight_dump("watchdog_stall")
             if self._on_stall is not None:
                 self._on_stall(step, elapsed)
             return "stall"
@@ -619,6 +626,13 @@ class GuardedStep:
                 # exactly where it happened in the step sequence
                 _trace.instant("guardrails.skip", guarded=self.name,
                                step=step_no, loss=loss, loss_scale=scale)
+                _attr.flight_note("guard_skip", guarded=self.name,
+                                  step=step_no, loss=loss,
+                                  loss_scale=scale)
+            else:
+                _attr.flight_note("step", guarded=self.name,
+                                  step=step_no, loss=loss,
+                                  grad_norm=gnorm)
             self._skips = int(skips)
             if (ok and self._clip_norm is not None
                     and np.isfinite(gnorm) and gnorm > self._clip_norm):
@@ -632,6 +646,13 @@ class GuardedStep:
             self._detector.reset()
             _trace.instant("guardrails.anomaly", guarded=self.name,
                            step=storm[0], loss=storm[1], kind="nan_storm")
+            # post-mortem timeline BEFORE the raise: whoever catches the
+            # fault (resumable_fit restore-and-replay) gets the last K
+            # step records on disk even if the process dies next
+            _attr.flight_note("anomaly", guarded=self.name,
+                              step=storm[0], loss=storm[1],
+                              kind="nan_storm")
+            _attr.flight_dump("anomaly_fault")
             raise AnomalyFault(
                 "NaN storm: >= %d skipped steps in the last %d (at step "
                 "%d) — restore-and-replay" % (self._detector.storm_skips,

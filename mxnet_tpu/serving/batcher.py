@@ -41,6 +41,7 @@ from concurrent.futures import Future
 
 import numpy as _np
 
+from ..observability import attribution as _attr
 from ..observability import tracer as _trace
 from ..resilience import chaos as _chaos
 from ..resilience import retry as _retry
@@ -314,6 +315,16 @@ class DynamicBatcher:
                                         req.enqueue_t, popped_t,
                                         parent=req.ctx,
                                         request_id=req.request_id)
+                if _attr.flight_enabled():
+                    # the flight recorder sees queue waits even with
+                    # tracing off: one batch-level record (max wait),
+                    # not one per member — the ring is for timelines,
+                    # not per-request accounting
+                    popped_t = time.monotonic()
+                    _attr.flight_note(
+                        "queue_wait", rows=len(batch),
+                        max_wait_ms=(popped_t - min(
+                            r.enqueue_t for r in batch)) * 1e3)
                 try:
                     self._execute(batch)
                 except BaseException as exc:  # _execute's guards failed too

@@ -319,13 +319,52 @@ class _Handler(BaseHTTPRequestHandler):
         body = self._read_body()
         if body is None:
             return
-        path = self.path.split("?", 1)[0]
+        path, _, query = self.path.partition("?")
         if path == "/predict" or path.startswith("/predict/"):
             self._route_predict(gw, path, body, rid)
         elif path == "/generate" or path.startswith("/generate/"):
             self._route_generate(gw, path, body, rid)
+        elif path == "/debug/profile":
+            self._route_profile(gw, query, body, rid)
         else:
             self._reply(404, {"error": "unknown path %s" % self.path})
+
+    def _route_profile(self, gw, query, body, rid):
+        """``POST /debug/profile?replica=ID&seconds=N``: proxy an
+        on-demand profile capture to ONE named replica (capturing "the
+        fleet" is meaningless — traces are per-process). The caller must
+        present the SAME admin token here that a replica would demand
+        (the gateway re-attaches it on the replica hop) — proxying
+        without the check would turn the gateway into a confused deputy
+        that launders unauthenticated capture requests through its own
+        credential. The forward timeout is stretched past the capture
+        window: a 30s capture is not a dead replica."""
+        if gw._admin_token and \
+                self.headers.get("X-Admin-Token") != gw._admin_token:
+            self._reply(403, {"error": "admin endpoint: missing or bad "
+                                       "X-Admin-Token"})
+            return
+        params = urllib.parse.parse_qs(query)
+        rep_id = params.get("replica", [None])[0]
+        if rep_id is None:
+            self._reply(400, {"error": "need ?replica=<id> (see "
+                                       "/replicas for ids)"})
+            return
+        try:
+            rep = gw.replica(int(rep_id))
+        except ValueError:
+            rep = None
+        if rep is None:
+            self._reply(404, {"error": "unknown replica %r" % rep_id})
+            return
+        try:
+            seconds = float(params.get("seconds", ["1"])[0])
+        except ValueError:
+            self._reply(400, {"error": "bad seconds value"})
+            return
+        status, headers, data = gw.forward_profile(rep, seconds, body,
+                                                   rid)
+        self._reply_raw(status, data, headers)
 
     def _route_predict(self, gw, path, body, rid):
         t0 = time.monotonic()
@@ -762,6 +801,44 @@ class Gateway:
             if self._retry is not None:
                 return self._retry.call(attempt)
             return attempt()
+
+    def forward_profile(self, rep, seconds, body, rid):
+        """Proxy one ``POST /debug/profile?seconds=N`` to a named
+        replica, attaching the gateway's admin token and widening the
+        socket timeout past the capture window (plus slack for trace
+        finalize + checksumming). Returns ``(status, headers, body)``;
+        transport failure maps to 502 — the replica may still be fine,
+        only this capture hop failed."""
+        max_s = float(_config.get("MXNET_PROF_CAPTURE_MAX_S") or 60.0)
+        timeout = min(seconds, max_s) + max(10.0,
+                                            self._forward_timeout_s)
+        u = urllib.parse.urlsplit(rep.url)
+        conn = http.client.HTTPConnection(u.hostname, u.port,
+                                          timeout=timeout)
+        headers = {"Content-Type": "application/json",
+                   "X-Request-Id": rid,
+                   "Content-Length": str(len(body))}
+        if self._admin_token:
+            headers["X-Admin-Token"] = self._admin_token
+        try:
+            with _trace.span("gateway.profile", request_id=rid,
+                             replica=rep.id, seconds=seconds):
+                conn.request("POST",
+                             "/debug/profile?seconds=%s" % seconds,
+                             body=body, headers=headers)
+                resp = conn.getresponse()
+                data = resp.read()
+            out_headers = {}
+            ctype = resp.headers.get("Content-Type")
+            if ctype:
+                out_headers["Content-Type"] = ctype
+            return resp.status, out_headers, data
+        except OSError as e:
+            return 502, {}, json.dumps(
+                {"error": "replica %d profile capture failed: %s: %s"
+                          % (rep.id, type(e).__name__, e)}).encode()
+        finally:
+            conn.close()
 
     # ---- streamed /generate (sticky) --------------------------------------
     def _pin(self, rep):

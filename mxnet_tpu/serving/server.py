@@ -63,6 +63,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 import numpy as _np
 
 from .. import config as _config
+from ..observability import attribution as _attr
 from ..observability import telemetry as _telemetry
 from ..observability import tracer as _trace
 from ..resilience import elastic as _elastic
@@ -123,6 +124,7 @@ class _Handler(BaseHTTPRequestHandler):
         pass
 
     def _reply(self, code, payload, headers=None):
+        self._last_code = code
         body = json.dumps(payload).encode("utf-8")
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
@@ -194,6 +196,8 @@ class _Handler(BaseHTTPRequestHandler):
         # attached to the request's whole span chain
         rid = self.headers.get("X-Request-Id") or uuid.uuid4().hex[:16]
         self._request_id = rid
+        self._last_code = None
+        t0 = time.perf_counter()
         with _trace.span("serving.http", request_id=rid,
                          path=self.path) as sp:
             self._http_span = sp
@@ -201,6 +205,68 @@ class _Handler(BaseHTTPRequestHandler):
                 self._handle_post(rid)
             finally:
                 self._http_span = None
+        # the flight recorder's request timeline rides regardless of
+        # whether a trace session is running — that is its whole point
+        _attr.flight_note("request", request_id=rid,
+                          path=self.path.partition("?")[0],
+                          status=self._last_code,
+                          wall_ms=(time.perf_counter() - t0) * 1e3)
+
+    # ---- on-demand production profiling -----------------------------------
+    def _handle_profile_capture(self, query, body):
+        """``POST /debug/profile?seconds=N`` (admin-guarded): capture N
+        seconds of live traffic — host spans, flight ring, roofline
+        attribution, and the jax/XPlane device trace when available —
+        into a checksummed artifact dir, replying with its manifest.
+        The capture runs on THIS handler thread; every other thread
+        keeps serving, which is the point: chip-side investigation
+        without a redeploy. 409 while another capture runs."""
+        import urllib.parse
+        if not self._admin_ok():
+            self._reply(403, {"error": "admin endpoint: missing or bad "
+                                       "X-Admin-Token"})
+            return
+        params = urllib.parse.parse_qs(query)
+        try:
+            payload = json.loads(body or b"{}") or {}
+            if not isinstance(payload, dict):
+                raise ValueError("body must be a JSON object")
+            seconds = float(params.get("seconds", [None])[0]
+                            or payload.get("seconds", 1.0))
+        except (ValueError, TypeError) as e:
+            self._reply(400, {"error": str(e)})
+            return
+        try:
+            # the artifact dir is always capture_profile's own
+            # MXNET_PROF_DIR-derived path: accepting a client-chosen
+            # directory here would hand the wire an arbitrary-path
+            # file-write primitive (worse through the gateway proxy)
+            manifest = _attr.capture_profile(seconds)
+        except _attr.CaptureBusy as e:
+            self._reply(409, {"error": str(e)},
+                        headers={"Retry-After": "1"})
+            return
+        except OSError as e:
+            self._reply(500, {"error": "capture failed: %s: %s"
+                              % (type(e).__name__, e)})
+            return
+        self._reply(200, manifest)
+
+    def _handle_flight_dump(self):
+        """``POST /debug/flight`` (admin-guarded): dump the flight ring
+        now — the HTTP twin of ``kill -USR2`` for operators without
+        shell access to the host."""
+        if not self._admin_ok():
+            self._reply(403, {"error": "admin endpoint: missing or bad "
+                                       "X-Admin-Token"})
+            return
+        path = _attr.flight_dump("http_request")
+        if path is None:
+            self._reply(503, {"error": "flight recorder disabled or "
+                                       "dump unwritable"})
+            return
+        self._reply(200, {"path": path,
+                          "records": len(_attr.flight.records())})
 
     @staticmethod
     def _split_model_path(path):
@@ -219,7 +285,14 @@ class _Handler(BaseHTTPRequestHandler):
         body = read_post_body(self)
         if body is None:
             return
-        path, model_name = self._split_model_path(self.path)
+        raw_path, _, query = self.path.partition("?")
+        if raw_path == "/debug/profile":
+            self._handle_profile_capture(query, body)
+            return
+        if raw_path == "/debug/flight":
+            self._handle_flight_dump()
+            return
+        path, model_name = self._split_model_path(raw_path)
         if path == "/generate":
             self._handle_generate(rid, srv, body, model_name)
             return
@@ -581,6 +654,9 @@ class _Handler(BaseHTTPRequestHandler):
                             headers=extra)
             return
         self.send_response(200)
+        # committed to the stream: record the status for the flight
+        # recorder's request record (_reply never runs on this path)
+        self._last_code = 200
         self.send_header("Content-Type", "application/x-ndjson")
         self.send_header("X-Request-Id", rid)
         for k, v in extra.items():
@@ -744,6 +820,14 @@ class ModelServer:
         # device HBM / FLOPs / MFU: the same numbers /metrics.prom
         # exposes, on the JSON surface
         self.metrics.set_gauge_fn("telemetry", _telemetry.telemetry_gauge)
+        # per-executable roofline attribution (the ranked kernel-work
+        # target list) on the JSON surface too
+        self.metrics.set_gauge_fn("roofline", _attr.roofline_gauge)
+        # post-mortem readiness: a serving process answers `kill -USR2`
+        # with a flight dump (no-op when called off the main thread —
+        # the embedding process then owns the disposition)
+        if _attr.flight_enabled():
+            _attr.install_flight_signal_handler()
         # generation lane: slot-arena occupancy + scheduler state, plus
         # this server's TTFT / tokens-per-slot percentiles when a
         # generator with GenerationMetrics is attached

@@ -1,0 +1,216 @@
+#!/usr/bin/env python
+"""Ranked roofline attribution report: where the dispatch time goes, and
+whether each compiled executable is compute-, HBM-, or overhead-bound.
+
+The top-N table ROADMAP item 1's kernel work starts from: programs
+sorted by total attributed dispatch time, each with its arithmetic
+intensity, achieved vs ceiling FLOP/s, share of the step budget, and
+the ``compute_bound | hbm_bound | overhead_bound`` classification the
+attribution plane derived (see docs/observability.md, "Performance
+attribution").
+
+Input sources (pure stdlib — runs on a monitoring box without jax):
+
+- a live endpoint: ``--url http://host:8080`` scrapes
+  ``/metrics.prom`` and reads the ``mxtpu_roofline_*`` families
+  (the per-(op, bucket) aggregate view);
+- a JSON file: the ``attribution.json`` a ``POST /debug/profile``
+  capture wrote (``{"rows": [...]}``, per-signature detail), or a bare
+  snapshot list.
+
+Usage::
+
+    python tools/roofline_report.py --url http://localhost:8080
+    python tools/roofline_report.py capture_dir/attribution.json --top 20
+    python tools/roofline_report.py attribution.json --json
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+
+_SAMPLE_RE = re.compile(
+    r"^(?P<name>mxtpu_roofline_[a-z_]+?)(?:_total)?"
+    r"(?:\{(?P<labels>[^}]*)\})? (?P<value>\S+)$")
+_LABEL_RE = re.compile(r'(\w+)="((?:[^"\\]|\\.)*)"')
+
+
+class ReportError(Exception):
+    """Input that can't be reported on, with a usable message."""
+
+
+def parse_prometheus(text):
+    """``mxtpu_roofline_*`` families from an OpenMetrics exposition →
+    row dicts keyed like :meth:`RooflineRegistry.by_op_bucket` output
+    (plus the ridge). Unknown families are ignored — the scrape carries
+    the whole telemetry plane."""
+    rows = {}
+    ridge = None
+    field_by_family = {
+        "mxtpu_roofline_dispatch": ("calls", 1.0),
+        "mxtpu_roofline_seconds": ("total_s", 1.0),
+        "mxtpu_roofline_flops_per_call": ("flops_per_call", 1.0),
+        "mxtpu_roofline_bytes_per_call": ("bytes_per_call", 1.0),
+        "mxtpu_roofline_arithmetic_intensity": ("ai", 1.0),
+        "mxtpu_roofline_achieved_flops": ("achieved_flops_s", 1.0),
+        "mxtpu_roofline_ceiling_flops": ("ceiling_flops_s", 1.0),
+    }
+    for line in text.splitlines():
+        if line.startswith("#") or not line.strip():
+            continue
+        m = _SAMPLE_RE.match(line)
+        if not m:
+            continue
+        name = m.group("name")
+        labels = dict(_LABEL_RE.findall(m.group("labels") or ""))
+        try:
+            value = float(m.group("value"))
+        except ValueError:
+            continue
+        if name == "mxtpu_roofline_ridge_flop_per_byte":
+            ridge = value
+            continue
+        op, bucket = labels.get("op"), labels.get("bucket")
+        if op is None:
+            continue
+        # rank is part of the key: a fleet-merged scrape
+        # (tools/telemetry_agg.py) stamps rank= on every sample, and
+        # collapsing ranks here would silently last-win one worker's
+        # numbers over the fleet's — per-rank rows are the honest view
+        rank = labels.get("rank")
+        key = (op, bucket, rank)
+        row = rows.setdefault(key, {"op": op, "bucket": bucket,
+                                    "rank": rank, "signature": None,
+                                    "ceiling_flops_s": None})
+        if name == "mxtpu_roofline_bound":
+            if value == 1:
+                row["bound"] = labels.get("bound", "unknown")
+        elif name in field_by_family:
+            field, scale = field_by_family[name]
+            row[field] = value * scale
+    out = list(rows.values())
+    total_s = sum(r.get("total_s", 0.0) for r in out) or 0.0
+    for r in out:
+        r.setdefault("calls", 0)
+        r.setdefault("total_s", 0.0)
+        r.setdefault("bound", "unknown")
+        r["pct_of_total"] = (r["total_s"] / total_s * 100.0
+                             if total_s > 0 else 0.0)
+    return out, ridge
+
+
+def load_rows(source, url=None):
+    """Rows + ridge from a ``--url`` endpoint or a JSON file path."""
+    if url is not None:
+        import urllib.request
+        target = url.rstrip("/")
+        if not target.endswith("/metrics.prom"):
+            target += "/metrics.prom"
+        try:
+            with urllib.request.urlopen(target, timeout=10.0) as r:
+                text = r.read().decode("utf-8", "replace")
+        except OSError as exc:
+            raise ReportError("cannot scrape %s: %s" % (target, exc)) \
+                from exc
+        return parse_prometheus(text)
+    try:
+        with open(source) as f:
+            doc = json.load(f)
+    except OSError as exc:
+        raise ReportError("cannot read %s: %s" % (source, exc)) from exc
+    except ValueError as exc:
+        raise ReportError("%s is not valid JSON (%s)" % (source, exc)) \
+            from exc
+    if isinstance(doc, dict) and "rows" in doc:
+        rows = doc["rows"]
+        peak = doc.get("peak_flops")
+        bw = doc.get("peak_bytes_s")
+        ridge = doc.get("ridge_flop_per_byte") or (
+            peak / bw if peak and bw else None)
+        return rows, ridge
+    if isinstance(doc, list):
+        return doc, None
+    raise ReportError("%s is neither an attribution gauge dict nor a "
+                      "snapshot list" % source)
+
+
+def _fmt_flops(v):
+    if v is None:
+        return "-"
+    for unit, div in (("T", 1e12), ("G", 1e9), ("M", 1e6), ("K", 1e3)):
+        if abs(v) >= div:
+            return "%.1f %sFLOP/s" % (v / div, unit)
+    return "%.0f FLOP/s" % v
+
+
+def format_report(rows, ridge=None, top=15):
+    """The human-readable top-N table (rows pre-sorted by total_s)."""
+    lines = []
+    total_s = sum(r.get("total_s", 0.0) for r in rows)
+    n_by_bound = {}
+    for r in rows:
+        n_by_bound[r.get("bound", "unknown")] = \
+            n_by_bound.get(r.get("bound", "unknown"), 0) + 1
+    lines.append("Roofline attribution: %d executable(s), %.1f ms total "
+                 "attributed dispatch time%s"
+                 % (len(rows), total_s * 1e3,
+                    (", ridge %.0f FLOP/byte" % ridge) if ridge else ""))
+    lines.append("bound-by: " + ", ".join(
+        "%s=%d" % (k, v) for k, v in sorted(n_by_bound.items())))
+    lines.append("")
+    lines.append("  %-28s %6s %8s %10s %7s %8s %14s %14s %6s  %s"
+                 % ("op", "bucket", "calls", "total ms", "%budget",
+                    "AI", "achieved", "ceiling", "%ceil", "bound"))
+    for r in rows[:top]:
+        ceiling = r.get("ceiling_flops_s")
+        achieved = r.get("achieved_flops_s") or 0.0
+        pct_ceil = ("%5.1f%%" % (achieved / ceiling * 100.0)
+                    if ceiling else "    -")
+        op_label = str(r.get("op", "?"))
+        if r.get("rank") is not None:   # fleet-merged scrape: per-rank
+            op_label = "%s@r%s" % (op_label, r["rank"])
+        lines.append(
+            "  %-28s %6s %8d %10.2f %6.1f%% %8.2f %14s %14s %6s  %s"
+            % (op_label[:28], r.get("bucket"),
+               int(r.get("calls", 0)), r.get("total_s", 0.0) * 1e3,
+               r.get("pct_of_total", 0.0), r.get("ai", 0.0),
+               _fmt_flops(achieved), _fmt_flops(ceiling), pct_ceil,
+               r.get("bound", "unknown")))
+    if len(rows) > top:
+        lines.append("  ... %d more (use --top)" % (len(rows) - top))
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="Ranked per-executable roofline report")
+    ap.add_argument("source", nargs="?",
+                    help="attribution.json from a profile capture (or a "
+                         "bare snapshot list)")
+    ap.add_argument("--url",
+                    help="scrape a live /metrics.prom endpoint instead")
+    ap.add_argument("--top", type=int, default=15,
+                    help="rows to list (default 15)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the rows as JSON instead of the table")
+    args = ap.parse_args(argv)
+    if not args.source and not args.url:
+        ap.error("need a JSON source or --url")
+    try:
+        rows, ridge = load_rows(args.source, url=args.url)
+    except ReportError as exc:
+        print("roofline_report: %s" % exc, file=sys.stderr)
+        return 2
+    rows = sorted(rows, key=lambda r: -float(r.get("total_s", 0.0)))
+    if args.json:
+        print(json.dumps({"ridge_flop_per_byte": ridge, "rows": rows},
+                         indent=2, default=str))
+    else:
+        print(format_report(rows, ridge=ridge, top=args.top))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -9,8 +9,10 @@ timeline exists to surface:
   (``serving.queue_wait``) vs. XLA compiles (``cachedop.compile``), and
   the staging **overlap efficiency** — the fraction of training time NOT
   spent stalled on input staging (1.0 = perfect overlap, the
-  ``step_stream`` design target);
-- a per-span-name aggregate table (count / total / mean / max);
+  ``step_stream`` design target). Category sums use **exclusive (self)
+  time** — a span's duration minus its direct children's overlap — so a
+  parent is never double-counted over the children nested inside it;
+- a per-span-name aggregate table (count / total / self / mean / max);
 - the **top-N slowest spans**, each with its request id when it carries
   one — the p99 outlier, decomposed.
 
@@ -84,6 +86,41 @@ def _is_span(ev):
     return ev.get("ph") == "X" and "dur" in ev
 
 
+def exclusive_durations(spans):
+    """Per-span *self* time: duration minus the time covered by direct
+    children (linked via the ``span_id``/``parent_id`` the exporter puts
+    in ``args``). Without this, every aggregate that sums durations
+    double-counts parents over children — ``serving.http`` "contains"
+    its own queue wait, so inclusive sums overstate the serving total by
+    exactly the child time. Returns ``{id(ev): self_us}``; spans with no
+    linkage (hand-written traces) keep their full duration."""
+    by_span_id = {}
+    for ev in spans:
+        sid = (ev.get("args") or {}).get("span_id")
+        if sid is not None:
+            by_span_id[sid] = ev
+    child_us = defaultdict(float)
+    for ev in spans:
+        args = ev.get("args") or {}
+        parent = args.get("parent_id")
+        if not parent or parent not in by_span_id:
+            continue
+        par = by_span_id[parent]
+        # clamp the child's contribution to the parent's interval:
+        # cross-thread children (queue waits recorded after the fact)
+        # can overhang, and a child must never push self time negative
+        p0, p1 = par["ts"], par["ts"] + par["dur"]
+        c0, c1 = ev["ts"], ev["ts"] + ev["dur"]
+        overlap = max(0.0, min(p1, c1) - max(p0, c0))
+        child_us[parent] += overlap
+    out = {}
+    for ev in spans:
+        sid = (ev.get("args") or {}).get("span_id")
+        covered = child_us.get(sid, 0.0) if sid is not None else 0.0
+        out[id(ev)] = max(0.0, ev["dur"] - covered)
+    return out
+
+
 def summarize(events, top=10, kept=None):
     """Aggregate a trace into one JSON-able summary dict. ``kept`` is
     the sampler's ``{trace_id_hex: reason}`` map — top-N spans whose
@@ -96,28 +133,37 @@ def summarize(events, top=10, kept=None):
                for ev in events
                if ev.get("ph") == "M" and ev.get("name") == "thread_name"}
 
-    by_name = defaultdict(lambda: [0, 0.0, 0.0])  # count, total_us, max_us
+    self_us = exclusive_durations(spans)
+    # count, total_us, max_us, self_us
+    by_name = defaultdict(lambda: [0, 0.0, 0.0, 0.0])
     for ev in spans:
         ent = by_name[ev["name"]]
         ent[0] += 1
         ent[1] += ev["dur"]
         if ev["dur"] > ent[2]:
             ent[2] = ev["dur"]
+        ent[3] += self_us[id(ev)]
 
-    def total_ms(match):
+    def total_ms(match, exclusive=True):
+        """Category total over *exclusive* time by default: a parent's
+        children must not be counted into the parent AND themselves
+        (e.g. trainer.step nesting inside trainer.step_many, compiles
+        inside engine.execute)."""
+        idx = 3 if exclusive else 1
         if callable(match):
-            return sum(t for n, (_, t, _m) in by_name.items()
+            return sum(ent[idx] for n, ent in by_name.items()
                        if match(n)) / 1e3
-        return sum(by_name[n][1] for n in match if n in by_name) / 1e3
+        return sum(by_name[n][idx] for n in match if n in by_name) / 1e3
 
     compute_ms = total_ms(lambda n: n.startswith(COMPUTE_PREFIXES))
-    # trainer.chunk nests inside nothing, but step/step_many are roots
-    # too: avoid double counting by preferring chunk/step/step_many spans
-    # only (trainer.* has no self-nesting today; keep the simple sum)
     stage_wait_ms = total_ms(STAGE_WAIT_NAMES)
     queue_wait_ms = total_ms(QUEUE_WAIT_NAMES)
     compile_ms = total_ms(COMPILE_NAMES)
+    # the serving root is reported inclusive (a request's wall time) AND
+    # exclusive (handler-only time, children counted in their own rows)
     serving_ms = by_name[SERVING_ROOT][1] / 1e3 \
+        if SERVING_ROOT in by_name else 0.0
+    serving_self_ms = by_name[SERVING_ROOT][3] / 1e3 \
         if SERVING_ROOT in by_name else 0.0
 
     wall_ms = 0.0
@@ -127,10 +173,16 @@ def summarize(events, top=10, kept=None):
         wall_ms = (t1 - t0) / 1e3
 
     overlap_efficiency = None
-    if compute_ms > 0:
-        # stage waits happen INSIDE trainer chunk spans: efficiency is the
-        # fraction of training wall time not stalled on input staging
-        overlap_efficiency = max(0.0, 1.0 - stage_wait_ms / compute_ms)
+    # stage waits happen INSIDE trainer chunk spans, so the efficiency
+    # denominator must be the INCLUSIVE trainer wall (the exclusive
+    # compute sum already has the wait subtracted out — dividing by it
+    # would double-penalize the wait and clamp efficiency to 0 whenever
+    # waits exceed half the chunk)
+    compute_incl_ms = total_ms(lambda n: n.startswith(COMPUTE_PREFIXES),
+                               exclusive=False)
+    if compute_incl_ms > 0:
+        overlap_efficiency = max(0.0,
+                                 1.0 - stage_wait_ms / compute_incl_ms)
 
     def _kept_reason(ev):
         tid = (ev.get("args") or {}).get("trace_id")
@@ -143,6 +195,7 @@ def summarize(events, top=10, kept=None):
     top_spans = [{
         "name": ev["name"],
         "dur_ms": ev["dur"] / 1e3,
+        "self_ms": self_us[id(ev)] / 1e3,
         "ts_ms": ev["ts"] / 1e3,
         "thread": threads.get(ev["tid"], str(ev["tid"])),
         "request_id": (ev.get("args") or {}).get("request_id"),
@@ -158,8 +211,8 @@ def summarize(events, top=10, kept=None):
         if _kept_reason(ev) and (ev.get("args") or {}).get("request_id")})
 
     names = {name: {"count": c, "total_ms": t / 1e3, "mean_ms": t / c / 1e3,
-                    "max_ms": m / 1e3}
-             for name, (c, t, m) in by_name.items()}
+                    "max_ms": m / 1e3, "self_ms": s / 1e3}
+             for name, (c, t, m, s) in by_name.items()}
 
     instant_counts = defaultdict(int)
     for ev in instants:
@@ -176,6 +229,8 @@ def summarize(events, top=10, kept=None):
             "queue_wait_ms": queue_wait_ms,
             "compile_ms": compile_ms,
             "serving_ms": serving_ms,
+            "serving_self_ms": serving_self_ms,
+            "basis": "exclusive",
         },
         "overlap_efficiency": overlap_efficiency,
         "by_name": names,
@@ -203,20 +258,25 @@ def format_summary(summary):
     lines.append("  %-28s %12.2f ms" % ("serving queue wait",
                                         cp["queue_wait_ms"]))
     lines.append("  %-28s %12.2f ms" % ("XLA compiles", cp["compile_ms"]))
-    lines.append("  %-28s %12.2f ms" % ("serving requests (http)",
-                                        cp["serving_ms"]))
+    lines.append("  %-28s %12.2f ms  (self %.2f ms)"
+                 % ("serving requests (http)", cp["serving_ms"],
+                    cp.get("serving_self_ms", cp["serving_ms"])))
+    lines.append("  (categories are EXCLUSIVE time: children are not "
+                 "re-counted into parents)")
     if summary["overlap_efficiency"] is not None:
         lines.append("  staging overlap efficiency: %.1f%%"
                      % (summary["overlap_efficiency"] * 100.0))
     lines.append("")
-    lines.append("Per-span aggregates:")
-    lines.append("  %-32s %8s %12s %10s %10s"
-                 % ("name", "count", "total ms", "mean ms", "max ms"))
+    lines.append("Per-span aggregates (self = exclusive of children):")
+    lines.append("  %-32s %8s %12s %12s %10s %10s"
+                 % ("name", "count", "total ms", "self ms", "mean ms",
+                    "max ms"))
     for name in sorted(summary["by_name"],
                        key=lambda n: -summary["by_name"][n]["total_ms"]):
         st = summary["by_name"][name]
-        lines.append("  %-32s %8d %12.2f %10.3f %10.3f"
-                     % (name, st["count"], st["total_ms"], st["mean_ms"],
+        lines.append("  %-32s %8d %12.2f %12.2f %10.3f %10.3f"
+                     % (name, st["count"], st["total_ms"],
+                        st.get("self_ms", st["total_ms"]), st["mean_ms"],
                         st["max_ms"]))
     if summary["instant_counts"]:
         lines.append("")
