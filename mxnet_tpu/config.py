@@ -157,6 +157,37 @@ KNOBS = dict([
     _k("MXNET_GEN_QUEUE_SIZE", 64, int, "wired",
        "generation serving: waiting-request bound before ServerBusy "
        "backpressure (serving/generation/scheduler.py)"),
+    _k("MXNET_GEN_PREFILL_CHUNK", 0, int, "wired",
+       "generation serving: chunked-prefill rung size — long prompts are "
+       "split into chunks of this many tokens interleaved with decode "
+       "iterations, so a 4k prompt no longer stalls every live stream's "
+       "next token (0 = monolithic prefill; 128 is a good chip default)"),
+    _k("MXNET_GEN_PREFIX_CACHE", 1, int, "wired",
+       "generation serving: copy-on-admit prefix KV cache — admits whose "
+       "prompt starts with a cached prefix copy the slab into their slot "
+       "via dynamic_update_slice and skip that many prefill tokens "
+       "(serving/generation/prefix_cache.py; 0 = off)"),
+    _k("MXNET_GEN_PREFIX_BLOCK", 32, int, "wired",
+       "prefix cache sharing granularity: prefixes are stored/probed at "
+       "multiples of this many tokens — finer blocks skip more of a "
+       "shared prompt, coarser blocks bound entry count"),
+    _k("MXNET_GEN_PREFIX_CACHE_MB", 256, int, "wired",
+       "prefix cache slab-byte budget; exceeding it LRU-evicts entries "
+       "whose refcount is zero (<= 0 disables the bound)"),
+    _k("MXNET_GEN_SPEC_K", 4, int, "wired",
+       "speculative decoding: draft tokens proposed per verify step "
+       "(serving/generation/speculative.py; the scheduler engages the "
+       "speculative path only when a draft engine is attached)"),
+    _k("MXNET_GEN_LANE", "mixed", str, "wired",
+       "generation lane policy: 'mixed' (default), 'prefill' (requests "
+       "retire after first token + prefix-cache publish — the "
+       "disaggregation handoff), or 'decode' (admits expect prefix-cache "
+       "coverage; misses are counted as decode_lane_misses)"),
+    _k("MXNET_FLASH_ATTENTION", 1, int, "wired",
+       "dispatch _contrib_dot_product_attention to the pallas flash "
+       "kernels when the problem aligns and a TPU is present (ops/nn.py; "
+       "0 = always take the XLA softmax path — the with/without switch "
+       "benchmark/bench_lm.py records the BERT MFU delta with)"),
     _k("MXNET_HTTP_MAX_BODY", 8 * 1024 * 1024, int, "wired",
        "ModelServer POST body cap in bytes: a larger client-declared "
        "Content-Length is consumed in bounded chunks and refused with "

@@ -96,6 +96,39 @@ class MultiHeadAttention(HybridBlock):
             layout="BSHD")
         return self.proj(out.reshape((B, 1, C))), k_cache, v_cache
 
+    def step_chunk(self, x, k_cache, v_cache, start):
+        """A multi-token incremental step: append a whole *chunk* of new
+        hidden states against cached K/V.
+
+        ``x (B, C, units)`` is a chunk of C consecutive positions starting
+        at absolute position ``start[b]`` per row; ``k_cache`` /
+        ``v_cache (B, S, H, D)`` hold the first ``start[b]`` committed
+        keys/values. Writes the chunk's K/V at ``start`` (per-row
+        ``dynamic_update_slice``) and attends each chunk query at absolute
+        position ``start[b] + i`` to every cached position ``<= start[b]
+        + i`` — causal *within* the chunk, full over the prefix. This is
+        the one program shape behind chunked prefill, prefix-cache suffix
+        fill, and the speculative verify step: ``step`` is the ``C == 1``
+        special case, a full prefill is the ``start == 0`` special case.
+        Chunk rows past the caller's valid count produce garbage outputs
+        AND garbage cache writes — both unreachable, because committed
+        lengths gate every later attention mask and the next chunk's
+        write overlays the pad tail before reading it."""
+        from .. import ndarray as nd
+        B, C, _ = x.shape
+        q, k, v = self._split_qkv(x)
+        k_cache = nd.kv_cache_update(k_cache, k, start)
+        v_cache = nd.kv_cache_update(v_cache, v, start)
+        S = k_cache.shape[1]
+        span = nd.arange(0, S, dtype="int32").reshape((1, 1, S))
+        qpos = start.reshape((B, 1, 1)) + \
+            nd.arange(0, C, dtype="int32").reshape((1, C, 1))
+        kv_mask = (span < qpos + 1).reshape((B, 1, C, S))
+        out = nd._contrib_dot_product_attention(
+            q, k_cache, v_cache, mask=kv_mask, dropout=0.0, causal=False,
+            layout="BSHD")
+        return self.proj(out.reshape((B, C, self._units))), k_cache, v_cache
+
 
 class TransformerEncoderLayer(HybridBlock):
     """Pre-norm block (attention + MLP)."""
@@ -135,6 +168,12 @@ class TransformerEncoderLayer(HybridBlock):
         """Incremental-decode step (see :meth:`MultiHeadAttention.step`)."""
         a, k_cache, v_cache = self.attn.step(self.ln1(x), k_cache, v_cache,
                                              positions)
+        return self._ffn(x + self.dropout(a)), k_cache, v_cache
+
+    def step_chunk(self, x, k_cache, v_cache, start):
+        """Chunk-append step (see :meth:`MultiHeadAttention.step_chunk`)."""
+        a, k_cache, v_cache = self.attn.step_chunk(self.ln1(x), k_cache,
+                                                   v_cache, start)
         return self._ffn(x + self.dropout(a)), k_cache, v_cache
 
 
@@ -225,6 +264,37 @@ class TransformerLM(HybridBlock):
         last = nd.one_hot(lengths - 1, depth=T)              # (B, T)
         h_last = nd.sum(x * last.reshape((B, T, 1)), axis=1)  # (B, C)
         return self.head(h_last), cache
+
+    def prefill_chunk(self, tokens, cache, start):
+        """Append a chunk of ``C`` tokens per row at per-row offsets.
+
+        ``tokens (B, C)`` int — consecutive prompt/draft tokens whose
+        first element sits at absolute position ``start[b]`` (int32
+        ``(B,)``); ``cache`` as returned by :meth:`init_cache` /
+        :meth:`prefill`, holding ``start[b]`` committed positions per
+        row. Returns ``(logits (B, C, vocab), new_cache)`` where
+        ``logits[b, i]`` is the next-token distribution after consuming
+        ``tokens[b, :i+1]`` — exactly what the speculative verify step
+        scores and what chunked prefill samples its first token from
+        (row ``valid - 1`` of the final chunk). Purely functional like
+        :meth:`step`; pad rows write garbage K/V past the caller's valid
+        count, unreachable through committed lengths (see
+        ``MultiHeadAttention.step_chunk``)."""
+        from .. import ndarray as nd
+        B, C = tokens.shape
+        pos = start.reshape((B, 1)) + \
+            nd.arange(0, C, dtype="int32").reshape((1, C))
+        # clamp for the position-embedding gather only: pad-tail positions
+        # of the final chunk can run past max_len; their rows are garbage
+        # by contract either way
+        pos = nd.minimum(pos, self._max_len - 1)
+        x = self.embed(tokens) + self.pos_embed(pos)
+        new_cache = []
+        for (k_c, v_c), blk in zip(cache, self.blocks):
+            x, k_c, v_c = blk.step_chunk(x, k_c, v_c, start)
+            new_cache.append((k_c, v_c))
+        x = self.ln_f(x)
+        return self.head(x), new_cache
 
     def step(self, tokens, cache, lengths):
         """One fused decode step for a whole batch of sequences.

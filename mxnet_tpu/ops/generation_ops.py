@@ -29,7 +29,8 @@ import jax.numpy as jnp
 from .registry import register
 
 __all__ = ["sample_greedy", "sample_temperature", "sample_top_k",
-           "generation_sample", "kv_cache_update", "arena_update"]
+           "generation_sample", "kv_cache_update", "arena_update",
+           "arena_slice"]
 
 _NEG_INF = -1e9  # large-negative fill that stays finite in fp16/bf16
 
@@ -108,6 +109,22 @@ def kv_cache_update(cache, new, positions):
         return jax.lax.dynamic_update_slice_in_dim(c, n, p, axis=0)
 
     return jax.vmap(_row)(cache, jnp.asarray(new, cache.dtype), pos)
+
+
+@register("_contrib_arena_slice", aliases=("arena_slice",),
+          differentiable=False)
+def arena_slice(arena, index, size=1, axis=1):
+    """Read ``size`` rows of ``arena`` at offset ``index`` (traced scalar)
+    on ``axis``, full extent on every other axis — the inverse of
+    :func:`arena_update`, used by the chunked-prefill program to pull one
+    slot's K/V rows out of the ``(layers, slots, seq, heads, head_dim)``
+    arena and by the prefix cache to extract a reusable slab. ``size`` is
+    static; out-of-range indices clamp (lax semantics)."""
+    starts = [jnp.asarray(0, jnp.int32)] * arena.ndim
+    starts[int(axis)] = jnp.asarray(index, jnp.int32).reshape(())
+    sizes = list(arena.shape)
+    sizes[int(axis)] = int(size)
+    return jax.lax.dynamic_slice(arena, tuple(starts), tuple(sizes))
 
 
 @register("_contrib_arena_update", aliases=("arena_update",),

@@ -729,6 +729,18 @@ def UpSampling(*data, scale=1, sample_type="nearest", num_args=1,
 # ------------------------------------------------------------ attention
 
 
+def _flash_enabled():
+    """Single gate for the pallas flash-attention dispatch: the
+    registered ``MXNET_FLASH_ATTENTION`` knob (0 disables — the
+    with/without benchmark switch) plus the legacy ``MXTPU_DISABLE_FLASH``
+    escape hatch."""
+    import os
+    if os.environ.get("MXTPU_DISABLE_FLASH"):
+        return False
+    from .. import config as _config
+    return bool(_config.get("MXNET_FLASH_ATTENTION"))
+
+
 def _reduce_key_mask(mask, batch, key_len):
     """Reduce a BERT-style broadcastable keep-mask to (B, S_k) for the
     flash kernels. Returns (kv_mask, ok): ok=False means the mask shape
@@ -773,7 +785,7 @@ def dot_product_attention(query, key, value, mask=None, dropout=0.0,
                 and (drop == 0.0 or rng_key is not None)
                 and flash_attention_bshd_usable(query.shape,
                                                 query.shape[-1])
-                and not os.environ.get("MXTPU_DISABLE_FLASH")):
+                and _flash_enabled()):
             try:
                 on_tpu = any(d.platform not in ("cpu",)
                              for d in jax.devices())
@@ -797,8 +809,7 @@ def dot_product_attention(query, key, value, mask=None, dropout=0.0,
             layout="BHSD", rng_key=rng_key, train=train)
         return jnp.transpose(out, (0, 2, 1, 3))
 
-    if query.ndim == 4 and scaled and \
-            not os.environ.get("MXTPU_DISABLE_FLASH"):
+    if query.ndim == 4 and scaled and _flash_enabled():
         from .pallas_kernels import flash_attention, flash_attention_usable
         # BERT-style key padding masks broadcast over q: reducible to (B,S)
         kv_mask, mask_ok = _reduce_key_mask(mask, query.shape[0],

@@ -407,6 +407,12 @@ class ModelVersion:
             state = self.state
             inflight = self._inflight
         out = {"state": state, "inflight": inflight}
+        if self.generator is not None:
+            lane = getattr(self.generator, "lane_policy", None)
+            if lane is not None:
+                # disaggregation role: operators (and the gateway) see
+                # which versions are prefill-only / decode-only lanes
+                out["gen_lane"] = lane
         status = "ok"
         if state in ("draining", "retired"):
             status = state
@@ -578,8 +584,14 @@ class ModelRegistry:
         if mv.engine is not None:
             n += len(mv.engine.buckets)
         if mv.generator is not None:
-            geng = getattr(mv.generator, "engine", None)
-            n += len(getattr(geng, "ladder", ()) or ()) + 1
+            if hasattr(mv.generator, "program_bound"):
+                # generation-v2 schedulers also hold chunk-prefill,
+                # prefix insert/extract, and (with a draft attached)
+                # draft + verify programs — charge the full bound
+                n += mv.generator.program_bound()
+            else:
+                geng = getattr(mv.generator, "engine", None)
+                n += len(getattr(geng, "ladder", ()) or ()) + 1
         return n
 
     def _programs_in_use(self):
@@ -598,7 +610,7 @@ class ModelRegistry:
     def load(self, model, version, source=None, path=None,
              input_names=("data",), artifact_prefix="model", buckets=None,
              jit=True, warmup=None, prewarm=None, generator=None,
-             breaker=None, verify=True, max_batch_size=32,
+             gen_lane=None, breaker=None, verify=True, max_batch_size=32,
              max_latency_ms=5.0, max_queue_size=128,
              default_timeout_ms=None, retry_policy=None,
              metrics_window=2048):
@@ -612,8 +624,13 @@ class ModelRegistry:
         attaches a :class:`~.generation.GenerationScheduler` for
         ``/generate`` routing (its metrics are renamed into the
         ``generation.<model>.<version>`` namespace when they still carry
-        the default name). ``warmup`` pre-compiles every bucket NOW so
-        the later pointer flip costs zero compiles.
+        the default name). ``gen_lane`` declares the generator's
+        disaggregation role (``"prefill"`` / ``"decode"`` / ``"mixed"``,
+        see ``GenerationScheduler.set_lane_policy``): a ModelVersion
+        bulkhead becomes a prefill-only or decode-only lane, surfaced
+        through ``/healthz`` as ``gen_lane`` so gateway routing can split
+        long-prompt traffic at the fleet level. ``warmup`` pre-compiles
+        every bucket NOW so the later pointer flip costs zero compiles.
 
         When ``path`` carries AOT artifacts (an ``executables.mxa``
         exported by ``InferenceEngine.export_artifacts`` / CI's
@@ -704,6 +721,10 @@ class ModelRegistry:
                 # namespace the lane's generation rows so two models'
                 # stats cannot collide in the aggregate table
                 gm.name = "generation.%s.%s" % (model, version)
+            if gen_lane is not None:
+                generator.set_lane_policy(gen_lane)
+        elif gen_lane is not None:
+            raise FleetError("gen_lane=%r needs a generator" % (gen_lane,))
         # admission AFTER construction (ladder sizes known), BEFORE the
         # lane becomes routable; _admit_lock spans check -> registration
         # so the budget cannot be overshot by racing loads. ANY failure

@@ -17,10 +17,24 @@ passes per request — so the unit of scheduling drops from "request" to
   nothing), with greedy/temperature/top-k sampling under explicit PRNG
   keys.
 - :class:`GenerationScheduler` (``scheduler.py``) — continuous batching:
-  admit into free slots at iteration boundaries, one fused step for all
-  live slots, immediate retirement on EOS/budget, streamed tokens,
-  ``DynamicBatcher``-compatible backpressure/drain and a
-  ``generation.step`` chaos point.
+  deadline-aware admission into free slots at iteration boundaries, one
+  fused step for all live slots, immediate retirement on EOS/budget,
+  streamed tokens, ``DynamicBatcher``-compatible backpressure/drain and
+  a ``generation.step`` chaos point.
+- :class:`PrefixCache` (``prefix_cache.py``) — copy-on-admit prefix KV
+  reuse: token-hash-chain keyed, refcounted, LRU-evicted slabs installed
+  into a slot with one ``dynamic_update_slice`` so shared system prompts
+  skip prefill (bitwise-equal outputs).
+- :class:`SpeculativeDecoder` (``speculative.py``) — draft-then-verify:
+  a small draft model proposes k tokens, ONE fused fixed-signature
+  verify step on the target accepts the longest agreeing run —
+  token-exact greedy, multiple tokens per iteration.
+
+Chunked prefill (``MXNET_GEN_PREFILL_CHUNK``) slices long prompts into
+rung-sized chunks interleaved with decode iterations, and the scheduler
+can be declared a ``prefill``/``decode`` lane
+(``fleet.ModelRegistry.load(gen_lane=...)``) — the first step of
+prefill/decode disaggregation. See docs/serving.md §"Generation v2".
 
 ``ModelServer`` exposes it as ``POST /generate`` with chunked NDJSON
 token streaming (``serving/server.py``). Quickstart::
@@ -35,15 +49,20 @@ token streaming (``serving/server.py``). Quickstart::
 """
 from .decode import DEFAULT_LADDER, DecodeEngine, PromptTooLong
 from .kvcache import CacheFull, SlotKVCache, cache_stats
+from .prefix_cache import PrefixCache, prefix_stats
 from .scheduler import GenerationRequest, GenerationScheduler, \
     scheduler_stats
+from .speculative import SpeculativeDecoder
 
 __all__ = ["SlotKVCache", "CacheFull", "DecodeEngine", "PromptTooLong",
            "GenerationScheduler", "GenerationRequest", "DEFAULT_LADDER",
-           "gauge", "cache_stats", "scheduler_stats"]
+           "PrefixCache", "SpeculativeDecoder", "gauge", "cache_stats",
+           "scheduler_stats", "prefix_stats"]
 
 
 def gauge():
-    """The ``/metrics`` ``"generation"`` gauge: slot-arena occupancy plus
-    scheduler/compile state for every live instance."""
-    return {"kvcache": cache_stats(), "schedulers": scheduler_stats()}
+    """The ``/metrics`` ``"generation"`` gauge: slot-arena occupancy,
+    prefix-cache hit ledger, and scheduler/compile state for every live
+    instance."""
+    return {"kvcache": cache_stats(), "prefix": prefix_stats(),
+            "schedulers": scheduler_stats()}

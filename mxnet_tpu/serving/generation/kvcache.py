@@ -71,8 +71,16 @@ class SlotKVCache:
         self._held = set()
         self._lock = threading.Lock()
         self._c = {"acquires": 0, "releases": 0, "acquire_failures": 0,
-                   "resets": 0, "peak_in_use": 0}
+                   "resets": 0, "peak_in_use": 0, "hwm": 0}
         _registry.add(self)
+
+    def _note_tokens_locked(self):
+        """Track the token high-water mark (capacity-planning signal:
+        how full the arena has EVER been, not just now). Caller holds
+        the lock."""
+        total = int(self._lengths.sum())
+        if total > self._c["hwm"]:
+            self._c["hwm"] = total
 
     @classmethod
     def for_model(cls, model, num_slots, max_seq=None, dtype="float32",
@@ -150,6 +158,7 @@ class SlotKVCache:
             if slot not in self._held:
                 raise ValueError("slot %d is not held" % slot)
             self._lengths[slot] = n
+            self._note_tokens_locked()
 
     def advance(self, slots):
         """Bump lengths by one for each held slot in ``slots`` (the decode
@@ -163,6 +172,7 @@ class SlotKVCache:
                     raise ValueError("slot %d already at max_seq %d"
                                      % (int(slot), self.max_seq))
                 self._lengths[slot] += 1
+            self._note_tokens_locked()
 
     # ---- arena commit -----------------------------------------------------
     def commit(self, k_arena, v_arena):
@@ -174,14 +184,25 @@ class SlotKVCache:
     # ---- stats ------------------------------------------------------------
     def stats(self):
         with self._lock:
+            tokens = int(self._lengths.sum())
+            in_use = len(self._held)
             out = dict(self._c)
             out.update({
                 "num_slots": self.num_slots,
-                "in_use": len(self._held),
+                "in_use": in_use,
                 "free": len(self._free),
-                "occupancy": len(self._held) / float(self.num_slots),
+                "occupancy": in_use / float(self.num_slots),
                 "max_seq": self.max_seq,
-                "tokens_cached": int(self._lengths.sum()),
+                "tokens_cached": tokens,
+                # capacity-planning satellites: slots_peak = most slots
+                # ever simultaneously held; hwm = most tokens ever
+                # cached; fragmentation = held-but-empty fraction of the
+                # in-use slots' capacity (reserved arena the current
+                # sequences aren't using — oversized max_seq shows here)
+                "slots_peak": self._c["peak_in_use"],
+                "fragmentation": (1.0 - tokens /
+                                  float(in_use * self.max_seq)
+                                  if in_use else 0.0),
                 "arena_bytes": 2 * self.num_layers * self.num_slots *
                 self.max_seq * self.num_heads * self.head_dim *
                 _np.dtype(self.dtype).itemsize,
@@ -215,6 +236,8 @@ def _profiler_rows():
         rows[prefix + ".releases"] = (st["releases"], 0.0)
         rows[prefix + ".acquire_failures"] = (st["acquire_failures"], 0.0)
         rows[prefix + ".tokens_cached"] = (st["tokens_cached"], 0.0)
+        rows[prefix + ".hwm"] = (st["hwm"], 0.0)
+        rows[prefix + ".slots_peak"] = (st["slots_peak"], 0.0)
     return rows
 
 

@@ -50,17 +50,26 @@ GENERATION_PROM_COUNTERS = (
     ("rejected", "generation requests shed with ServerBusy"),
     ("expired", "generation requests expired in queue"),
     ("prefills", "prompt prefill executions"),
+    ("prefill_chunks", "chunked-prefill program calls interleaved with "
+     "decode iterations"),
     ("steps", "fused decode iterations"),
     ("step_failures", "decode iterations that faulted"),
     ("tokens_out", "tokens emitted across all sequences"),
     ("retired_eos", "sequences retired on EOS"),
     ("retired_length", "sequences retired on max_new_tokens"),
     ("retired_max_seq", "sequences retired on KV-slot capacity"),
+    ("retired_prefill", "sequences retired by a prefill-only lane after "
+     "first token + prefix-cache publish (the disaggregation handoff)"),
+    ("spec_rounds", "speculative draft-then-verify iterations"),
+    ("spec_drafted", "draft tokens proposed to the verify step"),
+    ("spec_accepted", "draft tokens the target's greedy choice accepted"),
 )
 GENERATION_PROM_GAUGES = (
     ("decode_tokens_s", "fleet decode throughput: tokens/s over step time"),
     ("avg_step_occupancy", "mean live slots per fused decode step"),
     ("queue_depth", "generation requests waiting for a slot"),
+    ("spec_acceptance_rate", "accepted/drafted over the speculative "
+     "decoding lifetime"),
 )
 
 
@@ -283,9 +292,12 @@ class GenerationMetrics:
         self._ttft = deque(maxlen=window)       # seconds
         self._tps = deque(maxlen=window)        # per-request tokens/s
         self._c = {"requests": 0, "ok": 0, "errors": 0, "rejected": 0,
-                   "expired": 0, "prefills": 0, "steps": 0,
-                   "step_failures": 0, "tokens_out": 0, "retired_eos": 0,
-                   "retired_length": 0, "retired_max_seq": 0}
+                   "expired": 0, "prefills": 0, "prefill_chunks": 0,
+                   "steps": 0, "step_failures": 0, "tokens_out": 0,
+                   "retired_eos": 0, "retired_length": 0,
+                   "retired_max_seq": 0, "retired_prefill": 0,
+                   "spec_rounds": 0,
+                   "spec_drafted": 0, "spec_accepted": 0}
         self._ttft_total = 0.0
         self._step_time = 0.0
         self._prefill_time = 0.0
@@ -313,11 +325,31 @@ class GenerationMetrics:
             self._c["prefills"] += 1
             self._prefill_time += seconds
 
+    def record_prefill_chunk(self):
+        """One chunked-prefill program call (a slice of a long prompt
+        interleaved between decode iterations)."""
+        with self._lock:
+            self._c["prefill_chunks"] += 1
+
     def record_step(self, live_slots, seconds):
         """One fused decode iteration over ``live_slots`` sequences."""
         with self._lock:
             self._c["steps"] += 1
             self._c["tokens_out"] += live_slots
+            self._step_slots += live_slots
+            self._step_time += seconds
+
+    def record_spec_round(self, live_slots, drafted, emitted, seconds):
+        """One speculative iteration: ``drafted`` proposals went into the
+        verify step, ``emitted`` tokens came out across ``live_slots``
+        sequences (``emitted - live_slots`` of them were accepted
+        drafts; the rest are the per-sequence bonus token)."""
+        with self._lock:
+            self._c["steps"] += 1
+            self._c["spec_rounds"] += 1
+            self._c["spec_drafted"] += drafted
+            self._c["spec_accepted"] += max(0, emitted - live_slots)
+            self._c["tokens_out"] += emitted
             self._step_slots += live_slots
             self._step_time += seconds
 
@@ -378,6 +410,9 @@ class GenerationMetrics:
                                 if step_time > 0 else 0.0),
             "avg_step_occupancy": (step_slots / c["steps"]
                                    if c["steps"] else 0.0),
+            "spec_acceptance_rate": (c["spec_accepted"] /
+                                     float(c["spec_drafted"])
+                                     if c["spec_drafted"] else 0.0),
         }
         out.update(c)
         if self._queue_depth_fn is not None:
@@ -389,6 +424,8 @@ class GenerationMetrics:
             try:
                 out["kvcache"] = self._engine.cache.stats()
                 out["compile"] = self._engine.compile_stats()
+                if getattr(self._engine, "prefix", None) is not None:
+                    out["prefix"] = self._engine.prefix.stats()
             except Exception:
                 pass
         return out

@@ -49,11 +49,21 @@ HIGHER, LOWER, INFO = "higher", "lower", "info"
 _HIGHER_PAT = re.compile(
     r"(img_s|img_per_sec|per_sec|_s_per_|qps|tokens_s|tok_s|/s$|"
     r"throughput|speedup|mfu|tflops|gflops|flops_rate|hits\b|"
-    r"efficiency|vs_baseline|ratio_better|samples_per)", re.I)
+    r"efficiency|vs_baseline|ratio_better|samples_per|tokens_saved|"
+    r"improvement)", re.I)
 _LOWER_PAT = re.compile(
     r"(_ms\b|_ms_|_ns\b|_ns_|ms_per|ns_per|_s\b$|seconds\b|p50|p95|p99|"
     r"latency|ttft|overhead|compile|misses|evictions|penalty|wait|"
     r"stall|dropped|expired|failures|errors|time_to)", re.I)
+
+# workload-composition ratios from the generation-v2 artifact: compared
+# and reported on drift, but never gated — a prefix hit-rate or
+# speculative acceptance rate moving tracks the WORKLOAD MIX (and the
+# draft model), not a performance regression; the throughput/TTFT
+# numbers they drive are the gated ones
+_RATE_INFO_PAT = re.compile(
+    r"(hit_rate|acceptance_rate|accepted_rate|skip_pct|skipped_pct|"
+    r"coverage|tokens_saved_pct|occupancy)", re.I)
 
 # path segments that are configuration/identity, never performance —
 # skipped entirely (comparing them as metrics would gate on noise like
@@ -180,6 +190,8 @@ def direction_for(path, overrides=None):
                 return d
     if _SKIP_PAT.search(path):
         return None
+    if _RATE_INFO_PAT.search(path):
+        return INFO
     if _HIGHER_PAT.search(path):
         return HIGHER
     if _LOWER_PAT.search(path):
