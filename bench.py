@@ -247,9 +247,24 @@ def section_serving_probe(ctx):
             "requests": requests}
 
 
+def section_elastic3d(ctx):
+    """Sharding-planner placement check (ISSUE-15): on the memory-
+    constrained MoE config at this round's device count, the planner's
+    dp x pp x ep placement vs pure-dp — modeled bytes/device (the
+    portable signal) plus measured step time, and the zero-drift guard
+    (no new compiles in existing CachedOp paths). The full supervised
+    recovery drill stays in benchmark/planner_bench.py (subprocess-
+    heavy; writes ELASTIC3D.json)."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from benchmark.planner_bench import bench_placement
+
+    return bench_placement(steps=6)
+
+
 SECTIONS = (
     ("resnet50_train", section_resnet50_train),
     ("serving_probe", section_serving_probe),
+    ("elastic3d", section_elastic3d),
     # last on purpose: it summarizes every CachedOp dispatch the round
     # made (the serving probe's ladder, any hybridized block)
     ("roofline_attribution", section_roofline_attribution),

@@ -295,6 +295,17 @@ KNOBS = dict([
        "when set, admin endpoints (ModelServer GET /drain, POST "
        "/debug/profile) require a matching X-Admin-Token header; "
        "empty = unguarded (dev/tests)"),
+    _k("MXNET_PLAN_HBM_BYTES", 0, int, "wired",
+       "sharding planner per-device memory budget: placements whose "
+       "modeled params+optimizer+activation bytes/device exceed it are "
+       "infeasible (parallel/planner.py; 0 = unconstrained)"),
+    _k("MXNET_PLAN_MAX_PP", 0, int, "wired",
+       "sharding planner cap on the pipeline factor — bound the bubble "
+       "fraction regardless of what the cost model prefers (0 = no cap)"),
+    _k("MXNET_PLAN_FORCE", "", str, "wired",
+       "bypass the placement search with an explicit plan, e.g. "
+       "'dp=2,pp=2,ep=2' — still validated against the model profile "
+       "(divisibility + memory gate)"),
     _k("MXNET_PROF_ATTRIBUTION", 1, int, "wired",
        "per-executable roofline accounting: capture bytes-accessed from "
        "XLA cost analysis at compile time and measure per-dispatch wall "

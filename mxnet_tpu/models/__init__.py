@@ -2,4 +2,5 @@
 from .transformer import (TransformerLM, MultiHeadAttention,
                           TransformerEncoderLayer, transformer_lm_tiny,
                           transformer_lm_small, transformer_lm_base, tp_rules)
+from .moe_transformer import MoETransformerLM, moe_lm_tiny
 from .lstm_lm import RNNModel

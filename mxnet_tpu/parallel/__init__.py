@@ -16,6 +16,8 @@ from .datafeed import DeviceFeed, feed_stats
 from .checkpoint import save_checkpoint, restore_checkpoint
 from .ring_attention import ring_attention, ring_attention_sharded
 from .pipeline import pipeline_apply, pipeline_spmd
+from .planner import (ModelProfile, PlanError, PlanMismatchError,
+                      ShardingPlan, plan_sharding)
 
 
 def initialize(coordinator_address=None, num_processes=None, process_id=None):

@@ -12,6 +12,7 @@ reference compatibility; this is the path that scales to pod-sized models.
 """
 from __future__ import annotations
 
+import json
 import os
 import shutil
 
@@ -40,6 +41,12 @@ def _tree(trainer):
         "values": dict(zip(keys, trainer._values)),
         "states": {k: list(s) for k, s in zip(keys, trainer._states)},
     }
+    # the full placement, not just its size: restore onto a different
+    # placement counts a re-plan (dp x pp x ep state re-placed under a
+    # new factorization) and an impossible reshard can name both sides
+    plan = getattr(trainer, "_plan", None)
+    if plan is not None:
+        tree["plan"] = {k: np.int64(v) for k, v in plan.to_dict().items()}
     # wrappers with their own carried state (resilience.guardrails
     # GuardedStep: loss scale, clean-step counter, skip counter) ride in
     # the same atomic checkpoint, so restore-and-replay reproduces their
@@ -82,6 +89,16 @@ def _save_checkpoint(trainer, path, force):
         raise FileExistsError("checkpoint %s exists (force=False)" % path)
     ckptr = ocp.PyTreeCheckpointer()
     ckptr.save(tmp, _tree(trainer), force=force)
+    # advisory plan record INSIDE the staged dir (orbax ignores foreign
+    # files): it publishes atomically WITH the checkpoint, so a failed
+    # reshard can always name the placement this exact checkpoint was
+    # saved under — never a stale claim from a previous save (the
+    # authoritative copy rides the tree; this one is readable without an
+    # orbax restore, which is the point when the restore itself fails)
+    plan = getattr(trainer, "_plan", None)
+    if plan is not None:
+        with open(os.path.join(tmp, "plan.json"), "w") as f:
+            json.dump(plan.to_dict(), f)
     # a "crash" here (fault injected mid-save) must leave `path` untouched
     _chaos.point("checkpoint.save")
     if os.path.exists(path):  # force=False already rejected before the write
@@ -130,6 +147,29 @@ def _restore_checkpoint(trainer, path):
             lambda m: np.zeros(m.shape, m.dtype), saved["extra"])
     if "world" in tpl and saved is not None and "world" not in saved_keys:
         tpl.pop("world")  # checkpoint from before topology was recorded
+    # same both-ways adaptation for the recorded plan (a plan-stamped
+    # checkpoint restores into a planless trainer and vice versa)
+    if "plan" in tpl and "plan" not in saved_keys:
+        tpl.pop("plan")
+    elif saved is not None and "plan" in saved_keys and "plan" not in tpl:
+        tpl["plan"] = jax.tree_util.tree_map(
+            lambda m: np.zeros(m.shape, m.dtype), saved["plan"])
+    # reshard-impossible fast path: when metadata is readable, a saved
+    # value whose SHAPE cannot land on the current trainer is a typed
+    # plan/topology mismatch, not a deferred orbax/tensorstore failure
+    if saved is not None and "values" in saved_keys:
+        try:
+            saved_vals = dict(saved["values"].items())
+        except (AttributeError, TypeError):
+            saved_vals = {}
+        for k, v in tpl["values"].items():
+            m = saved_vals.get(k)
+            if m is not None and hasattr(m, "shape") \
+                    and tuple(m.shape) != tuple(v.shape):
+                raise _wrap_mismatch(trainer, path, ValueError(
+                    "param %s saved with shape %s cannot reshard onto "
+                    "current shape %s" % (k, tuple(m.shape),
+                                          tuple(v.shape))))
 
     def _restore(tpl):
         restore_args = jax.tree_util.tree_map(
@@ -141,16 +181,48 @@ def _restore_checkpoint(trainer, path):
 
     try:
         restored = _restore(tpl)
-    except (ValueError, KeyError):
+    except (ValueError, KeyError) as e:
         # tree-structure mismatch with metadata() unavailable: the only
-        # template adaptation that couldn't happen up front is the
-        # optional "world" key (pre-topology checkpoint) — retry without
-        # it. Runtime/shape errors are NOT retried: they would only fail
-        # again and mask the primary error.
-        if saved is not None or "world" not in tpl:
+        # template adaptations that couldn't happen up front are the
+        # optional "plan"/"world" keys — an older checkpoint may lack
+        # EITHER or BOTH, so retry the combinations most-likely first (a
+        # pre-planner checkpoint still has "world": dropping both at
+        # once would un-match it again). Runtime/shape errors are NOT
+        # retried: they would only fail again and mask the primary
+        # error.
+        restored = None
+        if saved is None:
+            candidates = []
+            for drop in (("plan",), ("world",), ("plan", "world")):
+                if all(k in tpl for k in drop):
+                    candidates.append({k: v for k, v in tpl.items()
+                                       if k not in drop})
+            if "plan" not in tpl:
+                # the reverse direction: a plan-stamped checkpoint into a
+                # planless trainer — the plan subtree's template is
+                # statically known (int64 scalars), so it can be ADDED
+                # and the restored copy simply ignored
+                t3 = dict(tpl)
+                t3["plan"] = {k: np.int64(0) for k in
+                              ("dp", "pp", "ep", "sp", "n_devices")}
+                candidates.append(t3)
+            for t2 in candidates:
+                try:
+                    restored = _restore(t2)
+                    break
+                except (ValueError, KeyError):
+                    continue
+        if restored is None:
+            if saved is None:
+                # no metadata to rule a reshard in or out: best-effort
+                # placement context (the message embeds the raw error)
+                raise _wrap_mismatch(trainer, path, e) from e
+            # metadata WAS readable and the shape pre-check above passed:
+            # this failure is not a placement mismatch (an IO blip on a
+            # legitimate re-plan restore must not be mislabeled as an
+            # impossible reshard — a retry on the same placement is the
+            # right recovery, not a re-plan)
             raise
-        tpl.pop("world")
-        restored = _restore(tpl)
     keys = ["p%04d" % i for i in range(len(trainer._params))]
     trainer._t = int(restored["step"])
     trainer._values = [restored["values"][k] for k in keys]
@@ -167,4 +239,39 @@ def _restore_checkpoint(trainer, path):
             _elastic._count("resharded_restores")
             _trace.instant("elastic.reshard", saved_world=saved_world,
                            world=now_world, step=trainer._t)
+    cur_plan = getattr(trainer, "_plan", None)
+    if "plan" in restored and cur_plan is not None:
+        saved_plan = {k: int(v) for k, v in restored["plan"].items()}
+        if saved_plan != cur_plan.to_dict():
+            # the elastic RE-PLAN path: dp x pp x ep state written under
+            # one placement landed on a planner-chosen different one
+            from ..parallel.planner import _describe_dict
+            from ..resilience import elastic as _elastic
+            _elastic._count("replans")
+            _trace.instant("elastic.replan",
+                           saved=_describe_dict(saved_plan),
+                           current=cur_plan.describe(),
+                           step=trainer._t)
     return trainer
+
+
+def _wrap_mismatch(trainer, path, exc):
+    """Dress a restore failure in placement context: when the sidecar
+    names a saved plan that differs from the restoring trainer's, the
+    failure IS a reshard-impossible transition — surface the typed
+    :class:`~mxnet_tpu.parallel.planner.PlanMismatchError` naming both
+    placements instead of the raw orbax/pytree error. Returns the
+    exception to raise (the original one when no plan context exists)."""
+    saved_plan = None
+    try:
+        with open(os.path.join(path, "plan.json")) as f:
+            saved_plan = json.load(f)
+    except (OSError, ValueError):
+        pass
+    cur = getattr(trainer, "_plan", None)
+    cur_d = cur.to_dict() if cur is not None else None
+    if saved_plan is not None and saved_plan != cur_d:
+        from .planner import PlanMismatchError
+        return PlanMismatchError(saved_plan, cur_d,
+                                 "%s: %s" % (type(exc).__name__, exc))
+    return exc

@@ -163,6 +163,12 @@ class DeviceFeed:
         ``DataLoader(pin_memory=True)`` path).
     batch_axes : tuple of str
         Mesh axes the leading (batch) dim shards over.
+    plan : ShardingPlan, optional
+        Derive ``mesh`` and ``batch_axes`` from a
+        :class:`~mxnet_tpu.parallel.planner.ShardingPlan` — batches are
+        staged onto the plan's DATA axes (dp and ep jointly for MoE
+        placements) instead of a hardcoded dp sharding. An explicit
+        ``mesh`` still wins (the plan then only supplies the axes).
     depth : int, optional
         Ring depth K (default ``MXNET_DATAFEED_DEPTH``): how many batches
         may be in flight/resident ahead of consumption.
@@ -178,10 +184,14 @@ class DeviceFeed:
     """
 
     def __init__(self, source, mesh=None, batch_axes=("dp",), depth=None,
-                 output="arrays", timeout=120.0, name="default"):
+                 output="arrays", timeout=120.0, name="default", plan=None):
         if output not in ("arrays", "batch"):
             raise ValueError("output must be 'arrays' or 'batch', got %r"
                              % (output,))
+        if plan is not None:
+            if mesh is None:
+                mesh = plan.mesh()
+            batch_axes = plan.data_axes
         if depth is None:
             from .. import config as _config
             depth = _config.get("MXNET_DATAFEED_DEPTH")

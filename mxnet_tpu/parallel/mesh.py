@@ -22,38 +22,42 @@ __all__ = ["MeshConfig", "make_mesh", "current_mesh", "set_mesh",
 
 _CURRENT = [None]
 
-AXES = ("dp", "pp", "tp", "sp")
+AXES = ("dp", "pp", "ep", "tp", "sp")
 
 
 class MeshConfig:
     """Sizes per logical axis; -1 on dp means 'use remaining devices'."""
 
-    def __init__(self, dp=-1, pp=1, tp=1, sp=1):
-        self.dp, self.pp, self.tp, self.sp = dp, pp, tp, sp
+    def __init__(self, dp=-1, pp=1, ep=1, tp=1, sp=1):
+        self.dp, self.pp, self.ep = dp, pp, ep
+        self.tp, self.sp = tp, sp
 
     def resolve(self, n_devices):
-        fixed = self.pp * self.tp * self.sp
+        fixed = self.pp * self.ep * self.tp * self.sp
         dp = self.dp
         if dp == -1:
             assert n_devices % fixed == 0, \
-                "device count %d not divisible by pp*tp*sp=%d" % (n_devices,
-                                                                  fixed)
+                "device count %d not divisible by pp*ep*tp*sp=%d" \
+                % (n_devices, fixed)
             dp = n_devices // fixed
         assert dp * fixed == n_devices, \
             "mesh %s does not cover %d devices" % (
-                (dp, self.pp, self.tp, self.sp), n_devices)
-        return (dp, self.pp, self.tp, self.sp)
+                (dp, self.pp, self.ep, self.tp, self.sp), n_devices)
+        return (dp, self.pp, self.ep, self.tp, self.sp)
 
 
-def make_mesh(dp=-1, pp=1, tp=1, sp=1, devices=None):
+def make_mesh(dp=-1, pp=1, ep=1, tp=1, sp=1, devices=None):
     """Create a Mesh over the given (default: all) devices.
 
-    Axis order is (dp, pp, tp, sp): tp/sp innermost so tensor/sequence
-    collectives ride the fastest ICI links (scaling-book layout rule).
+    Axis order is (dp, pp, ep, tp, sp): tp/sp innermost so tensor/
+    sequence collectives ride the fastest ICI links (scaling-book layout
+    rule); ep sits between pp and tp so expert all_to_alls stay within a
+    stage's slice. A :class:`~mxnet_tpu.parallel.planner.ShardingPlan`
+    chooses the axis sizes for composed placements.
     """
     if devices is None:
         devices = jax.devices()
-    shape = MeshConfig(dp, pp, tp, sp).resolve(len(devices))
+    shape = MeshConfig(dp, pp, ep, tp, sp).resolve(len(devices))
     arr = np.array(devices).reshape(shape)
     mesh = Mesh(arr, AXES)
     return mesh

@@ -130,4 +130,8 @@ def moe_ffn_sharded(x, gate_w, w1, w2, mesh, capacity_factor=1.25,
                    out_specs=(P(axis), P()))
     lead = x.shape[:-1]
     y, aux = fn(x.reshape(-1, x.shape[-1]), gate_w, w1, w2)
+    # a dead ep peer wedges the all_to_all exchange silently — bound the
+    # wait (collective watchdog; free unless the deadline knob is armed)
+    from ..resilience.elastic import guard_wait
+    y, aux = guard_wait((y, aux), op="moe.dispatch")
     return y.reshape(lead + (x.shape[-1],)), aux

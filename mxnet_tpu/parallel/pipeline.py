@@ -105,4 +105,8 @@ def pipeline_spmd(fn, stacked_params, batch, mesh, n_micro, axis_name="pp"):
         lambda v: jax.device_put(v, NamedSharding(mesh, p_stage)),
         stacked_params)
     x_sh = jax.device_put(batch, NamedSharding(mesh, p_rep))
-    return jax.jit(shmapped)(params_sh, x_sh)
+    out = jax.jit(shmapped)(params_sh, x_sh)
+    # a dead pp peer wedges the ppermute ring silently — bound the wait
+    # (collective watchdog; free unless the deadline knob is armed)
+    from ..resilience.elastic import guard_wait
+    return guard_wait(out, op="pipeline.dispatch")

@@ -99,4 +99,8 @@ def ring_attention_sharded(q, k, v, mesh, axis_name="sp", causal=False,
     qs = jax.device_put(q, NamedSharding(mesh, spec))
     ks = jax.device_put(k, NamedSharding(mesh, spec))
     vs = jax.device_put(v, NamedSharding(mesh, spec))
-    return jax.jit(run)(qs, ks, vs)
+    out = jax.jit(run)(qs, ks, vs)
+    # a dead sp peer wedges the K/V rotation ring silently — bound the
+    # wait (collective watchdog; free unless the deadline knob is armed)
+    from ..resilience.elastic import guard_wait
+    return guard_wait(out, op="ring.dispatch")

@@ -60,16 +60,34 @@ class ShardedTrainer:
 
     def __init__(self, block, loss_fn, optimizer="sgd", optimizer_params=None,
                  mesh=None, param_rules=None, batch_axes=("dp",),
-                 dtype=None, preprocess=None):
+                 dtype=None, preprocess=None, plan=None):
         """``preprocess``: optional callable applied to each model input
         INSIDE the compiled step (e.g. uint8 NHWC → normalized bf16 NCHW).
         Host ships raw uint8 over the link (4× fewer bytes than f32); the
         cast/normalize/transpose fuse into the step on device — the
         TPU-native input pipeline (reference normalized on host CPU,
-        src/io/iter_normalize.h)."""
+        src/io/iter_normalize.h).
+
+        ``plan``: a :class:`~mxnet_tpu.parallel.planner.ShardingPlan` —
+        the mesh, the batch axes, and the naming-convention param rules
+        all derive from it (explicit ``mesh`` still wins if given).
+        Caller ``param_rules`` are PREPENDED: rule matching is
+        first-match-wins, so an explicit rule overrides the plan's
+        convention for the params it names (e.g. a tp spec on a
+        ``stack_*`` param) and the plan's rules back-fill the rest. The
+        jitted step is then compiled against the resulting shardings,
+        checkpoints record the plan, and multi-axis placements get
+        their fused-step result waits bounded by the collective
+        watchdog."""
         self._block = block
         self._loss = loss_fn
         self._preprocess = preprocess
+        self._plan = plan
+        if plan is not None:
+            if mesh is None:
+                mesh = plan.mesh()
+            batch_axes = plan.data_axes
+            param_rules = list(param_rules or []) + list(plan.param_rules())
         self._mesh = mesh if mesh is not None else make_mesh()
         optimizer_params = dict(optimizer_params or {})
         self._lr = optimizer_params.get("learning_rate", 0.01)
@@ -83,9 +101,18 @@ class ShardedTrainer:
         self._shardings = shard_params(self._params, self._mesh, param_rules)
         self._values = []
         for p, s in zip(self._params, self._shardings):
-            v = p.data()._data
-            if dtype is not None:
-                v = v.astype(dtype)
+            src = p.data()._data
+            v = src.astype(dtype) if dtype is not None else src
+            if v is src:
+                # own the buffer BEFORE placing (astype is a no-op alias
+                # when the dtype already matches): device_put is
+                # zero-copy for the shard landing on the source device,
+                # and the donated step deleting a buffer the Block's
+                # eager param still references would kill eager forwards
+                # (and any second trainer built from the same Block)
+                # after one step — the sync_back/_owned_on hazard, at
+                # init
+                v = jnp.array(v, copy=True)
             self._values.append(jax.device_put(v, s))
         self._states = [tuple(jax.device_put(x, s) for x in init_state(v))
                         for v, s in zip(self._values, self._shardings)]
@@ -97,6 +124,24 @@ class ShardedTrainer:
     @property
     def mesh(self):
         return self._mesh
+
+    @property
+    def plan(self):
+        """The :class:`~mxnet_tpu.parallel.planner.ShardingPlan` this
+        trainer was built from, or ``None`` (mesh given directly)."""
+        return self._plan
+
+    def _await_plan(self, outputs):
+        """Multi-axis plans (pp/ep/sp > 1): bound the wait for the fused
+        step's collectives — a hung pipeline stage or MoE all_to_all
+        raises :class:`~mxnet_tpu.resilience.elastic.CollectiveTimeout`
+        instead of wedging the job forever. Free (async semantics
+        untouched) unless ``MXNET_ELASTIC_COLLECTIVE_DEADLINE_MS`` is
+        armed; the results are already committed to the trainer, so the
+        state stays consistent for the re-forming restart either way."""
+        if self._plan is not None and self._plan.multi_axis:
+            from ..resilience.elastic import guard_wait
+            guard_wait(outputs, op="trainer.dispatch")
 
     def _trainable_indices(self):
         return [i for i, p in enumerate(self._params)
@@ -209,6 +254,7 @@ class ShardedTrainer:
         loss_val, self._values, self._states, aux = self._step_fn(
             key, self._values, self._states, self._t,
             lr if lr is not None else self._lr, *xs, y)
+        self._await_plan((loss_val, self._values, self._states))
         # functional aux-state writeback (BatchNorm moving stats)
         for h, v in zip(self._pure.aux_handles, aux):
             h._data = v
@@ -254,7 +300,12 @@ class ShardedTrainer:
         losses, self._values, self._states = self._step_many_fn(
             key, self._values, self._states, self._t + 1,
             lr if lr is not None else self._lr, *xs, ys)
+        # _t commits WITH the values (the dispatch already consumed the
+        # donated state): a CollectiveTimeout out of the guarded wait
+        # below must leave counter and params consistent for the
+        # emergency checkpoint the re-forming exit path writes
         self._t += n_steps
+        self._await_plan((losses, self._values, self._states))
         # aux values (BatchNorm running stats) live in the carried values;
         # sync_back() lands them in the Block's handles. Doing it here per
         # call would add ~2 host roundtrips per BN layer per span — ~5s on
@@ -397,7 +448,9 @@ class ShardedTrainer:
                     losses, self._values, self._states = self._step_many_fn(
                         key, self._values, self._states, self._t + 1,
                         lr if lr is not None else self._lr, *xs, ys)
+                    # counter commits with the values (see step_many)
                     self._t += n
+                    self._await_plan((losses, self._values, self._states))
                     losses_out.append(losses)
                     if remaining is not None:
                         remaining -= n

@@ -30,6 +30,12 @@ Injection points wired in this codebase:
 ``trainer.step``          ShardedTrainer.step / step_many entry
 ``trainer.grads``         training-step input staging (``nan`` kind poisons
                           the batch so loss/grads go non-finite)
+``trainer.dispatch``      watchdog-guarded result wait of a multi-axis
+                          (pp/ep/sp) planned training step — ``stall``
+                          here models a hung stage
+``pipeline.dispatch``     guarded dispatch of ``pipeline_spmd``
+``moe.dispatch``          guarded dispatch of ``moe_ffn_sharded``
+``ring.dispatch``         guarded dispatch of ``ring_attention_sharded``
 ``kvstore.push``          KVStore.push entry (per attempt)
 ``kvstore.pull``          KVStore.pull entry (per attempt)
 ``checkpoint.save``       between staging-dir write and atomic publish
@@ -50,11 +56,17 @@ or via the environment (picked up at import and by :func:`arm_from_env`)::
     MXNET_CHAOS_SPEC="serving.execute:transient:first=2;trainer.step:fatal:at=5"
 
 Grammar: ``point:kind[:trigger]`` rules joined by ``;``. ``kind`` is
-``transient`` | ``fatal`` | ``slow(<delay_ms>)`` | ``nan`` | ``host_loss``
-| ``preempt``. ``trigger`` is one of ``first=K`` (default ``first=1``),
+``transient`` | ``fatal`` | ``slow(<delay_ms>)`` | ``stall[(<cap_ms>)]``
+| ``nan`` | ``host_loss`` | ``preempt``. ``trigger`` is one of
+``first=K`` (default ``first=1``),
 ``every=N``, ``at=K``, or ``p=R,seed=S`` (deterministic seeded Bernoulli).
 ``transient``/``fatal`` raise :class:`TransientFault`/:class:`FatalFault`;
-``slow`` injects latency (sleeps, then returns normally); ``nan`` raises
+``slow`` injects latency (sleeps, then returns normally); ``stall``
+BLOCKS at the point until :func:`release_stalls` (or the cap, default
+30 s — a safety net so an unreleased drill cannot wedge a suite
+forever): the deterministic "hung collective" that drives the
+``CollectiveWatchdog`` tests without racing a fixed sleep against the
+deadline; ``nan`` raises
 nothing — the point *returns* ``"nan"`` (see :func:`poisoned`) and
 data-path callers corrupt their in-flight values with non-finite numbers,
 which is how numerical faults reach the compiled training step (a raise
@@ -87,7 +99,7 @@ import time
 
 __all__ = ["Fault", "TransientFault", "FatalFault", "SlowFault",
            "point", "poisoned", "arm", "arm_from_env", "clear", "stats",
-           "active", "EXIT_HOST_LOSS"]
+           "active", "release_stalls", "EXIT_HOST_LOSS"]
 
 # what an abruptly lost host reports to its supervisor (128 + SIGKILL —
 # the rc a kernel-killed worker would produce); resilience.elastic
@@ -136,7 +148,34 @@ class SlowFault(Fault):
         self.delay_ms = float(delay_ms)
 
 
-_KINDS = ("transient", "fatal", "slow", "nan", "host_loss", "preempt")
+_KINDS = ("transient", "fatal", "slow", "stall", "nan", "host_loss",
+          "preempt")
+
+# stall release: parked points wait on a generation counter under one
+# condition, so release_stalls() (and clear()) wakes every stalled
+# thread at once while stalls armed AFTERWARDS block again
+_stall_cond = threading.Condition()
+_stall_gen = 0
+
+
+def _stall_wait(cap_ms, gen=None):
+    """Park until the stall generation moves past ``gen`` or everything
+    is disarmed. ``gen`` is captured by :func:`point` BEFORE the fire
+    decision: a release/clear landing between that decision and this
+    wait must still unpark the thread, not strand it until the cap."""
+    with _stall_cond:
+        base = _stall_gen if gen is None else gen
+        _stall_cond.wait_for(lambda: _stall_gen != base or not _armed,
+                             timeout=cap_ms / 1e3)
+
+
+def release_stalls():
+    """Unpark every thread currently blocked in a ``stall``-kind point
+    (the drill's release valve; :func:`clear` calls it too)."""
+    global _stall_gen
+    with _stall_cond:
+        _stall_gen += 1
+        _stall_cond.notify_all()
 
 
 class _Rule:
@@ -146,11 +185,15 @@ class _Rule:
     __slots__ = ("point", "kind", "delay_ms", "first", "every", "at",
                  "p", "seed", "_rng", "calls", "fires", "message")
 
-    def __init__(self, point, kind, delay_ms=10.0, first=None, every=None,
+    def __init__(self, point, kind, delay_ms=None, first=None, every=None,
                  at=None, p=None, seed=0, message=None):
         if kind not in _KINDS:
             raise ValueError("unknown fault kind %r (want one of %s)"
                              % (kind, "/".join(_KINDS)))
+        if delay_ms is None:
+            # slow: a latency blip; stall: the safety cap on a wedge the
+            # test forgot to release — generous, never the mechanism
+            delay_ms = 30000.0 if kind == "stall" else 10.0
         n_triggers = sum(x is not None for x in (first, every, at, p))
         if n_triggers > 1:
             raise ValueError("pick ONE trigger: first=/every=/at=/p=")
@@ -190,7 +233,7 @@ class _Rule:
             return self.calls == self.at
         return self._rng.random() < self.p
 
-    def fire(self):
+    def fire(self, stall_gen=None):
         # self.fires was already counted under the module lock in point()
         msg = self.message or ("chaos[%s] injected %s (call #%d)"
                                % (self.point, self.kind, self.calls))
@@ -200,6 +243,10 @@ class _Rule:
             raise FatalFault(msg)
         if self.kind == "slow":
             time.sleep(self.delay_ms / 1e3)  # slow: latency, not an error
+        if self.kind == "stall":
+            # blocks until released (or the cap); gen was captured at
+            # the fire decision so a concurrent release cannot strand us
+            _stall_wait(self.delay_ms, stall_gen)
         if self.kind == "host_loss":
             _host_loss_action(msg)
         if self.kind == "preempt":
@@ -221,6 +268,7 @@ def point(name):
     poison their in-flight values — see :func:`poisoned`)."""
     if not _armed:
         return None
+    stall_gen = _stall_gen  # pre-decision snapshot (see _stall_wait)
     with _lock:
         rules = _rules.get(name)
         if not rules:
@@ -236,7 +284,7 @@ def point(name):
         if r.kind == "nan":
             out = "nan"
         else:
-            r.fire()
+            r.fire(stall_gen)
     return out
 
 
@@ -262,7 +310,7 @@ def arm(name, kind="transient", **kwargs):
 
 _SPEC_RE = re.compile(
     r"^(?P<point>[\w.\-]+):(?P<kind>transient|fatal|nan|host_loss|preempt|"
-    r"slow(\((?P<delay>[0-9.]+)\))?)(:(?P<trig>[\w=.,\-]+))?$")
+    r"(?:slow|stall)(\((?P<delay>[0-9.]+)\))?)(:(?P<trig>[\w=.,\-]+))?$")
 
 
 def arm_from_env(spec=None):
@@ -281,14 +329,14 @@ def arm_from_env(spec=None):
             raise ValueError(
                 "bad MXNET_CHAOS_SPEC rule %r: want "
                 "'point:kind[:trigger]' with kind transient|fatal|nan|"
-                "host_loss|preempt|slow(<delay_ms>) and trigger "
-                "first=K|every=N|at=K|p=R,seed=S" % part)
+                "host_loss|preempt|slow(<delay_ms>)|stall(<cap_ms>) and "
+                "trigger first=K|every=N|at=K|p=R,seed=S" % part)
         kind = m.group("kind")
         kwargs = {}
-        if kind.startswith("slow"):
+        if kind.startswith(("slow", "stall")):
             if m.group("delay") is not None:
                 kwargs["delay_ms"] = float(m.group("delay"))
-            kind = "slow"
+            kind = "stall" if kind.startswith("stall") else "slow"
         trig = m.group("trig")
         if trig:
             for kv in trig.split(","):
@@ -304,11 +352,13 @@ def arm_from_env(spec=None):
 
 
 def clear():
-    """Disarm everything (lifetime fire totals are kept for the profiler)."""
+    """Disarm everything (lifetime fire totals are kept for the
+    profiler) and unpark any thread a ``stall`` rule left blocked."""
     global _armed
     with _lock:
         _rules.clear()
         _armed = False
+    release_stalls()
 
 
 def active():

@@ -55,6 +55,7 @@ from .chaos import EXIT_HOST_LOSS
 __all__ = ["Preempted", "CollectiveTimeout", "PreemptionHandler",
            "ElasticMember", "ElasticCoordinator", "CollectiveWatchdog",
            "elastic_fit", "emergency_checkpoint", "guard_collective",
+           "guard_wait", "collective_alarm", "clear_collective_alarm",
            "install_preemption_handler", "current_handler",
            "preemption_pending", "membership_gauge", "health",
            "elastic_stats", "current_rank", "EXIT_PREEMPTED",
@@ -101,9 +102,9 @@ class CollectiveTimeout(RuntimeError):
 _lock = threading.Lock()
 _counters = {"preemptions": 0, "emergency_checkpoints": 0,
              "grace_overruns": 0, "elastic_resumes": 0,
-             "resharded_restores": 0, "heartbeats": 0, "registrations": 0,
-             "leaves": 0, "dead_declared": 0, "collective_timeouts": 0,
-             "guarded_collectives": 0}
+             "resharded_restores": 0, "replans": 0, "heartbeats": 0,
+             "registrations": 0, "leaves": 0, "dead_declared": 0,
+             "collective_timeouts": 0, "guarded_collectives": 0}
 
 
 def _count(key, n=1):
@@ -114,6 +115,32 @@ def _count(key, n=1):
 def elastic_stats():
     with _lock:
         return dict(_counters)
+
+
+# the /healthz collective alarm: a watchdog timeout latches it (this
+# process saw the fabric wedge — it should stop taking traffic and is,
+# by contract, about to abort and re-form); any LATER guarded collective
+# completing clears it (the fabric demonstrably moves again)
+_alarm_lock = threading.Lock()
+_collective_alarm = None  # {"op": ..., "deadline_ms": ...} | None
+
+
+def _set_collective_alarm(op, deadline_ms):
+    global _collective_alarm
+    with _alarm_lock:
+        _collective_alarm = {"op": op, "deadline_ms": float(deadline_ms)}
+
+
+def collective_alarm():
+    """The pending hung-collective alarm, or ``None``."""
+    with _alarm_lock:
+        return dict(_collective_alarm) if _collective_alarm else None
+
+
+def clear_collective_alarm():
+    global _collective_alarm
+    with _alarm_lock:
+        _collective_alarm = None
 
 
 # ---------------------------------------------------------------------------
@@ -566,10 +593,15 @@ def membership_gauge():
 
 def health():
     """Elastic contribution to ``/healthz``: degraded while this process
-    holds an unserved eviction notice, or while the in-process
-    coordinator sees silently-lost members."""
+    holds an unserved eviction notice, saw a collective wedge that no
+    later collective has cleared, or while the in-process coordinator
+    sees silently-lost members."""
     if preemption_pending():
         return {"status": "degraded", "reason": "preemption_pending"}
+    alarm = collective_alarm()
+    if alarm:
+        return {"status": "degraded", "reason": "collective_timeout",
+                "op": alarm["op"]}
     with _gauge_lock:
         c = _gauge_coordinator() if _gauge_coordinator is not None else None
     if c is not None:
@@ -754,6 +786,7 @@ class CollectiveWatchdog:
         if not done.wait(self.deadline_ms / 1e3):
             self.timeouts += 1
             _count("collective_timeouts")
+            _set_collective_alarm(op, self.deadline_ms)
             _trace.instant("elastic.collective_timeout", op=op,
                            deadline_ms=self.deadline_ms)
             from ..observability import attribution as _attr
@@ -765,6 +798,9 @@ class CollectiveWatchdog:
             raise CollectiveTimeout(
                 "collective %r still not done after %.0f ms — peer lost? "
                 "aborting instead of wedging" % (op, self.deadline_ms))
+        # finished inside the deadline (even with its own error): the
+        # fabric moves, so a pending hung-collective alarm is stale
+        clear_collective_alarm()
         if "error" in box:
             raise box["error"]
         return box.get("result")
@@ -780,6 +816,37 @@ def guard_collective(fn, *args, op="collective", **kwargs):
         return fn(*args, **kwargs)
     return CollectiveWatchdog(deadline_ms=deadline, name=op).run(
         fn, *args, op=op, **kwargs)
+
+
+def guard_wait(outputs, op="collective"):
+    """Bound the wait for ASYNC-dispatched device work whose collectives
+    can wedge (pipeline ppermute rings, MoE all_to_alls, a multi-axis
+    planned training step): fires the chaos point ``op`` (so a ``stall``
+    drill models the hang deterministically), then blocks until the
+    outputs are ready under the env-configured deadline, raising
+    :class:`CollectiveTimeout` past it.
+
+    With ``MXNET_ELASTIC_COLLECTIVE_DEADLINE_MS`` unset/0 this neither
+    synchronizes nor spawns a thread — async dispatch semantics are
+    untouched (the chaos point still fires: one attribute read when
+    disarmed). Arming the deadline buys the bound at the price of one
+    host sync per guarded dispatch."""
+    from . import chaos as _chaos
+    from .. import config as _config
+
+    deadline = _config.get("MXNET_ELASTIC_COLLECTIVE_DEADLINE_MS")
+    if not deadline or deadline <= 0:
+        _chaos.point(op)
+        return outputs
+
+    def _wait():
+        _chaos.point(op)
+        import jax
+        jax.block_until_ready(outputs)
+        return outputs
+
+    return CollectiveWatchdog(deadline_ms=deadline, name=op).run(
+        _wait, op=op)
 
 
 def _profiler_rows():

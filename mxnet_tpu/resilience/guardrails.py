@@ -399,6 +399,16 @@ class GuardedStep:
         return self._trainer.mesh
 
     @property
+    def plan(self):
+        return getattr(self._trainer, "plan", None)
+
+    @property
+    def _plan(self):
+        # checkpoint.save records the placement through the wrapper, and
+        # restore's re-plan accounting compares against it
+        return getattr(self._trainer, "_plan", None)
+
+    @property
     def _mesh(self):
         return self._trainer._mesh
 
@@ -593,6 +603,10 @@ class GuardedStep:
          telem) = self._gstep_fn(
             key, tr._values, tr._states, self._gstate, tr._t,
             lr if lr is not None else tr._lr, *xs, y)
+        if hasattr(tr, "_await_plan"):
+            # multi-axis plans: the guarded step's collectives ride the
+            # same watchdog bound as the bare trainer's
+            tr._await_plan((loss_val, tr._values, tr._states))
         for h, v in zip(tr._pure.aux_handles, aux):
             h._data = v
         self._steps += 1
@@ -651,7 +665,7 @@ class GuardedStep:
             # step records on disk even if the process dies next
             _attr.flight_note("anomaly", guarded=self.name,
                               step=storm[0], loss=storm[1],
-                              kind="nan_storm")
+                              storm="nan_storm")
             _attr.flight_dump("anomaly_fault")
             raise AnomalyFault(
                 "NaN storm: >= %d skipped steps in the last %d (at step "

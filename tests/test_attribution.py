@@ -663,7 +663,7 @@ def test_bench_sections_isolate_crashes():
         bd.INFO
     # declared section list covers the subsystems
     names = [n for n, _ in bench.SECTIONS]
-    assert names == ["resnet50_train", "serving_probe",
+    assert names == ["resnet50_train", "serving_probe", "elastic3d",
                      "roofline_attribution"]
 
 

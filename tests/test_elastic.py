@@ -49,9 +49,14 @@ WORKER = os.path.join(REPO, "tests", "dist", "elastic_worker.py")
 
 @pytest.fixture(autouse=True)
 def _disarm_chaos():
+    from mxnet_tpu.resilience import elastic
     chaos.clear()
+    elastic.clear_collective_alarm()
     yield
     chaos.clear()
+    # watchdog tests latch the hung-collective /healthz alarm by design;
+    # don't leak the degradation into unrelated tests
+    elastic.clear_collective_alarm()
 
 
 # ---------------------------------------------------------------------------

@@ -20,7 +20,7 @@ def _devices():
 def test_mesh_creation():
     assert len(_devices()) == 8
     mesh = parallel.make_mesh(dp=4, tp=2)
-    assert mesh.shape == {"dp": 4, "pp": 1, "tp": 2, "sp": 1}
+    assert mesh.shape == {"dp": 4, "pp": 1, "ep": 1, "tp": 2, "sp": 1}
     mesh2 = parallel.make_mesh()  # all devices on dp
     assert mesh2.shape["dp"] == 8
 
@@ -279,6 +279,44 @@ def test_trainer_multictx_eager_steps_no_buffer_donation_clash():
     for p in net.collect_params().values():
         datas = [d.asnumpy() for d in p.list_data()]  # raises if deleted
         np.testing.assert_allclose(datas[0], datas[1], rtol=1e-6)
+
+
+def test_sharded_trainer_init_owns_param_buffers():
+    """ShardedTrainer's initial placement must OWN its buffers: the
+    device_put shard landing on the source device is zero-copy, so one
+    donated step would otherwise delete the Block's eager parameter —
+    killing eager forwards and any second trainer built from the same
+    Block (regression: 'Array has been deleted'; the sync_back/_owned_on
+    hazard, at init)."""
+    mx.random.seed(0)
+    np.random.seed(0)
+    net = gluon.nn.Sequential()
+    net.add(gluon.nn.Dense(4, in_units=8))
+    net.initialize(mx.init.Xavier())
+    net(mx.nd.zeros((1, 8)))
+    tr = parallel.ShardedTrainer(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+        {"learning_rate": 0.1})
+    x = mx.nd.array(np.random.rand(8, 8).astype("float32"))
+    y = mx.nd.array(np.random.randint(0, 4, (8,)).astype("float32"))
+    tr.step(x, y)  # donates the trainer's buffers
+    for p in net.collect_params().values():
+        _ = p.data().asnumpy()  # raises if the donation deleted it
+    out = net(x)  # eager forward still works mid-training
+    assert np.isfinite(out.asnumpy()).all()
+    # a second trainer from the same (still-intact) block
+    tr2 = parallel.ShardedTrainer(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+        {"learning_rate": 0.1})
+    assert np.isfinite(float(tr2.step(x, y).asnumpy()))
+    # dtype equal to the params' dtype: astype is a no-op ALIAS, not a
+    # copy — the ownership guarantee must hold on that path too
+    tr3 = parallel.ShardedTrainer(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+        {"learning_rate": 0.1}, dtype="float32")
+    tr3.step(x, y)
+    for p in net.collect_params().values():
+        _ = p.data().asnumpy()  # raises if the donation deleted it
 
 
 def test_kvstore_aggregates_device_copies():

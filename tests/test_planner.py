@@ -1,0 +1,600 @@
+"""Elastic 3D parallelism — the sharding planner (ISSUE-15 acceptance).
+
+Unit level: the placement search (feasibility gates, memory-constrained
+expert sharding, cost ordering, forced plans, serialization, the
+supervisor's planner-delegated device re-spread), the plan threading
+through ShardedTrainer/DeviceFeed/GuardedStep, checkpoint plan
+recording + re-plan accounting + the typed PlanMismatchError, the new
+``stall`` chaos kind, and CollectiveWatchdog coverage over the pipeline
+/ MoE dispatch collectives (hung stage -> CollectiveTimeout + /healthz
+degradation, never a silent wedge).
+
+Process level: a supervised dp x pp x ep MoE job (tests/dist/
+planner_worker.py) loses a host to injected ``host_loss``; the
+supervisor evicts, re-forms at world-1 with a planner re-spread pool,
+the restore RE-PLANS onto the new placement, and the resumed trajectory
+is bitwise-equal to uninterrupted restore-and-replay from the same
+snapshot at the surviving topology.
+"""
+import json
+import os
+import shutil
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, nd, parallel
+from mxnet_tpu.models.moe_transformer import moe_lm_tiny
+from mxnet_tpu.parallel import planner
+from mxnet_tpu.parallel.planner import (ModelProfile, PlanError,
+                                        PlanMismatchError, ShardingPlan,
+                                        plan_sharding, respread)
+from mxnet_tpu.resilience import chaos
+from mxnet_tpu.resilience.elastic import CollectiveTimeout
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LAUNCH = os.path.join(REPO, "tools", "launch.py")
+WORKER = os.path.join(REPO, "tests", "dist", "planner_worker.py")
+
+
+@pytest.fixture(autouse=True)
+def _clean_chaos_and_alarms():
+    from mxnet_tpu.resilience import elastic
+    chaos.clear()
+    elastic.clear_collective_alarm()
+    yield
+    chaos.clear()
+    elastic.clear_collective_alarm()
+
+
+def _profile(dense=1 << 20, stage=1 << 20, expert=1 << 24, stages=2,
+             experts=4, batch=8, seq=16, d_model=32):
+    return ModelProfile(dense_bytes=dense, stage_bytes=stage,
+                        expert_bytes=expert, n_stages=stages,
+                        n_experts=experts, batch=batch, seq=seq,
+                        d_model=d_model)
+
+
+# ---------------------------------------------------------------------------
+# planner unit: search, feasibility, cost, force, serialization, respread
+# ---------------------------------------------------------------------------
+
+def test_plan_covers_devices_and_respects_divisibility():
+    p = plan_sharding(8, _profile())
+    assert p.dp * p.pp * p.ep * p.sp == p.n_devices == 8
+    assert 2 % p.pp == 0 and 4 % p.ep == 0
+    assert 8 % (p.dp * p.ep) == 0  # batch divisible over the data axes
+
+
+def test_memory_gate_forces_expert_sharding():
+    """The memory-constrained MoE config: experts dominate, the budget
+    excludes full replication -> the planner must shard the expert axis;
+    pure-dp is infeasible at the same budget."""
+    prof = _profile(expert=1 << 26)
+    budget = ShardingPlan(dp=8).memory_per_device(prof) // 2
+    p = plan_sharding(8, prof, hbm_bytes=budget)
+    assert p.ep > 1 or p.pp > 1
+    assert p.memory_per_device(prof) <= budget
+    assert ShardingPlan(dp=8).feasible(prof, hbm_bytes=budget) is not None
+
+
+def test_no_feasible_placement_is_typed_and_named():
+    """experts x memory that cannot factor over the pool: a PlanError
+    carrying every candidate's rejection reason, not a bare assert."""
+    prof = _profile(expert=1 << 30, experts=3)  # ep in {1, 3}; 3 !| 8
+    with pytest.raises(PlanError, match="no feasible placement"):
+        plan_sharding(8, prof, hbm_bytes=1 << 20)
+
+
+def test_cost_prefers_dp_for_dense_small_models():
+    """Tiny params, fat batch: dp's allreduce is cheap, ep/pp would move
+    activation volume for nothing -> pure dp wins the cost ordering."""
+    prof = _profile(dense=1 << 10, stage=1 << 10, expert=1 << 10,
+                    batch=64, seq=128, d_model=256)
+    p = plan_sharding(8, prof)
+    assert (p.dp, p.pp, p.ep) == (8, 1, 1)
+
+
+def test_forced_plan_string_dict_and_env(monkeypatch):
+    prof = _profile()
+    p = plan_sharding(8, prof, force="dp=2,pp=2,ep=2")
+    assert (p.dp, p.pp, p.ep, p.sp) == (2, 2, 2, 1)
+    p2 = plan_sharding(8, prof, force={"dp": 4, "ep": 2})
+    assert (p2.dp, p2.ep) == (4, 2)
+    monkeypatch.setenv("MXNET_PLAN_FORCE", "dp=4,pp=2")
+    p3 = plan_sharding(8, prof)
+    assert (p3.dp, p3.pp) == (4, 2)
+    # forced but infeasible/ill-covering placements are still validated
+    with pytest.raises(PlanError, match="infeasible"):
+        plan_sharding(8, prof, force="dp=1,pp=1,ep=8")  # 8 !| 4 experts
+    with pytest.raises(PlanError, match="covers"):
+        plan_sharding(8, prof, force="dp=2,pp=2")
+    with pytest.raises(PlanError):
+        plan_sharding(8, prof, force="qq=8")
+
+
+def test_plan_serialization_roundtrip_and_equality():
+    p = ShardingPlan(dp=2, pp=2, ep=2)
+    d = p.to_dict()
+    assert d == {"dp": 2, "pp": 2, "ep": 2, "sp": 1, "n_devices": 8}
+    assert ShardingPlan.from_dict(d) == p
+    assert ShardingPlan.from_dict(json.loads(json.dumps(d))) == p
+    assert p != ShardingPlan(dp=4, pp=2, ep=1)
+    assert "dp2" in p.describe() and "ep2" in p.describe()
+    assert p.multi_axis and not ShardingPlan(dp=8).multi_axis
+    with pytest.raises(PlanError):
+        ShardingPlan(dp=0)
+    with pytest.raises(PlanError):
+        ShardingPlan(dp=2, pp=2, n_devices=8)
+
+
+def test_seq_parallel_axis_opt_in():
+    prof = _profile(dense=1 << 28, seq=64)  # fat replicated params:
+    # dp allreduce dominates, sp rotation is the cheap way to use devices
+    prof.seq_parallel = True
+    p = plan_sharding(8, prof)
+    assert p.sp > 1
+    off = _profile(dense=1 << 28, seq=64)
+    assert plan_sharding(8, off).sp == 1  # never without the opt-in
+
+
+def test_respread_is_planner_factorable():
+    """The supervisor's post-eviction spread: power-of-two per-worker
+    pools, so the worker-side axis search always has cofactors — the
+    un-factorable-mesh fix for pp/ep jobs re-formed at world-1."""
+    assert respread(8, 2) == 4
+    assert respread(8, 1) == 8
+    assert respread(8, 3) == 2      # not 8//3 with a remainder fiction
+    assert respread(6, 1) == 4      # rounded DOWN to a factorable pool
+    assert respread(1, 5) == 1
+    for total in (1, 2, 3, 4, 6, 8, 12, 16):
+        for world in (1, 2, 3, 4):
+            per = respread(total, world)
+            assert per >= 1 and per & (per - 1) == 0  # power of two
+
+
+def test_profile_from_block_naming_convention():
+    net = moe_lm_tiny()
+    net.initialize(mx.init.Xavier())
+    net(nd.zeros((1, 4), dtype="int32"))
+    prof = net.profile(batch=8, seq=16)
+    assert prof.n_stages == 2 and prof.n_experts == 4
+    assert prof.expert_bytes > 0 and prof.stage_bytes > 0
+    assert prof.dense_bytes > 0  # embeddings/head are unstacked
+    assert prof.token_bytes == 8 * 16 * 32 * 4
+
+
+# ---------------------------------------------------------------------------
+# plan threading: trainer / feed / guarded step / mesh
+# ---------------------------------------------------------------------------
+
+def _moe_trainer(plan, optimizer="adam"):
+    mx.random.seed(0)
+    np.random.seed(0)
+    net = moe_lm_tiny()
+    net.initialize(mx.init.Xavier())
+    net(nd.zeros((1, 4), dtype="int32"))
+    return parallel.ShardedTrainer(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(), optimizer,
+        {"learning_rate": 1e-2}, plan=plan)
+
+
+def _moe_batches(n, seed=3):
+    rng = np.random.RandomState(seed)
+    return [(nd.array(rng.randint(0, 64, (8, 16)).astype("int32")),
+             nd.array(rng.randint(0, 64, (8, 16)).astype("float32")))
+            for _ in range(n)]
+
+
+def test_trainer_builds_from_plan_and_matches_pure_dp():
+    """The end-to-end thread: mesh, batch axes and param rules all come
+    from the plan; the 3D placement computes the same math as pure dp
+    (same-placement runs are bitwise; across placements the collective
+    order differs, so compare to float tolerance)."""
+    t3 = _moe_trainer(ShardingPlan(dp=2, pp=2, ep=2))
+    assert t3._batch_axes == ("dp", "ep")
+    assert t3.plan.describe() == "dp2·pp2·ep2·sp1"
+    assert dict(t3.mesh.shape)["pp"] == 2 and dict(t3.mesh.shape)["ep"] == 2
+    # expert params landed sharded over (pp, ep): 1/4 of the tensor per
+    # device; stage params over pp only
+    for p, v in zip(t3._params, t3._values):
+        if "stack_expert_" in p.name:
+            shard = v.sharding.shard_shape(v.shape)
+            assert shard[0] == v.shape[0] // 2      # pp
+            assert shard[1] == v.shape[1] // 2      # ep
+        elif "stack_" in p.name:
+            assert v.sharding.shard_shape(v.shape)[0] == v.shape[0] // 2
+    tdp = _moe_trainer(ShardingPlan(dp=8))
+    for x, y in _moe_batches(3):
+        a = float(t3.step(x, y).asnumpy())
+        b = float(tdp.step(x, y).asnumpy())
+        assert a == pytest.approx(b, rel=1e-5)
+
+
+def test_same_placement_replay_is_bitwise():
+    a = _moe_trainer(ShardingPlan(dp=2, pp=2, ep=2))
+    b = _moe_trainer(ShardingPlan(dp=2, pp=2, ep=2))
+    la = [float(a.step(x, y).asnumpy()) for x, y in _moe_batches(3)]
+    lb = [float(b.step(x, y).asnumpy()) for x, y in _moe_batches(3)]
+    assert la == lb
+
+
+def test_device_feed_and_step_stream_use_plan_axes():
+    from mxnet_tpu.parallel.datafeed import DeviceFeed
+
+    plan = ShardingPlan(dp=2, pp=2, ep=2)
+    t = _moe_trainer(plan)
+    feed = DeviceFeed(_moe_batches(4), plan=plan, name="plan_feed")
+    try:
+        x, _y = next(iter(feed))
+        spec = x[0].sharding.spec
+        assert tuple(spec)[0] == ("dp", "ep")
+        losses = t.step_stream(feed, steps=3, chunk=2)
+        assert losses.shape == (3,) and t._t == 3
+    finally:
+        feed.close()
+
+
+def test_guarded_step_rides_the_plan():
+    from mxnet_tpu.resilience.guardrails import GuardedStep
+
+    t = _moe_trainer(ShardingPlan(dp=2, pp=2, ep=2))
+    g = GuardedStep(t)
+    try:
+        for x, y in _moe_batches(2):
+            loss = g.step(x, y)
+        assert np.isfinite(float(loss.asnumpy()))
+        assert g._plan is t.plan  # checkpoint save sees the plan through
+        assert t._t == 2
+    finally:
+        g.close()
+
+
+# ---------------------------------------------------------------------------
+# checkpoint: plan recorded, re-plan counted, typed mismatch
+# ---------------------------------------------------------------------------
+
+def test_checkpoint_roundtrip_records_plan_and_counts_replan(tmp_path):
+    from mxnet_tpu.resilience import elastic
+
+    t = _moe_trainer(ShardingPlan(dp=1, pp=2, ep=4))
+    for x, y in _moe_batches(2):
+        t.step(x, y)
+    ck = str(tmp_path / "ck")
+    parallel.save_checkpoint(t, ck)
+
+    before = elastic.elastic_stats()["replans"]
+    t2 = _moe_trainer(ShardingPlan(dp=2, pp=2, ep=2))
+    parallel.restore_checkpoint(t2, ck)
+    assert t2._t == 2
+    assert elastic.elastic_stats()["replans"] == before + 1
+
+    # same placement back in: a restore that is NOT a re-plan
+    t3 = _moe_trainer(ShardingPlan(dp=1, pp=2, ep=4))
+    parallel.restore_checkpoint(t3, ck)
+    assert elastic.elastic_stats()["replans"] == before + 1
+
+
+def test_plan_checkpoint_restores_into_planless_trainer(tmp_path):
+    """Back-compat both ways: a plan-stamped checkpoint restores into a
+    trainer built without a plan (plan recorded-and-ignored), and the
+    pre-plan checkpoint layout keeps restoring (covered by the existing
+    resilience suite, asserted here for the plan trainer)."""
+    t = _moe_trainer(ShardingPlan(dp=2, pp=2, ep=2))
+    for x, y in _moe_batches(2):
+        t.step(x, y)
+    ck = str(tmp_path / "ck")
+    parallel.save_checkpoint(t, ck)
+
+    import jax
+    mx.random.seed(0)
+    np.random.seed(0)
+    net = moe_lm_tiny()
+    net.initialize(mx.init.Xavier())
+    net(nd.zeros((1, 4), dtype="int32"))
+    mesh = parallel.make_mesh(dp=2, devices=jax.devices()[:2])
+    t2 = parallel.ShardedTrainer(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(), "adam",
+        {"learning_rate": 1e-2}, mesh=mesh)
+    parallel.restore_checkpoint(t2, ck)
+    assert t2._t == 2 and t2.plan is None
+
+
+def test_restore_pre_plan_checkpoint_without_metadata(tmp_path, monkeypatch):
+    """A pre-planner checkpoint (records 'world', no 'plan') restores
+    into a plan-built trainer even when orbax metadata() is unavailable:
+    the retry must drop ONLY the 'plan' template key, not 'world' with
+    it (regression: the joint pop un-matched the template again)."""
+    import jax
+    import orbax.checkpoint as ocp
+
+    mx.random.seed(0)
+    np.random.seed(0)
+    net = moe_lm_tiny()
+    net.initialize(mx.init.Xavier())
+    net(nd.zeros((1, 4), dtype="int32"))
+    mesh = parallel.make_mesh(dp=-1, devices=jax.devices())
+    t = parallel.ShardedTrainer(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(), "adam",
+        {"learning_rate": 1e-2}, mesh=mesh)  # planless: world, no plan
+    for x, y in _moe_batches(2):
+        t.step(x, y)
+    ck = str(tmp_path / "ck")
+    parallel.save_checkpoint(t, ck)
+
+    t2 = _moe_trainer(ShardingPlan(dp=2, pp=2, ep=2))
+
+    def no_metadata(self, path):
+        raise RuntimeError("metadata unavailable (older layout)")
+
+    monkeypatch.setattr(ocp.PyTreeCheckpointer, "metadata", no_metadata)
+    parallel.restore_checkpoint(t2, ck)
+    assert t2._t == 2
+
+    # ...and the reverse: a PLAN-stamped checkpoint restores into a
+    # PLANLESS trainer without metadata — the retry must ADD the
+    # statically-known plan template, not mislabel the restore as an
+    # impossible reshard
+    ck2 = str(tmp_path / "ck2")
+    parallel.save_checkpoint(t2, ck2)
+    mx.random.seed(0)
+    np.random.seed(0)
+    net3 = moe_lm_tiny()
+    net3.initialize(mx.init.Xavier())
+    net3(nd.zeros((1, 4), dtype="int32"))
+    t3 = parallel.ShardedTrainer(
+        net3, gluon.loss.SoftmaxCrossEntropyLoss(), "adam",
+        {"learning_rate": 1e-2}, mesh=mesh)
+    parallel.restore_checkpoint(t3, ck2)
+    assert t3._t == 2 and t3.plan is None
+
+
+def test_restore_mismatch_raises_typed_plan_error(tmp_path):
+    """An impossible reshard (the saved model's expert axis does not
+    exist in the restoring trainer) surfaces as PlanMismatchError naming
+    saved-vs-current placement — not a raw orbax/pytree failure."""
+    t = _moe_trainer(ShardingPlan(dp=1, pp=2, ep=4))
+    t.step(*_moe_batches(1)[0])
+    ck = str(tmp_path / "ck")
+    parallel.save_checkpoint(t, ck)
+
+    mx.random.seed(0)
+    np.random.seed(0)
+    net2 = moe_lm_tiny(n_experts=2)  # half the experts: shapes can't land
+    net2.initialize(mx.init.Xavier())
+    net2(nd.zeros((1, 4), dtype="int32"))
+    t2 = parallel.ShardedTrainer(
+        net2, gluon.loss.SoftmaxCrossEntropyLoss(), "adam",
+        {"learning_rate": 1e-2}, plan=ShardingPlan(dp=4, pp=2, ep=1))
+    with pytest.raises(PlanMismatchError) as ei:
+        parallel.restore_checkpoint(t2, ck)
+    msg = str(ei.value)
+    assert "pp2" in msg and "ep4" in msg      # saved placement named
+    assert "dp4" in msg and "ep1" in msg      # current placement named
+
+
+# ---------------------------------------------------------------------------
+# chaos kind: stall
+# ---------------------------------------------------------------------------
+
+def test_chaos_stall_blocks_until_released():
+    chaos.arm("st.p", "stall", at=2, delay_ms=30000)
+    assert chaos.point("st.p") is None  # call 1: not yet
+    state = {"done": False}
+
+    def blocked():
+        chaos.point("st.p")  # call 2: stalls
+        state["done"] = True
+
+    th = threading.Thread(target=blocked, daemon=True)
+    th.start()
+    time.sleep(0.1)
+    assert not state["done"]            # deterministically wedged
+    chaos.release_stalls()
+    th.join(5.0)
+    assert state["done"]
+    assert chaos.stats()["st.p"] == {"calls": 2, "fires": 1}
+
+
+def test_chaos_stall_cap_and_spec_grammar():
+    rules = chaos.arm_from_env("st.spec:stall(40):every=2")
+    assert rules[0].kind == "stall" and rules[0].delay_ms == 40.0
+    t0 = time.monotonic()
+    chaos.point("st.spec")              # call 1: no fire
+    chaos.point("st.spec")              # call 2: stalls, capped at 40ms
+    assert 0.02 < time.monotonic() - t0 < 5.0
+    with pytest.raises(ValueError):
+        chaos.arm_from_env("st.bad:stall(nope)")
+
+
+def test_chaos_clear_releases_parked_stalls():
+    chaos.arm("st.clear", "stall", first=1, delay_ms=30000)
+    th = threading.Thread(target=lambda: chaos.point("st.clear"),
+                          daemon=True)
+    th.start()
+    time.sleep(0.05)
+    chaos.clear()  # disarm + unpark: the autouse fixture's guarantee
+    th.join(5.0)
+    assert not th.is_alive()
+
+
+# ---------------------------------------------------------------------------
+# watchdog coverage: pipeline / MoE dispatch + /healthz degradation
+# ---------------------------------------------------------------------------
+
+def _pp_mesh(n=2):
+    import jax
+    return parallel.make_mesh(pp=n, devices=jax.devices()[:n])
+
+
+def test_pipeline_stall_raises_collective_timeout(monkeypatch):
+    """A hung pipeline dispatch (stalled stage) aborts with the typed
+    CollectiveTimeout inside the configured deadline — never a silent
+    wedge — and lands in the elastic counters + /healthz."""
+    import jax.numpy as jnp
+    from mxnet_tpu.parallel.pipeline import pipeline_spmd
+    from mxnet_tpu.resilience import elastic
+
+    mesh = _pp_mesh(2)
+    eye = jnp.eye(4, dtype=jnp.float32)
+    params = {"w": jnp.stack([eye, 2.0 * eye])}
+    x = jnp.ones((4, 4), jnp.float32)
+
+    def stage(p, a):
+        return a @ p["w"]
+
+    # healthy path first: guarded, transparent
+    monkeypatch.setenv("MXNET_ELASTIC_COLLECTIVE_DEADLINE_MS", "5000")
+    out = pipeline_spmd(stage, params, x, mesh, n_micro=2)
+    np.testing.assert_allclose(np.asarray(out), 2.0 * np.ones((4, 4)))
+    assert elastic.health()["status"] == "ok"
+
+    before = elastic.elastic_stats()["collective_timeouts"]
+    chaos.arm("pipeline.dispatch", "stall", first=1, delay_ms=30000)
+    monkeypatch.setenv("MXNET_ELASTIC_COLLECTIVE_DEADLINE_MS", "100")
+    t0 = time.monotonic()
+    with pytest.raises(CollectiveTimeout, match="pipeline.dispatch"):
+        pipeline_spmd(stage, params, x, mesh, n_micro=2)
+    assert time.monotonic() - t0 < 5.0  # aborted, not wedged
+    assert elastic.elastic_stats()["collective_timeouts"] == before + 1
+    h = elastic.health()
+    assert h["status"] == "degraded" and h["reason"] == "collective_timeout"
+    chaos.release_stalls()
+    # the fabric moving again clears the alarm: next guarded op succeeds
+    monkeypatch.setenv("MXNET_ELASTIC_COLLECTIVE_DEADLINE_MS", "5000")
+    pipeline_spmd(stage, params, x, mesh, n_micro=2)
+    assert elastic.health()["status"] == "ok"
+
+
+def test_moe_dispatch_stall_raises_collective_timeout(monkeypatch):
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.parallel.mesh import make_mesh
+    from mxnet_tpu.parallel.moe import init_moe_params, moe_ffn_sharded
+
+    mesh = make_mesh(ep=2, devices=jax.devices()[:2])
+    gate, w1, w2 = init_moe_params(0, 8, 16, 4)
+    x = jnp.asarray(np.random.RandomState(0).randn(16, 8), jnp.float32)
+
+    chaos.arm("moe.dispatch", "stall", first=1, delay_ms=30000)
+    monkeypatch.setenv("MXNET_ELASTIC_COLLECTIVE_DEADLINE_MS", "100")
+    with pytest.raises(CollectiveTimeout, match="moe.dispatch"):
+        moe_ffn_sharded(x, gate, w1, w2, mesh)
+    chaos.release_stalls()
+    chaos.clear()
+    # released + disarmed: the same dispatch completes and matches the
+    # single-device routing oracle (large capacity: no drops, so local
+    # vs global capacity rounding cannot diverge)
+    from mxnet_tpu.parallel.moe import moe_ffn
+    y, aux = moe_ffn_sharded(x, gate, w1, w2, mesh, capacity_factor=100.0)
+    y_ref, aux_ref = moe_ffn(x, gate, w1, w2, capacity_factor=100.0)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(y_ref), atol=1e-5)
+
+
+def test_trainer_3d_step_dispatch_guarded(monkeypatch):
+    """The fused training step of a multi-axis plan rides the same
+    watchdog: a stalled dispatch raises CollectiveTimeout instead of
+    wedging the job, with the trainer pre-step state intact."""
+    t = _moe_trainer(ShardingPlan(dp=2, pp=2, ep=2))
+    x, y = _moe_batches(1)[0]
+    t.step(x, y)  # compile outside the deadline
+    chaos.arm("trainer.dispatch", "stall", first=1, delay_ms=30000)
+    monkeypatch.setenv("MXNET_ELASTIC_COLLECTIVE_DEADLINE_MS", "150")
+    with pytest.raises(CollectiveTimeout):
+        t.step(x, y)
+    chaos.release_stalls()
+    chaos.clear()
+    monkeypatch.delenv("MXNET_ELASTIC_COLLECTIVE_DEADLINE_MS")
+    assert np.isfinite(float(t.step(x, y).asnumpy()))
+
+
+# ---------------------------------------------------------------------------
+# supervised e2e: dp x pp x ep MoE job + host loss -> re-plan, bitwise
+# (ISSUE-15 acceptance)
+# ---------------------------------------------------------------------------
+
+def _worker_env(workdir, **extra):
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)  # the supervisor re-spreads the devices
+    env.update({"JAX_PLATFORMS": "cpu",
+                "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
+                "ELASTIC_WORKDIR": str(workdir)})
+    env.update({k: str(v) for k, v in extra.items()})
+    return env
+
+
+@pytest.mark.slow
+def test_supervised_3d_host_loss_replan_bitwise(tmp_path):
+    """Worker 1 of a 2-worker dp x pp x ep MoE job dies abruptly
+    (injected host_loss, exit 137). The supervisor evicts, re-forms at
+    world 1 with the planner-re-spread 8-device pool; the restarted
+    worker PLANS A DIFFERENT PLACEMENT (4 -> 8 devices), restores the
+    rolling checkpoint across placements (counted as a re-plan), and
+    its trajectory is bitwise-equal to uninterrupted restore-and-replay
+    from the same snapshot at the surviving topology."""
+    steps = 10
+    events = tmp_path / "events.jsonl"
+    env = _worker_env(tmp_path, ELASTIC_STEPS=steps, ELASTIC_CKPT_EVERY=2,
+                      ELASTIC_FAIL_RANK=1, ELASTIC_FAIL_STEP=4,
+                      ELASTIC_FAIL_KIND="host_loss",
+                      ELASTIC_STEP_SLOW_MS=150)
+    proc = subprocess.run(
+        [sys.executable, LAUNCH, "-n", "2", "--supervise",
+         "--max-restarts", "0", "--total-devices", "8",
+         "--rdzv-dir", str(tmp_path / "rdzv"),
+         "--event-log", str(events), "--grace-ms", "20000",
+         sys.executable, WORKER],
+        env=env, capture_output=True, text=True, timeout=420)
+    assert proc.returncode == 0, \
+        "supervised 3D run failed:\n%s" % proc.stderr[-4000:]
+
+    evs = [json.loads(ln) for ln in events.read_text().splitlines()]
+    fail = next(e for e in evs if e["event"] == "worker_failed")
+    assert fail["rank"] == 1 and fail["rc"] == 137
+    assert any(e["event"] == "evicted" and e["world"] == 1 for e in evs)
+    assert any(e["event"] == "run_complete" for e in evs)
+
+    with open(tmp_path / "out" / "result_gen1_rank0.json") as f:
+        resumed = json.load(f)
+
+    # the re-formed world absorbed the planner-re-spread pool (4 -> 8
+    # devices per worker) and chose a DIFFERENT placement than gen 0's
+    # 4-device plan (recomputed here with the worker's exact budget rule
+    # — the planner is deterministic): a genuine 3D re-plan, counted as
+    # such by the restore, and the re-formed placement spans ALL of
+    # dp x pp x ep
+    prof = moe_lm_tiny().profile(batch=48, seq=64)  # worker geometry
+    gen0_plan = plan_sharding(
+        4, prof,
+        hbm_bytes=int(planner.min_memory_per_device(4, prof) * 1.25)
+    ).to_dict()
+    assert resumed["devices"] == 8 and resumed["world"] == 1
+    assert resumed["plan"]["n_devices"] == 8
+    assert resumed["plan"] != gen0_plan
+    assert resumed["plan"]["dp"] > 1 and resumed["plan"]["pp"] > 1 \
+        and resumed["plan"]["ep"] > 1
+    assert resumed["replans"] >= 1
+    assert 0 < resumed["start_step"] < steps
+    assert resumed["end_step"] == steps
+
+    # reference: restore-and-replay from the same snapshot, same pool
+    ref = tmp_path / "ref"
+    os.makedirs(ref / "ckpt-rank0")
+    shutil.copytree(tmp_path / "out" / "restored_gen1_rank0",
+                    ref / "ckpt-rank0" / "resume_ckpt")
+    renv = _worker_env(ref, ELASTIC_STEPS=steps, MXTPU_GENERATION=1)
+    renv["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    rproc = subprocess.run([sys.executable, WORKER], env=renv,
+                           capture_output=True, text=True, timeout=240)
+    assert rproc.returncode == 0, rproc.stderr[-3000:]
+    with open(ref / "out" / "result_gen1_rank0.json") as f:
+        refres = json.load(f)
+    assert refres["start_step"] == resumed["start_step"]
+    assert refres["plan"] == resumed["plan"]
+    assert refres["losses"] == resumed["losses"]          # bitwise
+    assert refres["params_sha256"] == resumed["params_sha256"]

@@ -1020,3 +1020,69 @@ def test_reshard_on_resume_bitwise(tmp_path):
     assert replays[0][0] == replays[1][0]
     for a, b in zip(replays[0][1], replays[1][1]):
         np.testing.assert_array_equal(a, b)
+
+
+def test_reshard_on_resume_bitwise_expert_axis(tmp_path):
+    """The dp case above, on the EXPERT axis: an ep=4 checkpoint of the
+    stage-stacked MoE model restores onto an ep=2 plan — experts
+    re-spread over half the ranks — with params AND optimizer state
+    bitwise, and the replay at the surviving placement deterministic
+    (the ISSUE-15 elastic 3D re-form contract)."""
+    import jax
+
+    from mxnet_tpu.models.moe_transformer import moe_lm_tiny
+    from mxnet_tpu.parallel.mesh import replicated
+    from mxnet_tpu.parallel.planner import ShardingPlan
+
+    def trainer_on(plan):
+        mx.random.seed(0)
+        np.random.seed(0)
+        net = moe_lm_tiny()
+        net.initialize(mx.init.Xavier())
+        net(mx.nd.zeros((1, 4), dtype="int32"))
+        return parallel.ShardedTrainer(
+            net, gluon.loss.SoftmaxCrossEntropyLoss(), "adam",
+            {"learning_rate": 1e-2}, plan=plan)
+
+    def gathered(t):
+        return [np.asarray(jax.device_put(v, replicated(t._mesh)))
+                for v in t._values]
+
+    rng = np.random.RandomState(22)
+    batches = [(mx.nd.array(rng.randint(0, 64, (8, 16)).astype("int32")),
+                mx.nd.array(rng.randint(0, 64, (8, 16)).astype("float32")))
+               for _ in range(6)]
+    t4 = trainer_on(ShardingPlan(dp=1, pp=2, ep=4))
+    for x, y in batches[:3]:
+        t4.step(x, y)
+    ck = str(tmp_path / "ep4")
+    parallel.save_checkpoint(t4, ck)
+    saved = gathered(t4)
+    saved_states = [np.asarray(jax.device_put(s, replicated(t4._mesh)))
+                    for st in t4._states for s in st]
+
+    from mxnet_tpu.resilience import elastic as elastic_mod
+    before = elastic_mod.elastic_stats()["replans"]
+    replays = []
+    for run in range(2):
+        t2 = trainer_on(ShardingPlan(dp=2, pp=2, ep=2))
+        parallel.restore_checkpoint(t2, ck)
+        assert t2._t == 3
+        # restore across the expert re-spread is bitwise: every param
+        # and every optimizer-state leaf identical
+        for a, b in zip(saved, gathered(t2)):
+            np.testing.assert_array_equal(a, b)
+        restored_states = [np.asarray(jax.device_put(s,
+                                                     replicated(t2._mesh)))
+                           for st in t2._states for s in st]
+        for a, b in zip(saved_states, restored_states):
+            np.testing.assert_array_equal(a, b)
+        losses = [float(np.asarray(t2.step(x, y).asnumpy()))
+                  for x, y in batches[3:]]
+        replays.append((losses, gathered(t2)))
+    # both restores crossed ep=4 -> ep=2: counted as re-plans
+    assert elastic_mod.elastic_stats()["replans"] >= before + 2
+    # replay at the surviving placement is bitwise-deterministic
+    assert replays[0][0] == replays[1][0]
+    for a, b in zip(replays[0][1], replays[1][1]):
+        np.testing.assert_array_equal(a, b)

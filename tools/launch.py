@@ -50,6 +50,19 @@ EXIT_PREEMPTED = 75
 EXIT_HOST_LOSS = 137
 
 
+def _respread(total_devices, world):
+    """Per-worker device count per generation, delegated to the sharding
+    planner's spread policy (mxnet_tpu.parallel.planner.respread — the
+    module itself never probes devices, so the supervisor touches no
+    backend the workers own). Falls back to the legacy flat spread if
+    the library is absent (plain-launcher installs)."""
+    try:
+        from mxnet_tpu.parallel.planner import respread
+        return respread(total_devices, world)
+    except ImportError:
+        return max(1, int(total_devices) // max(1, int(world)))
+
+
 def _rank_env(args, rank, world=None, coordinator=None):
     world = args.num_workers if world is None else world
     coordinator = args.coordinator if coordinator is None else coordinator
@@ -310,10 +323,15 @@ def _supervise_loop(args, log, coord_host, hosts_pool, rdzv, world,
         if args.total_devices:
             # CPU-oracle topology simulation: the device pool re-spreads
             # over the surviving world, so a re-formed run reshards (the
-            # analogue of a pod slice reassigned at a new size)
+            # analogue of a pod slice reassigned at a new size). The
+            # spread is DELEGATED TO THE PLANNER: the flat total//world
+            # assumed a pure-dp world (any count factors as dp=N), but a
+            # pp/ep job re-formed at world-1 needs a pool the worker-side
+            # axis search can still split — planner.respread rounds down
+            # to a power of two so every re-placement stays factorable.
             extra["XLA_FLAGS"] = (
                 "--xla_force_host_platform_device_count=%d"
-                % max(1, args.total_devices // world))
+                % _respread(args.total_devices, world))
         procs.clear()  # in place: _teardown/exit sweep track this dict
         for rank in range(world):
             env = _rank_env(args, rank, world=world, coordinator=coordinator)
