@@ -22,7 +22,15 @@ numbers and exit code 0. Sections:
   bound-by classification): the chip round now says WHICH programs are
   HBM-bound, not just one MFU number;
 - ``serving_probe`` — a small bucket-laddered serving engine's
-  requests/s, so serving regressions surface in chip rounds too.
+  requests/s, so serving regressions surface in chip rounds too;
+- ``sharded_serving`` — the ISSUE-16 acceptance drill as a subprocess
+  on a forced 8-device CPU host platform (planner-infeasible MoE
+  served through the gateway, zero-compile AOT restart, host-loss
+  re-plan);
+- ``bench_gate`` — closing section: this round's fresh numbers diffed
+  against the committed ``benchmark/*.json`` baselines via
+  ``tools/bench_diff`` (a gated regression marks the section
+  REGRESSION instead of killing the round).
 
 Prints ONE JSON line; compare rounds with ``tools/bench_diff.py``.
 
@@ -247,6 +255,89 @@ def section_serving_probe(ctx):
             "requests": requests}
 
 
+def section_sharded_serving(ctx):
+    """ISSUE-16 acceptance drill: the sharded serving lane end to end
+    (planner-infeasible-on-one-chip MoE served through the gateway,
+    AOT restart with zero compiles, host-loss re-plan). Runs
+    ``benchmark/sharded_serving_bench.py`` as a subprocess on a forced
+    8-device CPU host platform — the mesh shape is the point, so this
+    section measures counters/assertions, not chip throughput (the
+    artifact carries its own cpu_caveat). The parsed artifact is
+    stashed in ctx for the closing bench_gate section."""
+    import subprocess
+    here = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(here, "benchmark", "sharded_serving_bench.py"),
+         "--json-only"],
+        env=env, capture_output=True, text=True, timeout=900)
+    if proc.returncode != 0:
+        raise RuntimeError("sharded_serving_bench rc=%d: %s"
+                           % (proc.returncode, proc.stderr[-2000:]))
+    artifact = json.loads(proc.stdout.strip().splitlines()[-1])
+    ctx["sharded_serving_artifact"] = artifact
+    return artifact
+
+
+def section_bench_gate(ctx):
+    """Closing regression gate (crash-isolated like every section): diff
+    this round's fresh numbers against the COMMITTED baselines with
+    tools/bench_diff — the regression ledger stops being write-only.
+    A gated regression marks this section REGRESSION (so it lands in
+    failed_sections and the round exits loudly in CI greps) without
+    zeroing the rest of the round's signal."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    sys.path.insert(0, here)
+    from tools.bench_diff import diff, load_artifact
+
+    gates = []
+    # per-gate tolerance + direction overrides: the sharded drill's
+    # committed baseline is the CPU oracle, where sub-second build/
+    # replan walls and shared-socket throughput jitter far beyond the
+    # default 5% — the portable signal is counters (misses, compiles,
+    # loaded executables) and halving-scale throughput collapses, so
+    # the gate runs wide (50%) with the raw walls demoted to info
+    for name, baseline_rel, candidate, tol, overrides in (
+            ("sharded_serving",
+             os.path.join("benchmark", "SHARDED_SERVING.json"),
+             ctx.get("sharded_serving_artifact"), 0.5,
+             {"host_loss.replan_s": "info",
+              "aot_restart.build_plus_load_s": "info",
+              "sharded.build_plus_compile_s": "info"}),
+    ):
+        base_path = os.path.join(here, baseline_rel)
+        if candidate is None:
+            gates.append({"gate": name, "status": "SKIPPED",
+                          "reason": "section did not run this round"})
+            continue
+        if not os.path.exists(base_path):
+            gates.append({"gate": name, "status": "SKIPPED",
+                          "reason": "no committed baseline %s"
+                                    % baseline_rel})
+            continue
+        verdict = diff(load_artifact(base_path), candidate,
+                       tolerance=tol, overrides=overrides)
+        gates.append({
+            "gate": name, "baseline": baseline_rel,
+            "status": verdict["status"],
+            "gated": verdict["gated"],
+            "regressions": verdict["regressions"],
+            "improvements": [r["metric"]
+                             for r in verdict["improvements"]],
+        })
+        for r in verdict["regressions"]:
+            log("bench_gate %s REGRESSION %s: %.4g -> %.4g (%+.1f%%)"
+                % (name, r["metric"], r["baseline"], r["candidate"],
+                   r["change"] * 100.0))
+    regressed = [g["gate"] for g in gates
+                 if g.get("status") == "regression"]
+    return {"status": "REGRESSION" if regressed else "OK",
+            "regressed": regressed, "gates": gates}
+
+
 def section_elastic3d(ctx):
     """Sharding-planner placement check (ISSUE-15): on the memory-
     constrained MoE config at this round's device count, the planner's
@@ -265,9 +356,13 @@ SECTIONS = (
     ("resnet50_train", section_resnet50_train),
     ("serving_probe", section_serving_probe),
     ("elastic3d", section_elastic3d),
-    # last on purpose: it summarizes every CachedOp dispatch the round
-    # made (the serving probe's ladder, any hybridized block)
+    ("sharded_serving", section_sharded_serving),
+    # it summarizes every CachedOp dispatch the round made (the serving
+    # probe's ladder, any hybridized block)
     ("roofline_attribution", section_roofline_attribution),
+    # last on purpose: gates the round's fresh numbers against the
+    # committed benchmark/*.json baselines (tools/bench_diff)
+    ("bench_gate", section_bench_gate),
 )
 
 

@@ -39,7 +39,8 @@ import pickle
 import struct
 
 __all__ = ["ArtifactError", "ARTIFACT_NAME", "WARMUP_NAME",
-           "fingerprint", "fingerprint_matches", "fingerprint_diff",
+           "fingerprint", "mesh_axes", "fingerprint_matches",
+           "fingerprint_diff",
            "write_artifact", "read_artifact", "read_artifact_header",
            "serialize_compiled", "deserialize_compiled"]
 
@@ -61,10 +62,32 @@ class ArtifactError(Exception):
 # fingerprinting: which process may load this artifact
 # ---------------------------------------------------------------------------
 
-def fingerprint():
+def mesh_axes(mesh):
+    """Normalize a mesh descriptor to the fingerprint's ``mesh`` entry:
+    ordered ``{axis_name: size}`` from a ``jax.sharding.Mesh`` (its
+    ``.shape`` mapping), a plain dict, or None (single-device lane).
+    Size-1 axes are kept — the axis NAMES are part of what the compiled
+    SPMD program was specialized against."""
+    if mesh is None:
+        return None
+    shape = getattr(mesh, "shape", mesh)
+    if not hasattr(shape, "items"):
+        raise ArtifactError("mesh descriptor %r has no axis mapping"
+                            % (mesh,))
+    return {str(k): int(v) for k, v in shape.items()}
+
+
+def fingerprint(mesh=None):
     """The compatibility tuple a serialized executable is valid for:
     jax/jaxlib/mxnet_tpu versions + backend platform + device kind +
-    addressable-device count. Computed at export, compared at load."""
+    addressable-device count + (for sharded lanes) the mesh axis
+    names and sizes the program was compiled against. Computed at
+    export, compared at load.
+
+    ``mesh=None`` means a single-device program; an artifact exported
+    without a mesh can therefore never be silently installed into a
+    sharded lane (and vice versa) — :func:`fingerprint_matches` treats
+    ``mesh`` exactly like the topology keys."""
     import jax
     import jaxlib
     from . import __version__ as _mx_version
@@ -82,10 +105,15 @@ def fingerprint():
         "device_kind": (getattr(accel[0], "device_kind", "") or ""
                         ) if accel else "",
         "n_devices": len(accel),
+        "mesh": mesh_axes(mesh),
     }
 
 
-_COMPARED_KEYS = ("jax", "jaxlib", "platform", "device_kind", "n_devices")
+# "mesh" compares via .get on BOTH sides: a pre-mesh artifact (no key)
+# equals a current single-device fingerprint (mesh None) — old artifacts
+# keep loading — while a sharded lane's mesh dict never equals either.
+_COMPARED_KEYS = ("jax", "jaxlib", "platform", "device_kind", "n_devices",
+                  "mesh")
 
 
 def fingerprint_matches(recorded, current=None):

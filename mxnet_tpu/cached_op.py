@@ -52,6 +52,21 @@ def _np_dtype(name):
         import ml_dtypes
         return _np.dtype(getattr(ml_dtypes, str(name)))
 
+
+def _active_sharding(val):
+    """The input's NamedSharding when it is committed onto a multi-device
+    mesh — the part of program identity the (shape, dtype) cache
+    signature can't see. jit specializes the compiled SPMD program on
+    these, so AOT export must re-lower with the SAME shardings or it
+    would serialize a different (single-device) program than the one
+    dispatch actually ran. Uncommitted / single-device inputs record
+    None and keep the exact pre-sharding behavior."""
+    s = getattr(val, "sharding", None)
+    mesh = getattr(s, "mesh", None)
+    if mesh is None or getattr(mesh, "size", 1) <= 1:
+        return None
+    return s
+
 # Process-wide executor-cache counters, aggregated across every CachedOp
 # instance (the serving layer exports these through /metrics). A "miss" is
 # an XLA compile; an "eviction" frees a compiled executable under the LRU
@@ -104,6 +119,9 @@ class CachedOp:
             capacity = _config.get("MXNET_CACHED_OP_CAPACITY")
         self._capacity = int(capacity)
         self._cache = OrderedDict()
+        # per-signature committed input shardings (mesh lanes only; see
+        # _active_sharding) — what serialize() re-lowers against
+        self._shardings = {}
         self._stats = {"hits": 0, "misses": 0, "evictions": 0,
                        "aot_loads": 0}
         # the serving engine dispatches one CachedOp from many HTTP threads:
@@ -154,6 +172,7 @@ class CachedOp:
         executables through this cache would be a device-memory leak."""
         with self._dispatch_lock:
             self._cache.clear()
+            self._shardings.clear()
 
     def _signature(self, args):
         return (tuple((a.shape, str(a.dtype)) for a in args),
@@ -234,10 +253,27 @@ class CachedOp:
                 nbytes)
 
     # ---- AOT export / load (cold-start: compile in CI, ship bytes) --------
-    def _specs_for(self, sig):
+    def _specs_for(self, sig, shardings=None):
         shapes, _ = sig
-        return [jax.ShapeDtypeStruct(tuple(shape), _np_dtype(dtype))
-                for shape, dtype in shapes]
+        if shardings is None:
+            shardings = (None,) * len(shapes)
+        return [jax.ShapeDtypeStruct(tuple(shape), _np_dtype(dtype),
+                                     sharding=s)
+                for (shape, dtype), s in zip(shapes, shardings)]
+
+    def input_shardings(self, sig):
+        """The committed input shardings signature ``sig`` was compiled
+        against (None per arg on single-device lanes)."""
+        with self._dispatch_lock:
+            return self._shardings.get(sig)
+
+    def record_shardings(self, sig, shardings):
+        """Pre-seed ``sig``'s committed input shardings. Sharded engines
+        call this after an AOT load (deserialized machine code carries
+        no jax-level shardings), so a later re-export still lowers the
+        same SPMD program instead of a single-device one."""
+        with self._dispatch_lock:
+            self._shardings[sig] = tuple(shardings)
 
     def serialize(self):
         """Capture every resident executable's *program* as
@@ -252,14 +288,15 @@ class CachedOp:
         restart after it compiles nothing. With the persistent compile
         cache enabled the re-compile here is itself a disk hit."""
         with self._dispatch_lock:
-            sigs = [(sig, entry[4], entry[6])
+            sigs = [(sig, entry[4], entry[6], self._shardings.get(sig))
                     for sig, entry in self._cache.items()]
         records = []
-        for sig, flops, nbytes in sigs:
+        for sig, flops, nbytes, shardings in sigs:
             train = sig[1]
             pure, _n_out_box, _aux_box = self._make_pure(train)
             compiled = jax.jit(pure).lower(
-                jax.random.PRNGKey(0), *self._specs_for(sig)).compile()
+                jax.random.PRNGKey(0),
+                *self._specs_for(sig, shardings)).compile()
             blob, in_tree, out_tree = _aot.serialize_compiled(compiled)
             records.append({"signature": sig, "train": train,
                             "flops": flops, "bytes": nbytes,
@@ -345,6 +382,9 @@ class CachedOp:
             # shape bucket (leading dim of the first input) that triggered
             # them — the classic "why was THIS request 2s?" answer
             t_c0 = time.perf_counter()
+            shards = tuple(_active_sharding(a._data) for a in args)
+            if not any(s is not None for s in shards):
+                shards = None
             with _trace.span("cachedop.compile", op=self._name,
                              bucket=bucket, signature=str(sig[0])):
                 compiled = self._compile(args)
@@ -353,6 +393,8 @@ class CachedOp:
             evicted = 0
             with self._dispatch_lock:
                 entry = self._cache.get(sig)
+                if shards is not None:
+                    self._shardings[sig] = shards
                 if entry is None or (entry[5] and recording):
                     # we won (or were alone, or are replacing an AOT
                     # entry with a traceable one): publish our executable

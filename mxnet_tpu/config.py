@@ -306,6 +306,20 @@ KNOBS = dict([
        "bypass the placement search with an explicit plan, e.g. "
        "'dp=2,pp=2,ep=2' — still validated against the model profile "
        "(divisibility + memory gate)"),
+    _k("MXNET_SERVE_PLAN_HBM_BYTES", 0, int, "wired",
+       "serving planner per-device memory budget: placements whose "
+       "modeled weights+activation+kv-arena bytes/device exceed it are "
+       "infeasible for plan_serving (parallel/planner.py; 0 = "
+       "unconstrained). Separate from MXNET_PLAN_HBM_BYTES because "
+       "inference carries no optimizer state"),
+    _k("MXNET_SERVE_PLAN_MAX_PP", 0, int, "wired",
+       "serving planner cap on the pipeline factor for plan_serving "
+       "(0 = no cap) — decode already prices pp's serialized hops, this "
+       "forbids them outright"),
+    _k("MXNET_SERVE_PLAN_FORCE", "", str, "wired",
+       "bypass the serving placement search with an explicit plan, e.g. "
+       "'dp=1,ep=8' — still validated against the model profile "
+       "(divisibility + serving memory gate)"),
     _k("MXNET_PROF_ATTRIBUTION", 1, int, "wired",
        "per-executable roofline accounting: capture bytes-accessed from "
        "XLA cost analysis at compile time and measure per-dispatch wall "

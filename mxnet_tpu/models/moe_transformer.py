@@ -153,6 +153,157 @@ class MoETransformerLM(HybridBlock):
         return self.head(NDArray(x))
 
 
+    # ---- incremental decode (KV-cache) path -------------------------------
+    # Same contract as TransformerLM (what DecodeEngine compiles its
+    # fused fixed-signature programs against): properties + init_cache /
+    # prefill / prefill_chunk / step. The MoE FFN stays moe_ffn — under
+    # jit with the expert stacks committed onto an 'ep' mesh axis, the
+    # SPMD partitioner shards the expert einsums, so the SAME contract
+    # serves expert-parallel with zero decode-path changes.
+
+    @property
+    def num_heads(self):
+        return self._num_heads
+
+    @property
+    def head_dim(self):
+        return self._units // self._num_heads
+
+    @property
+    def units(self):
+        return self._units
+
+    @property
+    def max_len(self):
+        return self._max_len
+
+    def init_cache(self, batch_size, max_len=None, dtype="float32"):
+        """Zeroed per-layer KV caches: ``[(k, v), ...]`` with each buffer
+        ``(batch_size, max_len, heads, head_dim)``."""
+        from .. import ndarray as nd
+        S = int(max_len or self._max_len)
+        shape = (int(batch_size), S, self.num_heads, self.head_dim)
+        return [(nd.zeros(shape, dtype=dtype), nd.zeros(shape, dtype=dtype))
+                for _ in range(self._num_layers)]
+
+    def _slabs(self):
+        """The stacked parameter tensors as raw jax values."""
+        return (self.stack_ln1_gamma.data()._data,
+                self.stack_ln1_beta.data()._data,
+                self.stack_ln2_gamma.data()._data,
+                self.stack_ln2_beta.data()._data,
+                self.stack_qkv_weight.data()._data,
+                self.stack_proj_weight.data()._data,
+                self.stack_gate_weight.data()._data,
+                self.stack_expert_w1.data()._data,
+                self.stack_expert_w2.data()._data)
+
+    def _split_qkv(self, xv, qkv_w):
+        """(B, T, D) hidden -> q/k/v in BSHD layout, one slab's weights."""
+        B, T, D = xv.shape
+        Hn = self._num_heads
+        hd = D // Hn
+        qkv = (xv @ qkv_w).reshape(B, T, 3, Hn, hd)
+        return qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+
+    def _moe(self, xv, gate_w, w1, w2):
+        from ..parallel.moe import moe_ffn
+        y, _aux = moe_ffn(xv, gate_w, w1, w2,
+                          capacity_factor=self._capacity_factor)
+        return y
+
+    def prefill(self, tokens, lengths=None):
+        """Fill a KV cache from a (padded) prompt in ONE forward pass.
+        Same contract as :meth:`TransformerLM.prefill`: returns
+        ``(logits (B, vocab) at each row's last valid position,
+        cache [(k, v), ...])``."""
+        from .. import ndarray as nd
+        from ..ndarray.ndarray import NDArray
+        B, T = tokens.shape
+        pos = nd.arange(0, T, dtype="int32")
+        x = self.embed(tokens) + self.pos_embed(pos)
+        if lengths is None:
+            lengths = nd.full((B,), T, dtype="int32")
+        kv_mask = pos.reshape((1, T)) < lengths.reshape((B, 1))
+        g1, b1, g2, b2, qkv_w, proj_w, gate_w, w1, w2 = self._slabs()
+        xv = x._data
+        cache = []
+        for i in range(self._num_layers):
+            h = _ln(xv, g1[i], b1[i])
+            q, k, v = self._split_qkv(h, qkv_w[i])
+            out = nd._contrib_dot_product_attention(
+                NDArray(q), NDArray(k), NDArray(v), mask=kv_mask,
+                causal=True, layout="BSHD")
+            xv = xv + out._data.reshape(B, T, self._units) @ proj_w[i]
+            xv = xv + self._moe(_ln(xv, g2[i], b2[i]), gate_w[i],
+                                w1[i], w2[i])
+            cache.append((NDArray(k), NDArray(v)))
+        last = nd.one_hot(lengths - 1, depth=T)              # (B, T)
+        h_last = nd.sum(NDArray(xv) * last.reshape((B, T, 1)), axis=1)
+        return self.head(h_last), cache
+
+    def _incremental(self, tokens, cache, start, chunk):
+        """Shared body of :meth:`step` (chunk=False, C==1) and
+        :meth:`prefill_chunk` (chunk=True): append C tokens per row at
+        per-row offsets ``start`` against cached K/V, purely
+        functional. Returns ``(hidden (B, C, D), new_cache)``."""
+        from .. import ndarray as nd
+        from ..ndarray.ndarray import NDArray
+        B, C = tokens.shape
+        if chunk:
+            pos = start.reshape((B, 1)) + \
+                nd.arange(0, C, dtype="int32").reshape((1, C))
+            # clamp for the position-embedding gather only (pad tails of
+            # the final chunk may run past max_len; garbage by contract)
+            pos = nd.minimum(pos, self._max_len - 1)
+        else:
+            pos = start.reshape((B, 1))
+        x = self.embed(tokens) + self.pos_embed(pos)
+        g1, b1, g2, b2, qkv_w, proj_w, gate_w, w1, w2 = self._slabs()
+        xv = x._data
+        new_cache = []
+        for i, (k_c, v_c) in enumerate(cache):
+            h = _ln(xv, g1[i], b1[i])
+            q, k, v = self._split_qkv(h, qkv_w[i])
+            k_c = nd.kv_cache_update(k_c, NDArray(k), start)
+            v_c = nd.kv_cache_update(v_c, NDArray(v), start)
+            S = k_c.shape[1]
+            if chunk:
+                span = nd.arange(0, S, dtype="int32").reshape((1, 1, S))
+                qpos = start.reshape((B, 1, 1)) + \
+                    nd.arange(0, C, dtype="int32").reshape((1, C, 1))
+                kv_mask = (span < qpos + 1).reshape((B, 1, C, S))
+            else:
+                span = nd.arange(0, S, dtype="int32").reshape((1, S))
+                kv_mask = span < (start.reshape((B, 1)) + 1)
+            out = nd._contrib_dot_product_attention(
+                NDArray(q), k_c, v_c, mask=kv_mask, dropout=0.0,
+                causal=False, layout="BSHD")
+            xv = xv + out._data.reshape(B, C, self._units) @ proj_w[i]
+            xv = xv + self._moe(_ln(xv, g2[i], b2[i]), gate_w[i],
+                                w1[i], w2[i])
+            new_cache.append((k_c, v_c))
+        return xv, new_cache
+
+    def prefill_chunk(self, tokens, cache, start):
+        """Append a chunk of ``C`` tokens per row at per-row offsets;
+        same contract as :meth:`TransformerLM.prefill_chunk`. Returns
+        ``(logits (B, C, vocab), new_cache)``."""
+        from ..ndarray.ndarray import NDArray
+        xv, new_cache = self._incremental(tokens, cache, start, chunk=True)
+        return self.head(NDArray(xv)), new_cache
+
+    def step(self, tokens, cache, lengths):
+        """One fused decode step; same contract as
+        :meth:`TransformerLM.step`. Returns ``(logits (B, vocab),
+        new_cache)``."""
+        from ..ndarray.ndarray import NDArray
+        B = tokens.shape[0]
+        xv, new_cache = self._incremental(tokens, cache, lengths,
+                                          chunk=False)
+        return self.head(NDArray(xv.reshape(B, self._units))), new_cache
+
+
 def jax_softmax(s):
     import jax
     return jax.nn.softmax(s, axis=-1)

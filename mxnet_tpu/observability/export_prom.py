@@ -527,7 +527,11 @@ def render_gateway_section(w, snap):
              "streams pinned to the replica", "pins"),
             ("mxtpu_gateway_replica_routed_total",
              "requests the gateway has routed to the replica",
-             "routed")):
+             "routed"),
+            ("mxtpu_gateway_replica_chips",
+             "devices behind the replica (a sharded replica is a "
+             "planned mesh of M chips; capacity math divides by this)",
+             "chips")):
         mtype = "counter" if name.endswith("_total") else "gauge"
         w.family(name, mtype, help_text)
         for rid, rep in table.items():
@@ -537,7 +541,12 @@ def render_gateway_section(w, snap):
                           and rep.get("breaker") != "open")
             else:
                 val = rep.get(key)
-            w.sample(name, val, labels={"replica": rid})
+            if val is None:
+                val = 1 if key == "chips" else 0
+            # every per-replica sample carries the mesh size so a
+            # dashboard summing replica counts can weight by chips
+            w.sample(name, val, labels={"replica": rid,
+                                        "mesh": str(rep.get("chips") or 1)})
 
 
 def _const_labels():

@@ -46,13 +46,23 @@ Knobs: ``MXNET_PLAN_HBM_BYTES`` (per-device memory budget for the
 feasibility gate; 0 = unconstrained), ``MXNET_PLAN_MAX_PP`` (cap the
 pipeline factor; 0 = no cap), ``MXNET_PLAN_FORCE`` (bypass the search
 with an explicit ``"dp=2,pp=2,ep=2"`` placement — still validated).
+
+Serving profile (:func:`plan_serving`): same factorization space and
+typed :class:`PlanError`, but scored by :meth:`ShardingPlan.decode_cost`
+— a latency-weighted model of one decode step (HBM weight reads on the
+serial critical path + latency-bound collectives) instead of training's
+per-step communication volume — and gated by
+:meth:`ShardingPlan.serving_memory_per_device` (weights only, no
+optimizer state, plus the KV arena shard). Its knob family mirrors
+training's: ``MXNET_SERVE_PLAN_HBM_BYTES``, ``MXNET_SERVE_PLAN_MAX_PP``,
+``MXNET_SERVE_PLAN_FORCE``.
 """
 from __future__ import annotations
 
 import re
 
 __all__ = ["PlanError", "PlanMismatchError", "ModelProfile", "ShardingPlan",
-           "plan_sharding", "respread"]
+           "plan_sharding", "plan_serving", "respread"]
 
 # enumeration order of the plan axes everywhere (serialization, describe,
 # mesh construction); tp is carried for mesh parity but the planner keeps
@@ -288,6 +298,62 @@ class ShardingPlan:
                / (self.dp * self.ep * self.sp))
         return int(profile.optimizer_factor * param + act)
 
+    def serving_memory_per_device(self, profile, kv_bytes=0):
+        """Analytic inference bytes/device: weights only (no optimizer
+        state), one decode step's activation shard, plus this device's
+        slice of the KV arena. The arena's layer dim shards over pp and
+        its slot dim over the data axes, so its shard divides by the
+        whole mesh — ``kv_bytes`` is the GLOBAL arena size
+        (:meth:`~mxnet_tpu.serving.generation.SlotKVCache.nbytes` x2
+        for k+v)."""
+        param = (profile.dense_bytes
+                 + profile.stage_bytes / self.pp
+                 + profile.expert_bytes / (self.pp * self.ep))
+        act = (profile.token_bytes * (profile.n_stages / self.pp)
+               / (self.dp * self.ep * self.sp))
+        kv = float(kv_bytes) / (self.pp * self.dp * self.ep * self.sp)
+        return int(param + act + kv)
+
+    def serving_feasible(self, profile, hbm_bytes=0, kv_bytes=0):
+        """None when this placement can SERVE ``profile``; else the
+        reason. Same divisibility gates as :meth:`feasible`, but the
+        memory gate uses :meth:`serving_memory_per_device` (no
+        optimizer state, KV arena included)."""
+        reason = self.feasible(profile)
+        if reason:
+            return reason
+        if hbm_bytes:
+            need = self.serving_memory_per_device(profile, kv_bytes)
+            if need > hbm_bytes:
+                return ("needs %d bytes/device > budget %d (serving: "
+                        "weights + kv arena)" % (need, int(hbm_bytes)))
+        return None
+
+    def decode_cost(self, profile):
+        """Latency-weighted cost of ONE decode step (lower is better) —
+        the serving planner's objective, where training's volume model
+        is wrong on purpose:
+
+        - decode is HBM-bandwidth bound: the critical path reads every
+          weight byte the token traverses. pp stages run SERIALLY per
+          token, so pp cuts nothing off that path (dense + stage reads
+          stay whole); ep genuinely divides the expert reads;
+        - pp adds a serialized boundary hop per stage — decode's tokens
+          are tiny, so each hop is latency- not bandwidth-priced
+          (weight 8 vs the training model's 2);
+        - ep pays its two all_to_alls (dispatch + combine, no backward);
+        - sp rotates the K/V ring on the critical path;
+        - dp moves nothing (weights replicated, no gradients) — it buys
+          throughput, never latency, so it only breaks ties.
+        """
+        hbm = (profile.dense_bytes + profile.stage_bytes
+               + profile.expert_bytes / self.ep)
+        tokens_local = profile.token_bytes / (self.dp * self.ep * self.sp)
+        comm = tokens_local * (8.0 * (self.pp - 1)
+                               + 2.0 * (self.ep - 1) / self.ep
+                               + 4.0 * (self.sp - 1))
+        return hbm + comm
+
     def comm_cost(self, profile):
         """Analytic per-step communication volume (bytes moved per
         device, lower is better). Per axis:
@@ -418,6 +484,77 @@ def plan_sharding(n_devices, profile, hbm_bytes=None, max_pp=None,
     return best
 
 
+def plan_serving(n_devices, profile, hbm_bytes=None, kv_bytes=0,
+                 max_pp=None, force=None):
+    """Choose the lowest-LATENCY feasible placement of ``profile`` on
+    ``n_devices`` for decode serving.
+
+    Same factorization space and typed :class:`PlanError` as
+    :func:`plan_sharding`, but scored by
+    :meth:`ShardingPlan.decode_cost` (per-token critical path: HBM
+    weight reads + latency-priced hops — prefers ep over pp, which a
+    volume model would happily pick) and gated by
+    :meth:`ShardingPlan.serving_feasible` (weights only, no optimizer
+    state, plus the ``kv_bytes`` KV-arena shard). Ties prefer larger ep
+    (shards the weight reads), then larger dp (free throughput), then
+    smaller pp.
+
+    ``profile.batch`` should be the decode slot count and
+    ``profile.seq`` the arena's max sequence length — what one decode
+    step actually touches. Knobs: ``MXNET_SERVE_PLAN_HBM_BYTES``,
+    ``MXNET_SERVE_PLAN_MAX_PP``, ``MXNET_SERVE_PLAN_FORCE`` (an
+    explicit ``"dp=1,ep=8"`` placement — still validated).
+    """
+    from .. import config as _config
+
+    n_devices = int(n_devices)
+    if n_devices < 1:
+        raise PlanError("n_devices must be >= 1, got %d" % n_devices)
+    if hbm_bytes is None:
+        hbm_bytes = _config.get("MXNET_SERVE_PLAN_HBM_BYTES")
+    hbm_bytes = int(hbm_bytes or 0)
+    kv_bytes = int(kv_bytes or 0)
+    if max_pp is None:
+        max_pp = _config.get("MXNET_SERVE_PLAN_MAX_PP")
+    max_pp = int(max_pp or 0)
+    if force is None:
+        force = _config.get("MXNET_SERVE_PLAN_FORCE") or None
+    if force is not None:
+        plan = _parse_force(force)
+        if plan.n_devices != n_devices:
+            raise PlanError("forced serving plan %s covers %d devices, "
+                            "pool has %d"
+                            % (plan.describe(), plan.n_devices, n_devices))
+        reason = plan.serving_feasible(profile, hbm_bytes, kv_bytes)
+        if reason:
+            raise PlanError("forced serving plan %s infeasible: %s"
+                            % (plan.describe(), reason))
+        return plan
+
+    best, best_key = None, None
+    rejected = []
+    for dp, pp, ep, sp in _factorizations(n_devices, profile.seq_parallel):
+        if max_pp and pp > max_pp:
+            continue
+        cand = ShardingPlan(dp=dp, pp=pp, ep=ep, sp=sp)
+        reason = cand.serving_feasible(profile, hbm_bytes, kv_bytes)
+        if reason:
+            rejected.append("%s: %s" % (cand.describe(), reason))
+            continue
+        key = (cand.decode_cost(profile), -ep, -dp, pp)
+        if best_key is None or key < best_key:
+            best, best_key = cand, key
+    if best is None:
+        raise PlanError(
+            "no feasible SERVING placement of %d stages x %d experts "
+            "(%d slots) on %d devices%s:\n  %s"
+            % (profile.n_stages, profile.n_experts, profile.batch,
+               n_devices,
+               " under %d bytes/device" % hbm_bytes if hbm_bytes else "",
+               "\n  ".join(rejected) or "<no factorization>"))
+    return best
+
+
 def min_memory_per_device(n_devices, profile, max_pp=None):
     """The tightest bytes/device any feasible placement of ``profile``
     achieves on ``n_devices`` (divisibility gates only). Feed it back as
@@ -446,6 +583,36 @@ def min_memory_per_device(n_devices, profile, max_pp=None):
         raise PlanError("no feasible placement of %d stages x %d experts "
                         "on %d devices" % (profile.n_stages,
                                            profile.n_experts, n_devices))
+    return best
+
+
+def min_serving_memory_per_device(n_devices, profile, kv_bytes=0,
+                                  max_pp=None):
+    """Serving twin of :func:`min_memory_per_device`: the tightest
+    bytes/device any feasible placement needs to SERVE ``profile``
+    (weights + kv arena, no optimizer state). Feed it back as
+    ``hbm_bytes`` with headroom to model the model-does-not-fit-one-chip
+    serving regime. Honors ``MXNET_SERVE_PLAN_MAX_PP``."""
+    if max_pp is None:
+        from .. import config as _config
+        max_pp = _config.get("MXNET_SERVE_PLAN_MAX_PP")
+    max_pp = int(max_pp or 0)
+    best = None
+    for dp, pp, ep, sp in _factorizations(int(n_devices),
+                                          profile.seq_parallel):
+        if max_pp and pp > max_pp:
+            continue
+        cand = ShardingPlan(dp=dp, pp=pp, ep=ep, sp=sp)
+        if cand.feasible(profile):
+            continue
+        mem = cand.serving_memory_per_device(profile, kv_bytes)
+        if best is None or mem < best:
+            best = mem
+    if best is None:
+        raise PlanError("no feasible serving placement of %d stages x "
+                        "%d experts on %d devices"
+                        % (profile.n_stages, profile.n_experts,
+                           n_devices))
     return best
 
 

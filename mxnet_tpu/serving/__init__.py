@@ -37,6 +37,7 @@ from .server import ModelServer
 from . import fleet
 from . import gateway
 from . import generation
+from . import sharded
 
 __all__ = ["InferenceEngine", "DynamicBatcher", "ModelServer",
            "ServingMetrics", "GenerationMetrics", "ServingError",
@@ -47,4 +48,4 @@ __all__ = ["InferenceEngine", "DynamicBatcher", "ModelServer",
            "ChecksumMismatch", "CompileBudgetExceeded",
            "write_manifest", "verify_manifest", "gateway", "Gateway",
            "Autoscaler", "GatewayMetrics", "Replica",
-           "ReplicaUnavailable", "NoRoutableReplica"]
+           "ReplicaUnavailable", "NoRoutableReplica", "sharded"]

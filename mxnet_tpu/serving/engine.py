@@ -249,6 +249,18 @@ class InferenceEngine:
             f.result()
 
     # ---- AOT artifacts (compile in CI, ship with the checkpoint) ----------
+    def _aot_fingerprint(self):
+        """The compatibility fingerprint this engine's artifacts are
+        exported under and gated against at load. The sharded lane
+        overrides this with ``aot.fingerprint(mesh)`` so a single-chip
+        artifact can never be silently installed into a multi-chip
+        engine (or vice versa)."""
+        return _aot.fingerprint()
+
+    def _artifact_extra(self):
+        """Header ``extra`` for :meth:`export_artifacts` (overridable)."""
+        return {"name": self._name, "buckets": list(self._buckets)}
+
     def export_artifacts(self, directory, include_warmup=True):
         """Write this engine's compiled ladder as AOT artifacts into
         ``directory``: ``executables.mxa`` (every resident executable as
@@ -270,7 +282,7 @@ class InferenceEngine:
         os.makedirs(directory, exist_ok=True)
         header = _aot.write_artifact(
             os.path.join(directory, _aot.ARTIFACT_NAME), records,
-            extra={"name": self._name, "buckets": list(self._buckets)})
+            extra=self._artifact_extra(), fp=self._aot_fingerprint())
         if include_warmup:
             manifest = self.warmup_manifest()
             if manifest["traffic"]:
@@ -302,10 +314,11 @@ class InferenceEngine:
             path = os.path.join(directory, _aot.ARTIFACT_NAME)
         header = _aot.read_artifact_header(path)   # typed on corrupt
         fp = header.get("fingerprint")
-        if not _aot.fingerprint_matches(fp):
+        current = self._aot_fingerprint()
+        if not _aot.fingerprint_matches(fp, current=current):
             _pcache.note_aot_fallback(
                 "fingerprint mismatch: %s"
-                % "; ".join(_aot.fingerprint_diff(fp)),
+                % "; ".join(_aot.fingerprint_diff(fp, current=current)),
                 where="InferenceEngine(%s)" % self._name)
             return 0
         header, records = _aot.read_artifact(path)

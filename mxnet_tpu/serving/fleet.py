@@ -163,6 +163,15 @@ def write_manifest(version_dir, extra=None):
             "warmup": (_aot.WARMUP_NAME
                        if _aot.WARMUP_NAME in files else None),
         }
+        # sharded exports ride extra identity: the mesh the machine code
+        # was specialized against, the plan that produced it, and the
+        # program-family layout — so a fleet (or `prewarm --check
+        # --mesh ...`) can decide mesh compatibility from the manifest
+        # alone, before touching the blob
+        for k in ("engine", "mesh", "plan", "families"):
+            v = header.get("extra", {}).get(k)
+            if v is not None:
+                manifest["executables"][k] = v
     if extra:
         manifest.update(extra)
     tmp = os.path.join(version_dir, MANIFEST_NAME + ".tmp")

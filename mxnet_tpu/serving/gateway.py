@@ -117,7 +117,7 @@ class Replica:
 
     __slots__ = ("id", "url", "state", "health", "breaker", "queue_depth",
                  "headroom", "inflight", "pins", "routed", "failures",
-                 "scrape_failures", "generation", "meta")
+                 "scrape_failures", "generation", "mesh", "meta")
 
     def __init__(self, rid, url, breaker, meta=None):
         self.id = rid
@@ -133,7 +133,19 @@ class Replica:
         self.failures = 0
         self.scrape_failures = 0
         self.generation = 0       # bumped per restart
+        self.mesh = None          # sharded lane: /metrics "mesh" gauge
         self.meta = meta
+
+    @property
+    def chips(self):
+        """Devices behind this replica: a sharded replica is a planned
+        mesh of M chips, not one — the autoscaler's capacity unit."""
+        if isinstance(self.mesh, dict):
+            try:
+                return max(1, int(self.mesh.get("n_devices") or 1))
+            except (TypeError, ValueError):
+                return 1
+        return 1
 
     def describe(self):
         return {
@@ -142,6 +154,7 @@ class Replica:
             "headroom": self.headroom, "inflight": self.inflight,
             "pins": self.pins, "routed": self.routed,
             "failures": self.failures, "generation": self.generation,
+            "mesh": self.mesh, "chips": self.chips,
             "breaker": self.breaker.snapshot()["state"],
         }
 
@@ -594,8 +607,8 @@ class Gateway:
         return results
 
     def _scrape_replica(self, url):
-        """One replica's (health_status, queue_depth, headroom) or None
-        when unreachable."""
+        """One replica's (health_status, queue_depth, headroom, mesh)
+        or None when unreachable."""
         try:
             with urllib.request.urlopen(
                     url + "/healthz",
@@ -603,7 +616,7 @@ class Gateway:
                 health = json.loads(r.read()).get("status", "ok")
         except Exception:
             return None
-        queue_depth, headroom = 0, None
+        queue_depth, headroom, mesh = 0, None, None
         try:
             with urllib.request.urlopen(
                     url + "/metrics",
@@ -616,9 +629,14 @@ class Gateway:
             mem = ((snap.get("telemetry") or {}).get("memory") or {})
             if isinstance(mem, dict) and "min_headroom" in mem:
                 headroom = mem["min_headroom"]
+            # sharded lane: the replica is a planned mesh of M chips —
+            # carried on the table so capacity math counts chips
+            m = snap.get("mesh")
+            if isinstance(m, dict):
+                mesh = m
         except Exception:
             pass  # health answered; load detail is best-effort
-        return health, queue_depth, headroom
+        return health, queue_depth, headroom, mesh
 
     def scrape_once(self):
         """One parallel load/health sweep over every replica; applies
@@ -641,12 +659,14 @@ class Gateway:
                         self._event("replica_down", replica=rid,
                                     url=rep.url)
                     continue
-                health, queue_depth, headroom = out
+                health, queue_depth, headroom, mesh = out
                 rep.scrape_failures = 0
                 came_up = (rep.health != "ok" and health == "ok")
                 rep.health = health
                 rep.queue_depth = queue_depth
                 rep.headroom = headroom
+                if mesh is not None:
+                    rep.mesh = mesh
                 if rep.state == JOINING and health == "ok":
                     rep.state = UP
                     self._event("replica_up", replica=rid, url=rep.url)
@@ -1209,7 +1229,8 @@ class Autoscaler:
 
     - **burn**: gateway-observed p99 over the sliding window above
       ``slo_p99_ms`` (``MXNET_GATEWAY_SLO_P99_MS``; 0 disables), OR mean
-      scraped queue depth per ready replica above ``queue_high``
+      scraped queue depth per ready *chip* (a sharded replica counts
+      its mesh size, keeping capacity math honest) above ``queue_high``
       (``MXNET_GATEWAY_QUEUE_HIGH``). ``burn_ticks`` consecutive burn
       ticks → spawn one replica through the backend (it joins
       health-gated, like any other replica).
@@ -1254,14 +1275,21 @@ class Autoscaler:
         ready = gw.ready_replicas()
         n = len(ready)
         p99 = gw.metrics.p99_ms()
-        mean_q = (sum(r.queue_depth for r in ready) / n) if n else 0.0
+        # capacity unit is the CHIP, not the replica: a sharded replica
+        # is a planned mesh of M chips, so its backlog divides by M —
+        # otherwise one 8-chip replica reads 8x busier than eight
+        # 1-chip replicas holding the same queue
+        chips = sum(r.chips for r in ready)
+        mean_q = (sum(r.queue_depth for r in ready) / chips) if chips \
+            else 0.0
         slo_burn = self.slo_p99_ms > 0 and p99 > self.slo_p99_ms
         queue_burn = n > 0 and mean_q > self.queue_high
         idle = (mean_q <= 1.0
                 and (self.slo_p99_ms <= 0 or p99 < self.slo_p99_ms / 2))
         return {"ready": n, "total": len(gw.replicas()), "p99_ms": p99,
-                "mean_queue_depth": mean_q, "slo_burn": slo_burn,
-                "queue_burn": queue_burn, "idle": idle}
+                "chips": chips, "mean_queue_depth": mean_q,
+                "slo_burn": slo_burn, "queue_burn": queue_burn,
+                "idle": idle}
 
     def tick(self):
         """One evaluation step; applies at most one scale action.

@@ -840,6 +840,14 @@ class ModelServer:
                 gen_metrics.bind_profiler()
         else:
             self.metrics.set_gauge_fn("generation", _generation.gauge)
+        # sharded lane: mesh identity (axis names+sizes, chips, plan) as
+        # a /metrics gauge — what the gateway scrape reads to know this
+        # replica is "a planned mesh of M chips", not one chip
+        mesh_src = getattr(self.generator, "engine", None) \
+            if self.generator is not None else None
+        mesh_fn = getattr(mesh_src or self.engine, "mesh_info", None)
+        if mesh_fn is not None:
+            self.metrics.set_gauge_fn("mesh", mesh_fn)
         # cold-start ledger: persistent-cache hits, AOT loads/fallbacks,
         # and the live prewarm replay's progress — restart health at a
         # glance without a Prometheus scrape

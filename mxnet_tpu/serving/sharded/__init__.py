@@ -1,0 +1,35 @@
+"""mxnet_tpu.serving.sharded — the sharded multi-chip inference lane.
+
+PR 15's elastic planner composed dp x pp x ep for *training*; this
+package threads the same :class:`~mxnet_tpu.parallel.planner.ShardingPlan`
+machinery through the serving stack, under the serving objective
+(:func:`~mxnet_tpu.parallel.planner.plan_serving`: decode latency —
+serial HBM weight reads + latency-priced collectives — instead of
+training comm volume):
+
+- :mod:`placement <.placement>` — commit params / KV arenas / host
+  inputs onto the plan's mesh (GSPMD then partitions every program);
+- :class:`ShardedDecodeEngine <.decode.ShardedDecodeEngine>` — the
+  fused fixed-signature decode step compiled against the plan's
+  shardings: MoE stacks serve expert-parallel, the slot arena is
+  mesh-sharded, and membership churn still compiles nothing;
+- :class:`ShardedInferenceEngine <.engine.ShardedInferenceEngine>` —
+  the bucketed predict lane, batch-sharded over the plan's data axes;
+- :class:`ShardedReplica <.replica.ShardedReplica>` — "a planned mesh
+  of M chips" as one drain-restart unit, surviving chip-host loss by
+  re-planning on the surviving pool.
+
+AOT artifacts from this lane fingerprint the MESH (axis names+sizes,
+``aot.fingerprint(mesh)``), so a multi-chip replica restarts with zero
+XLA compiles and a single-chip artifact can never be silently installed
+into a sharded lane.
+"""
+from .decode import ShardedDecodeEngine, ShardedSlotKVCache
+from .engine import ShardedInferenceEngine
+from .placement import (MeshCommittedOp, arena_sharding, arena_spec,
+                        place_params)
+from .replica import ShardedReplica
+
+__all__ = ["ShardedDecodeEngine", "ShardedSlotKVCache",
+           "ShardedInferenceEngine", "ShardedReplica", "MeshCommittedOp",
+           "place_params", "arena_spec", "arena_sharding"]

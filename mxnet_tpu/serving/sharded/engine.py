@@ -1,0 +1,111 @@
+"""ShardedInferenceEngine: the bucketed predict lane on a planned mesh.
+
+Same contract as :class:`~mxnet_tpu.serving.engine.InferenceEngine`
+(bucket ladder bounds compiles, pad/unpad batch invariant, warmup /
+prewarm / AOT artifacts), with the model's parameters committed onto a
+serving :class:`~mxnet_tpu.parallel.planner.ShardingPlan`'s mesh and
+batches committed batch-sharded over the plan's data axes — each bucket
+rung compiles to ONE SPMD program over all M chips.
+
+AOT artifacts ride the mesh-aware fingerprint
+(``aot.fingerprint(mesh)``): a restart of the same mesh shape installs
+machine code and compiles nothing; any other topology — including the
+single-chip lane — falls back with a ``cachedop.pcache.fallback`` row.
+"""
+from __future__ import annotations
+
+from ... import aot as _aot
+from ...parallel.planner import plan_serving
+from ..engine import InferenceEngine
+from .placement import MeshCommittedOp, place_params
+
+__all__ = ["ShardedInferenceEngine"]
+
+
+class ShardedInferenceEngine(InferenceEngine):
+    """Bucketed inference engine compiled against a ShardingPlan.
+
+    ``plan`` may be given directly; otherwise ``profile`` (a planner
+    :class:`~mxnet_tpu.parallel.planner.ModelProfile`, e.g. from
+    ``model.profile(batch, seq)``) is planned with
+    :func:`~mxnet_tpu.parallel.planner.plan_serving` over the device
+    pool. ``param_rules`` are prepended to the plan's naming-convention
+    rules (first match wins)."""
+
+    def __init__(self, model, plan=None, profile=None, devices=None,
+                 n_devices=None, hbm_bytes=None, kv_bytes=0,
+                 param_rules=None, name="sharded_inference", **kwargs):
+        import jax
+        if devices is None:
+            devices = list(jax.devices())
+            if n_devices:
+                devices = devices[:int(n_devices)]
+        if plan is None:
+            if profile is None:
+                raise ValueError("ShardedInferenceEngine needs a plan or "
+                                 "a ModelProfile to plan from")
+            plan = plan_serving(len(devices), profile,
+                                hbm_bytes=hbm_bytes, kv_bytes=kv_bytes)
+        self.plan = plan
+        self._mesh = plan.mesh(devices)
+        rules = list(param_rules or []) + list(plan.param_rules())
+        self._param_shardings = place_params(model, self._mesh, rules)
+        super().__init__(model, name=name, **kwargs)
+        if self._op is not None:
+            self._op = MeshCommittedOp(self._op._fn, self._mesh,
+                                       batch_axes=plan.data_axes,
+                                       name=name)
+
+    @property
+    def mesh(self):
+        return self._mesh
+
+    def mesh_info(self):
+        """Mesh identity for the fleet/gateway layers: axis names+sizes,
+        chip count, and the plan."""
+        p = self.plan
+        return {"axes": _aot.mesh_axes(self._mesh),
+                "n_devices": int(self._mesh.size),
+                "plan": {"dp": p.dp, "pp": p.pp, "ep": p.ep, "sp": p.sp}}
+
+    def param_shardings(self):
+        return dict(self._param_shardings)
+
+    # ---- AOT: mesh-fingerprinted artifacts --------------------------------
+    def _aot_fingerprint(self):
+        return _aot.fingerprint(self._mesh)
+
+    def _artifact_extra(self):
+        extra = super()._artifact_extra()
+        p = self.plan
+        extra["mesh"] = _aot.mesh_axes(self._mesh)
+        extra["plan"] = {"dp": p.dp, "pp": p.pp, "ep": p.ep, "sp": p.sp}
+        return extra
+
+    def _input_shardings_for(self, sig):
+        """The committed shardings dispatch uses for ``sig`` — the
+        MeshCommittedOp rule (batch-sharded when the leading dim
+        divides, else replicated), applied per recorded input."""
+        from jax.sharding import NamedSharding, PartitionSpec
+        repl = NamedSharding(self._mesh, PartitionSpec())
+        batch = NamedSharding(self._mesh, PartitionSpec(self.plan.data_axes))
+        n = 1
+        for ax in self.plan.data_axes:
+            n *= int(self._mesh.shape[ax])
+        shapes, _train = sig
+        return tuple(batch if shape and shape[0] % n == 0 else repl
+                     for shape, _dtype in shapes)
+
+    def load_artifacts(self, directory, strict=False):
+        loaded = super().load_artifacts(directory, strict=strict)
+        if loaded and self._op is not None:
+            # deserialized machine code carries no jax-level shardings:
+            # re-seed each installed signature with the dispatch-rule
+            # shardings so a later re-export lowers the same SPMD
+            # programs instead of single-device ones
+            with self._op._dispatch_lock:
+                sigs = list(self._op._cache.keys())
+            for sig in sigs:
+                self._op.record_shardings(sig,
+                                          self._input_shardings_for(sig))
+        return loaded

@@ -1,0 +1,152 @@
+"""ShardedReplica: a planned mesh of M chips serving as ONE unit.
+
+The fleet/gateway layers treat a replica as an opaque URL; this module
+gives that URL a mesh. A :class:`ShardedReplica` owns the serving plan,
+the :class:`~.decode.ShardedDecodeEngine`, and the generation scheduler
+over it — and can **re-form on a smaller pool** when a chip host is
+lost: :meth:`replan` runs the serving planner on the surviving devices,
+rebuilds the engine against the new mesh (a new plan may move from,
+say, ``ep=8`` to ``ep=4``), and replays the AOT artifact — which
+installs machine code when the new mesh matches the artifact's
+fingerprint and falls back to compiles (one typed
+``cachedop.pcache.fallback`` row) when the mesh shrank. Parameters are
+re-placed from the live values; a production restart would re-place
+from the checkpoint instead — the placement path is identical.
+
+This is the drain-restart unit the gateway sees: ``mesh_info()`` rides
+the server's ``/metrics`` ``mesh`` gauge, the gateway's replica table
+carries it as the ``mesh`` label, and the autoscaler weights capacity
+by chips, not replica count.
+"""
+from __future__ import annotations
+
+import threading
+
+from ... import aot as _aot
+from ...parallel.planner import PlanError, plan_serving
+from .decode import ShardedDecodeEngine
+
+__all__ = ["ShardedReplica"]
+
+
+class ShardedReplica:
+    """Own a sharded decode lane end to end: plan -> mesh -> engine,
+    with re-plan on device loss.
+
+    Parameters
+    ----------
+    model : MoETransformerLM-like
+        The incremental-decode model (``prefill``/``step``/
+        ``prefill_chunk`` + geometry) whose ``stack_*`` naming the plan
+        places.
+    devices : optional
+        Device pool (default: all local). :meth:`replan` shrinks it.
+    hbm_bytes : optional
+        Per-device budget for the serving feasibility gate (also read
+        from ``MXNET_SERVE_PLAN_HBM_BYTES``).
+    artifacts_dir : optional
+        Sharded ``.mxa`` directory: loaded at build and after every
+        re-plan (fingerprint-gated on the CURRENT mesh).
+    engine_kwargs : optional
+        Forwarded to :class:`ShardedDecodeEngine` (num_slots, max_seq,
+        ladder, chunk, ...).
+    """
+
+    def __init__(self, model, devices=None, hbm_bytes=None,
+                 artifacts_dir=None, engine_kwargs=None,
+                 name="sharded_replica"):
+        import jax
+        self._model = model
+        self._hbm = hbm_bytes
+        self._artifacts = artifacts_dir
+        self._kw = dict(engine_kwargs or {})
+        self._name = name
+        self._lock = threading.Lock()
+        self.generation = 0
+        self.engine = None
+        self.aot_loaded = 0
+        self._build(list(devices) if devices is not None
+                    else list(jax.devices()))
+
+    def _build(self, devices):
+        self._devices = devices
+        self.engine = ShardedDecodeEngine(
+            self._model, devices=devices, hbm_bytes=self._hbm,
+            name="%s.g%d" % (self._name, self.generation), **self._kw)
+        self.aot_loaded = 0
+        if self._artifacts:
+            try:
+                self.aot_loaded = self.engine.load_artifacts(self._artifacts)
+            except _aot.ArtifactError:
+                # corrupt artifact: the lane compiles normally; the
+                # fallback row was already noted by the loader
+                self.aot_loaded = 0
+
+    # ---- identity ---------------------------------------------------------
+    @property
+    def plan(self):
+        return self.engine.plan
+
+    @property
+    def n_devices(self):
+        return len(self._devices)
+
+    def mesh_info(self):
+        info = self.engine.mesh_info()
+        info["generation"] = self.generation
+        return info
+
+    def compile_stats(self):
+        return self.engine.compile_stats()
+
+    # ---- fault tolerance --------------------------------------------------
+    def replan(self, devices=None, lost=None):
+        """Re-form this replica on a surviving device pool.
+
+        ``devices`` is the explicit surviving pool; ``lost`` removes
+        devices from the current one instead. Runs the serving planner
+        on the survivors (raising the planner's typed
+        :class:`~mxnet_tpu.parallel.planner.PlanError` when the model
+        no longer fits — the caller drains the replica instead), closes
+        the old engine (freeing its executables and arena), rebuilds on
+        the new mesh, and replays the AOT artifact under the new mesh's
+        fingerprint. In-flight sequences do NOT survive: the gateway
+        drain-restarts the replica as a unit, and requests re-enter
+        through the prefix-cache handoff. Returns a report dict."""
+        with self._lock:
+            if devices is None:
+                if lost is None:
+                    raise ValueError("replan needs devices= or lost=")
+                gone = set(id(d) for d in lost)
+                devices = [d for d in self._devices if id(d) not in gone]
+            if not devices:
+                raise PlanError("no surviving devices to re-plan on")
+            old = {"plan": str(self.engine.plan),
+                   "n_devices": self.n_devices}
+            # feasibility first: keep serving on the old (degraded) mesh
+            # rather than tearing down a lane the survivors can't hold
+            profile = self._kw.get("profile") or self._model.profile(
+                self.engine.cache.num_slots,
+                seq=self.engine.cache.max_seq)
+            new_plan = plan_serving(len(devices), profile,
+                                    hbm_bytes=self._hbm)
+            self.engine.close()
+            self.generation += 1
+            self._build(devices)
+            return {"generation": self.generation,
+                    "from": old,
+                    "to": {"plan": str(self.engine.plan),
+                           "n_devices": len(devices)},
+                    "planned": str(new_plan),
+                    "aot_loaded": self.aot_loaded}
+
+    def export_artifacts(self, directory=None):
+        """Export the current mesh's executables (defaults to the
+        replica's own artifact directory)."""
+        directory = directory or self._artifacts
+        if not directory:
+            raise ValueError("no artifacts directory configured")
+        return self.engine.export_artifacts(directory)
+
+    def close(self):
+        self.engine.close()

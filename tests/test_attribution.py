@@ -664,7 +664,8 @@ def test_bench_sections_isolate_crashes():
     # declared section list covers the subsystems
     names = [n for n, _ in bench.SECTIONS]
     assert names == ["resnet50_train", "serving_probe", "elastic3d",
-                     "roofline_attribution"]
+                     "sharded_serving", "roofline_attribution",
+                     "bench_gate"]
 
 
 # ---------------------------------------------------------------------------

@@ -1069,8 +1069,10 @@ def test_gateway_prometheus_exposition():
                        "mxtpu_gateway_replica_queue_depth",
                        "mxtpu_gateway_latency_ms"):
             assert family in text, family
-        # per-replica sample carries the replica label
-        assert 'mxtpu_gateway_replica_up{replica="0"} 1' in text
+        # per-replica sample carries the replica label plus the mesh
+        # size (chips behind the replica; 1 for a single-chip backend)
+        assert 'mxtpu_gateway_replica_up{replica="0",mesh="1"} 1' in text
+        assert "mxtpu_gateway_replica_chips" in text
         # gateway.* rows reached the profiler aggregate table
         from mxnet_tpu import profiler
         rows = profiler.get_aggregate_stats()

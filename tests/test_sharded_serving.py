@@ -1,0 +1,431 @@
+"""Sharded multi-chip serving (ISSUE-16): the PR 15 serving planner
+threaded through the decode/serving stack.
+
+Covers, on the suite's virtual 8-device CPU mesh (conftest forces
+``--xla_force_host_platform_device_count=8``):
+
+- the serving planner sharding an MoE model that is provably
+  infeasible on one chip (the planner's own feasibility math);
+- :class:`ShardedDecodeEngine`: membership churn and chunked prefill
+  compile NOTHING after the first fused decode step (misses == 1),
+  with the KV arena and expert weights committed per plan;
+- sharded ``.mxa``: in-process restart with zero compiles, plus a
+  genuine cross-process restart via
+  ``tests/dist/sharded_serving_worker.py`` (fresh interpreter, same
+  greedy tokens, ``compiles == 0``);
+- the mesh-fingerprint regression: a single-chip artifact is never
+  silently installed into a sharded lane (typed fallback + counted
+  ``cachedop.pcache.fallback`` row);
+- ``tools/prewarm.py --check --mesh``: exit 2 on mesh drift;
+- :class:`ShardedReplica`: chip-host loss -> re-plan on survivors,
+  typed ``PlanError`` when no pool remains;
+- gateway composition: a sharded replica scrapes its mesh into the
+  replica table, ``/generate`` flows through the gateway, the
+  autoscaler counts chips (not replicas), and the Prometheus
+  exposition carries the ``mesh`` label.
+"""
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+
+import mxnet_tpu as mx
+from mxnet_tpu import aot, nd, pcache
+from mxnet_tpu.models.moe_transformer import moe_lm_tiny
+from mxnet_tpu.parallel import planner
+from mxnet_tpu.serving.generation import GenerationScheduler
+from mxnet_tpu.serving.sharded import (ShardedDecodeEngine,
+                                       ShardedInferenceEngine,
+                                       ShardedReplica, arena_spec)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "dist", "sharded_serving_worker.py")
+
+SLOTS, SEQ, EXPERTS = 8, 32, 8
+
+
+def _net(seed=0):
+    mx.random.seed(seed)
+    np.random.seed(seed)
+    net = moe_lm_tiny(n_experts=EXPERTS)
+    net.initialize(mx.init.Xavier())
+    net(nd.array(np.zeros((1, 8), "int32")))
+    return net
+
+
+def _kv_bytes(net):
+    return (2 * net.num_layers * SLOTS * SEQ * net.num_heads *
+            net.head_dim * np.dtype("float32").itemsize)
+
+
+def _drive(eng, steps=3):
+    """One slot through prefill + ``steps`` greedy decode steps."""
+    slot = eng.cache.acquire()
+    tok = eng.prefill(slot, np.arange(1, 9, dtype=np.int32))
+    tokens = np.zeros(SLOTS, np.int32)
+    temps = np.zeros(SLOTS, np.float32)
+    tokens[slot] = tok
+    out = [int(tok)]
+    for _ in range(steps):
+        nxt = eng.decode_step(tokens, temps)
+        eng.cache.advance([slot])
+        tokens[slot] = nxt[slot]
+        out.append(int(nxt[slot]))
+    eng.cache.release(slot)
+    return out
+
+
+@pytest.fixture(scope="module")
+def exported(tmp_path_factory):
+    """A compiled sharded lane + its exported ``.mxa`` directory,
+    shared by the AOT / fingerprint / prewarm / replica / gateway
+    tests (one engine build instead of five)."""
+    art = str(tmp_path_factory.mktemp("sharded_mxa"))
+    eng = ShardedDecodeEngine(_net(), num_slots=SLOTS, max_seq=SEQ,
+                              chunk=0, name="t16_shared")
+    tokens = _drive(eng)
+    header = eng.export_artifacts(art)
+    yield {"engine": eng, "dir": art, "header": header,
+           "tokens": tokens}
+    eng.close()
+
+
+# ---------------------------------------------------------------------------
+# planner math + arena placement (no engine)
+# ---------------------------------------------------------------------------
+
+def test_serving_plan_shards_infeasible_moe():
+    net = _net()
+    profile = net.profile(SLOTS, seq=SEQ)
+    kv = _kv_bytes(net)
+    single = planner.ShardingPlan()
+    need1 = single.serving_memory_per_device(profile, kv_bytes=kv)
+    budget = int(max(
+        need1 * 0.6,
+        planner.min_serving_memory_per_device(8, profile,
+                                              kv_bytes=kv) * 1.05))
+    # infeasible on one chip by the planner's own math...
+    reason = single.serving_feasible(profile, hbm_bytes=budget,
+                                     kv_bytes=kv)
+    assert reason and "bytes/device" in reason
+    # ...and the serving planner shards it over the expert axis
+    plan = planner.plan_serving(8, profile, hbm_bytes=budget,
+                                kv_bytes=kv)
+    assert plan.ep > 1
+    assert plan.serving_feasible(profile, hbm_bytes=budget,
+                                 kv_bytes=kv) is None
+    assert plan.serving_memory_per_device(profile, kv_bytes=kv) <= budget
+
+
+def test_arena_spec_follows_plan():
+    from jax.sharding import PartitionSpec as P
+    shape = (4, 8, SEQ, 4, 16)   # (layers, slots, seq, heads, head_dim)
+    # expert plan: slots shard over ep; layers stay whole (pp == 1)
+    assert arena_spec(planner.ShardingPlan(ep=8), shape) \
+        == P(None, ("ep",))
+    # pipeline plan: layer axis shards over pp when divisible
+    sp = arena_spec(planner.ShardingPlan(pp=2), shape)
+    assert sp[0] == "pp" and not sp[1]
+    # indivisible slot dim -> slots replicated, not misplaced
+    odd = (4, 7, SEQ, 4, 16)
+    sp = arena_spec(planner.ShardingPlan(ep=8), odd)
+    assert sp[0] is None and not sp[1]
+
+
+# ---------------------------------------------------------------------------
+# the sharded decode lane
+# ---------------------------------------------------------------------------
+
+def test_sharded_decode_churn_compiles_once():
+    from jax.sharding import PartitionSpec as P
+    eng = ShardedDecodeEngine(_net(), num_slots=SLOTS, max_seq=SEQ,
+                              chunk=0, name="t16_churn")
+    try:
+        assert eng.plan.ep == EXPERTS  # expert-parallel serving
+        # arena committed on the plan's mesh, slots over the ep axis
+        assert eng.cache.arena_sharding.spec == P(None, ("ep",))
+        assert (eng.cache.k_arena._data.sharding
+                == eng.cache.arena_sharding)
+        # expert stacks placed expert-parallel by naming convention
+        shardings = eng.param_shardings()
+        expert = [s for n, s in shardings.items() if "stack_expert_" in n]
+        assert expert and all(s.spec == P("pp", "ep") for s in expert)
+
+        slot = eng.cache.acquire()
+        tok = eng.prefill(slot, np.arange(1, 9, dtype=np.int32))
+        tokens = np.zeros(SLOTS, np.int32)
+        temps = np.zeros(SLOTS, np.float32)
+        tokens[slot] = tok
+        out = eng.decode_step(tokens, temps)
+        eng.cache.advance([slot])
+        tokens[slot] = out[slot]
+        # membership churn: slots join/leave, chunked prefill runs —
+        # the fused decode step never recompiles
+        s2 = eng.cache.acquire()
+        tokens[s2] = eng.prefill(s2, np.arange(3, 13, dtype=np.int32))
+        eng.decode_step(tokens, temps)
+        eng.cache.advance([slot, s2])
+        eng.cache.release(slot)
+        s3 = eng.cache.acquire()
+        eng.prefill_chunks(s3, np.arange(2, 20, dtype=np.int32), 0)
+        eng.decode_step(tokens, temps)
+        eng.cache.advance([s2, s3])
+        assert eng.compile_stats()["decode"]["misses"] == 1
+        # arena still canonically placed after many functional commits
+        assert (eng.cache.k_arena._data.sharding
+                == eng.cache.arena_sharding)
+    finally:
+        eng.close()
+
+
+def test_aot_restart_zero_compiles_in_process(exported):
+    eng2 = ShardedDecodeEngine(_net(), num_slots=SLOTS, max_seq=SEQ,
+                               chunk=0, name="t16_restart")
+    try:
+        loaded = eng2.load_artifacts(exported["dir"])
+        assert loaded >= 2   # decode + prefill at least
+        toks = _drive(eng2)
+        assert toks == exported["tokens"]  # same params, same machine code
+        assert sum(v["misses"]
+                   for v in eng2.compile_stats().values()) == 0
+    finally:
+        eng2.close()
+
+
+def test_single_chip_artifact_refused_by_sharded_lane(exported,
+                                                      tmp_path):
+    """Regression (the aot.py mesh-fingerprint fix): an artifact
+    exported WITHOUT a mesh can never be silently installed into a
+    sharded lane — typed fallback, counted, lane unharmed."""
+    eng = exported["engine"]
+    # the same records, re-stamped as a single-chip export
+    header, records = aot.read_artifact(
+        os.path.join(exported["dir"], aot.ARTIFACT_NAME))
+    single_dir = tmp_path / "single"
+    single_dir.mkdir()
+    aot.write_artifact(str(single_dir / aot.ARTIFACT_NAME), records,
+                       extra=header["extra"], fp=aot.fingerprint())
+    before = pcache.stats().get("aot_fallbacks", 0)
+    # (the RuntimeWarning fires once per process; the COUNTER is the
+    # stable observable — every refusal adds a pcache.fallback row)
+    assert eng.load_artifacts(str(single_dir)) == 0
+    assert pcache.stats().get("aot_fallbacks", 0) == before + 1
+    # and the mismatch is the mesh key specifically, both directions
+    sharded_fp = aot.fingerprint(eng.mesh)
+    assert not aot.fingerprint_matches(aot.fingerprint(),
+                                       current=sharded_fp)
+    assert not aot.fingerprint_matches(sharded_fp,
+                                       current=aot.fingerprint())
+    assert any(d.startswith("mesh:")
+               for d in aot.fingerprint_diff(aot.fingerprint(),
+                                             current=sharded_fp))
+
+
+# ---------------------------------------------------------------------------
+# prewarm --check: mesh drift gate
+# ---------------------------------------------------------------------------
+
+def _prewarm_tool():
+    spec = importlib.util.spec_from_file_location(
+        "prewarm_tool", os.path.join(REPO, "tools", "prewarm.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_prewarm_check_mesh_drift(exported):
+    from mxnet_tpu.serving.fleet import write_manifest
+    tool = _prewarm_tool()
+    manifest = write_manifest(exported["dir"])
+    # the manifest carries the mesh with the artifact (fleet-visible)
+    exe = manifest["executables"]
+    assert exe["engine"] == "sharded_decode"
+    assert exe["mesh"] == exported["header"]["fingerprint"]["mesh"]
+    assert exe["plan"]["ep"] == EXPERTS
+
+    # default expectation is a single-chip lane -> sharded artifact is
+    # mesh drift, exit 2, with the dedicated status + reason
+    code, report = tool.check(exported["dir"])
+    assert code == 2 and report["status"] == "mesh-drift"
+    assert "mesh drift" in report["error"]
+    # the planned mesh as expectation -> gate passes
+    code, report = tool.check(exported["dir"],
+                              mesh=exe["mesh"])
+    assert code == 0 and report["status"] == "ok"
+    # operator shorthand omits size-1 axes (the docs' `--mesh dp=1,ep=8`):
+    # the lane materializes them at 1, so the gate must still pass
+    code, report = tool.check(exported["dir"],
+                              mesh=tool._parse_mesh("dp=1,ep=%d" % EXPERTS))
+    assert code == 0 and report["status"] == "ok"
+    # a shrunken surviving pool's mesh -> drift again (exit 2)
+    code, report = tool.check(exported["dir"],
+                              mesh={"dp": 1, "pp": 1, "ep": 4,
+                                    "tp": 1, "sp": 1})
+    assert code == 2 and report["status"] == "mesh-drift"
+    # --mesh spec parsing
+    assert tool._parse_mesh("dp=1, ep=8") == {"dp": 1, "ep": 8}
+    assert tool._parse_mesh("none") is None
+    with pytest.raises(SystemExit):
+        tool._parse_mesh("ep8")
+
+
+# ---------------------------------------------------------------------------
+# replica: chip-host loss -> re-plan
+# ---------------------------------------------------------------------------
+
+def test_replica_replan_on_host_loss(exported):
+    rep = ShardedReplica(_net(), artifacts_dir=exported["dir"],
+                         engine_kwargs={"num_slots": SLOTS,
+                                        "max_seq": SEQ, "chunk": 0},
+                         name="t16_replica")
+    try:
+        assert rep.aot_loaded >= 2        # restart installed machine code
+        assert rep.n_devices == 8 and rep.plan.ep == EXPERTS
+        before = pcache.stats().get("aot_fallbacks", 0)
+        # lose half the pool: re-plan on survivors; the 8-chip artifact
+        # must be refused under the 4-chip mesh, not installed
+        report = rep.replan(lost=jax.devices()[4:])
+        assert report["to"]["n_devices"] == 4
+        assert rep.plan.ep == 4 and rep.aot_loaded == 0
+        assert rep.mesh_info()["generation"] == 1
+        assert pcache.stats().get("aot_fallbacks", 0) == before + 1
+        _drive(rep.engine)
+        assert rep.compile_stats()["decode"]["misses"] == 1
+        # no survivors at all -> the planner's typed error
+        with pytest.raises(planner.PlanError):
+            rep.replan(devices=[])
+    finally:
+        rep.close()
+
+
+# ---------------------------------------------------------------------------
+# gateway composition: mesh label, chips-weighted capacity, /generate
+# ---------------------------------------------------------------------------
+
+def test_gateway_serves_sharded_replica_with_mesh_label(exported):
+    from mxnet_tpu.serving.gateway import Autoscaler, Gateway
+    from mxnet_tpu.serving.server import ModelServer
+    sched = GenerationScheduler(exported["engine"])
+    srv = ModelServer(None, port=0, generator=sched).start()
+    gw = Gateway(replicas=[srv.url], scrape_ms=0)
+    gw.start()
+    try:
+        gw.scrape_once()
+        rep = gw.replicas()[0]
+        # the scrape carried the engine's mesh into the replica table
+        assert rep.chips == 8
+        assert rep.mesh["n_devices"] == 8
+        assert rep.mesh["plan"]["ep"] == EXPERTS
+        assert rep.describe()["chips"] == 8
+        # autoscaler capacity math counts chips, not replicas
+        backend = type("B", (), {"spawn": staticmethod(lambda: None),
+                                 "stop": staticmethod(lambda rid: None)})
+        sig = Autoscaler(gw, backend=backend, min_replicas=1,
+                         max_replicas=2).evaluate()
+        assert sig["chips"] == 8 and sig["ready"] == 1
+        # live /generate traffic through the gateway, no recompiles
+        body = json.dumps({"prompt": [1, 2, 3],
+                           "max_new_tokens": 4}).encode()
+        raw = urllib.request.urlopen(urllib.request.Request(
+            gw.url + "/generate", data=body), timeout=120).read()
+        lines = [json.loads(l) for l in raw.splitlines() if l.strip()]
+        toks = [l["token"] for l in lines if "token" in l]
+        assert len(toks) == 4
+        stats = exported["engine"].compile_stats()
+        assert stats["decode"]["misses"] == 1
+        # Prometheus exposition: per-replica samples carry the mesh size
+        with urllib.request.urlopen(gw.url + "/metrics.prom",
+                                    timeout=5) as resp:
+            text = resp.read().decode()
+        assert 'mxtpu_gateway_replica_up{replica="0",mesh="8"} 1' in text
+        assert 'mxtpu_gateway_replica_chips{replica="0",mesh="8"} 8' \
+            in text
+    finally:
+        gw.close()
+        srv.stop()
+        sched.close()
+
+
+# ---------------------------------------------------------------------------
+# cross-process restart (the honest zero-compile claim)
+# ---------------------------------------------------------------------------
+
+def _run_worker(scenario, art, out_path):
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)   # the worker forces its own 8 devices
+    env.update(SHARDED_SCENARIO=scenario, SHARDED_DIR=str(art),
+               SHARDED_OUT=str(out_path))
+    proc = subprocess.run([sys.executable, WORKER], env=env,
+                          capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    with open(out_path) as f:
+        return json.load(f)
+
+
+def test_cross_process_aot_restart(tmp_path):
+    art = tmp_path / "mxa"
+    art.mkdir()
+    exp = _run_worker("export", art, tmp_path / "export.json")
+    assert exp["decode_misses"] == 1
+    assert exp["fingerprint_mesh"]["ep"] == EXPERTS
+    res = _run_worker("restart", art, tmp_path / "restart.json")
+    # a genuinely fresh process serves off the .mxa: zero compiles,
+    # bit-identical greedy trajectory
+    assert res["loaded"] >= 2
+    assert res["compiles"] == 0
+    assert res["tokens"] == exp["tokens"]
+    assert res["plan"] == exp["plan"]
+
+
+# ---------------------------------------------------------------------------
+# the bucketed predict lane on a mesh
+# ---------------------------------------------------------------------------
+
+def test_sharded_inference_engine_predict_and_aot(tmp_path):
+    from mxnet_tpu import cached_op, gluon
+
+    def _dense():
+        mx.random.seed(0)
+        np.random.seed(0)
+        net = gluon.nn.HybridSequential()
+        net.add(gluon.nn.Dense(32, activation="relu"))
+        net.add(gluon.nn.Dense(8))
+        net.initialize(mx.init.Xavier())
+        net(nd.zeros((1, 16)))
+        return net
+
+    x = np.random.RandomState(1).standard_normal((8, 16)).astype(
+        "float32")
+    ref = _dense()(nd.array(x)).asnumpy()
+
+    plan = planner.ShardingPlan(dp=8)
+    eng = ShardedInferenceEngine(_dense(), plan=plan, buckets=(8,),
+                                 name="t16_pred")
+    try:
+        got = eng.predict(nd.array(x))
+        np.testing.assert_allclose(np.asarray(got), ref, rtol=2e-5,
+                                   atol=2e-5)
+        assert eng.mesh_info()["n_devices"] == 8
+        eng.export_artifacts(str(tmp_path))
+    finally:
+        eng.close()
+
+    eng2 = ShardedInferenceEngine(_dense(), plan=plan, buckets=(8,),
+                                  name="t16_pred2")
+    try:
+        assert eng2.load_artifacts(str(tmp_path)) >= 1
+        misses0 = cached_op.cache_stats()["misses"]
+        got = eng2.predict(nd.array(x))
+        np.testing.assert_allclose(np.asarray(got), ref, rtol=2e-5,
+                                   atol=2e-5)
+        assert cached_op.cache_stats()["misses"] == misses0
+    finally:
+        eng2.close()

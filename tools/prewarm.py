@@ -21,11 +21,14 @@ The cold-start runbook (ROADMAP item 4, ``docs/performance.md``):
 2. **Check** (CI gate: "artifacts shipped with the checkpoint")::
 
        python tools/prewarm.py MODEL_DIR --check
+       python tools/prewarm.py MODEL_DIR --check --mesh dp=1,ep=8
 
    Exit 0 when the version dir's manifest lists executables, every
    checksum verifies, and the artifact's fingerprint matches THIS
-   process (jax/jaxlib version, platform, device kind/count). Exit 2
-   when artifacts are missing or stale (re-export needed), 3 when they
+   process (jax/jaxlib version, platform, device kind/count — and,
+   for sharded artifacts, the ``--mesh`` expectation: the axis
+   names+sizes the deployment will form). Exit 2 when artifacts are
+   missing, stale, or mesh-drifted (re-export needed), 3 when they
    are corrupt. A restarting server would fall back to fresh compiles
    in exactly the cases this gate reports — the gate exists so that
    fallback never ships silently.
@@ -49,8 +52,39 @@ def _log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
-def check(model_dir):
-    """The ``--check`` gate. Returns (exit_code, report dict)."""
+def _parse_mesh(spec):
+    """``--mesh`` expectation string -> ordered ``{axis: size}`` dict.
+    ``"dp=1,ep=8"`` -> ``{"dp": 1, "ep": 8}``; ``"none"`` / ``"single"``
+    / ``""`` mean "expect an UNsharded artifact" (mesh ``None``)."""
+    if spec is None:
+        return None
+    spec = spec.strip()
+    if spec.lower() in ("", "none", "single"):
+        return None
+    mesh = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            raise SystemExit("--mesh expects 'axis=size,...' (e.g. "
+                             "'dp=1,ep=8'), got %r" % part)
+        k, v = part.split("=", 1)
+        mesh[k.strip()] = int(v)
+    return mesh or None
+
+
+def check(model_dir, mesh=None):
+    """The ``--check`` gate. Returns (exit_code, report dict).
+
+    ``mesh`` is the deployment's mesh expectation (``--mesh``): the
+    ordered axis dict the serving lane will form, or None for a
+    single-chip lane. A sharded artifact records the mesh it was
+    compiled against in its fingerprint; drift against the expectation
+    — a single-chip artifact where the fleet plans a mesh, a
+    dp1·ep8 artifact where the surviving pool can only form ep4 —
+    exits 2 (``mesh-drift``) exactly like any other staleness, because
+    the restarting replica would fall back to fresh compiles."""
     from mxnet_tpu import aot
     from mxnet_tpu.serving.fleet import (MANIFEST_NAME, ChecksumMismatch,
                                          ManifestError, verify_manifest)
@@ -78,17 +112,41 @@ def check(model_dir):
                             "artifacts were not exported for this version")
         return 2, report
     current = aot.fingerprint()
+    current["mesh"] = aot.mesh_axes(mesh)
     recorded = exe.get("fingerprint")
+    # the --mesh expectation is operator shorthand: a sharded lane always
+    # forms the full named mesh, so axes the spec omits materialize at
+    # size 1. If the recorded mesh agrees with the expectation on every
+    # axis of size > 1 (both ways), adopt the recorded axis set — the
+    # load-time fingerprint stays strict, only the CLI gate is lenient.
+    rec_mesh = (recorded or {}).get("mesh")
+    if current["mesh"] is not None and rec_mesh is not None:
+        def _nontrivial(m):
+            return {k: v for k, v in m.items() if v != 1}
+        if _nontrivial(current["mesh"]) == _nontrivial(rec_mesh):
+            current["mesh"] = dict(rec_mesh)
     report["executables"] = {"count": exe.get("count"),
                              "buckets": exe.get("buckets"),
                              "warmup": exe.get("warmup")}
+    for k in ("engine", "mesh", "plan", "families"):
+        if exe.get(k) is not None:
+            report["executables"][k] = exe[k]
     report["fingerprint"] = {"recorded": recorded, "current": current}
     if not aot.fingerprint_matches(recorded, current):
+        diff = aot.fingerprint_diff(recorded, current)
+        mesh_drift = all(d.startswith("mesh:") for d in diff)
         report.update(
-            status="stale",
+            status="mesh-drift" if mesh_drift else "stale",
             error="artifact fingerprint does not match this process: %s "
                   "— re-export on the current topology/jax version"
-                  % "; ".join(aot.fingerprint_diff(recorded, current)))
+                  % "; ".join(diff))
+        if mesh_drift:
+            rec_mesh = (recorded or {}).get("mesh")
+            report["error"] = (
+                "mesh drift: artifact compiled for mesh %r, deployment "
+                "expects %r — a replica restarting on this plan would "
+                "fall back to fresh compiles; re-export on the planned "
+                "mesh" % (rec_mesh, current["mesh"]))
         return 2, report
     return 0, report
 
@@ -138,6 +196,12 @@ def main(argv=None):
                     help="gate mode: exit non-zero when the manifest's "
                          "executables are missing/stale (2) or corrupt "
                          "(3) vs the current fingerprint")
+    ap.add_argument("--mesh", default=None, metavar="AXES",
+                    help="with --check: the deployment's mesh "
+                         "expectation, e.g. 'dp=1,ep=8' (or 'none' for "
+                         "a single-chip lane, the default) — a sharded "
+                         "artifact whose recorded mesh differs exits 2 "
+                         "(mesh drift)")
     ap.add_argument("--prefix", default="model",
                     help="artifact prefix (default: model)")
     ap.add_argument("--input-names", default="data",
@@ -156,7 +220,7 @@ def main(argv=None):
     args = ap.parse_args(argv)
 
     if args.check:
-        code, report = check(args.model_dir)
+        code, report = check(args.model_dir, mesh=_parse_mesh(args.mesh))
         print(json.dumps(report, indent=2, sort_keys=True))
         return code
 
